@@ -1,0 +1,9 @@
+"""oim-tpu: a TPU-native framework with the capabilities of Intel OIM.
+
+Three cooperating gRPC services (registry / per-host controller / feeder), a C++
+host->HBM staging engine in the SPDK role, and a JAX training stack (models, named-axis
+parallelism, pallas ops) that consumes CSI-mounted HBM shards. See repo-root SURVEY.md
+for the structural analysis of the reference and README.md for the architecture.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+"""CLI entry points (reference cmd/oim-registry, cmd/oim-controller,
+cmd/oim-csi-driver, cmd/oimctl; SURVEY.md 2.7). Run as
+``python -m oim_tpu.cli.<name>``."""

@@ -1,0 +1,37 @@
+"""Shared flag plumbing for the CLIs (the reference's InitSimpleFlags +
+LoadTLSConfig pattern, cmd/*/main.go)."""
+
+from __future__ import annotations
+
+import argparse
+
+from oim_tpu.common import logging as oim_logging
+from oim_tpu.common.tlsutil import TLSConfig, load_tls
+
+
+def add_common_flags(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--log-level",
+        default="info",
+        help="debug|info|warning|error (reference -log.level flag)",
+    )
+    parser.add_argument("--ca", default="", help="CA certificate file (mTLS)")
+    parser.add_argument(
+        "--key",
+        default="",
+        help="path prefix for <prefix>.key/.crt (reference .key/.crt convention)",
+    )
+
+
+def setup_logging(args: argparse.Namespace) -> None:
+    oim_logging.set_global(
+        oim_logging.Logger(level=oim_logging.parse_level(args.log_level))
+    )
+
+
+def load_tls_flags(args: argparse.Namespace, peer_name: str = "") -> TLSConfig | None:
+    if not args.ca and not args.key:
+        return None
+    if not (args.ca and args.key):
+        raise SystemExit("--ca and --key must be given together")
+    return load_tls(args.ca, args.key, peer_name)

@@ -1,0 +1,63 @@
+"""oim-controller daemon (reference cmd/oim-controller/main.go)."""
+
+from __future__ import annotations
+
+import argparse
+
+from oim_tpu.cli.common import add_common_flags, load_tls_flags, setup_logging
+from oim_tpu.common.meshcoord import MeshCoord
+from oim_tpu.controller import Controller, MallocBackend, TPUBackend, controller_server
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser("oim-controller")
+    parser.add_argument("--endpoint", default="tcp://0.0.0.0:8998")
+    parser.add_argument("--controller-id", required=True)
+    parser.add_argument(
+        "--controller-address",
+        default="",
+        help="address registered into the registry (reference -controller-address)",
+    )
+    parser.add_argument("--registry", default="", help="registry address to register at")
+    parser.add_argument(
+        "--registry-delay",
+        type=float,
+        default=60.0,
+        help="re-registration interval seconds (reference -registry-delay)",
+    )
+    parser.add_argument(
+        "--backend",
+        choices=("malloc", "tpu"),
+        default="tpu",
+        help="staging backend (malloc = host-RAM only, the reference's Malloc BDev mode)",
+    )
+    parser.add_argument(
+        "--mesh-coord", default="", help="this host's ICI coordinate x,y,z[,core]"
+    )
+    add_common_flags(parser)
+    args = parser.parse_args(argv)
+    setup_logging(args)
+    tls = load_tls_flags(args)
+    backend = TPUBackend() if args.backend == "tpu" else MallocBackend()
+    coord = MeshCoord.parse(args.mesh_coord) if args.mesh_coord else None
+    controller = Controller(
+        controller_id=args.controller_id,
+        backend=backend,
+        controller_address=args.controller_address,
+        registry_address=args.registry,
+        registry_delay=args.registry_delay,
+        mesh_coord=coord,
+        tls=tls,
+    )
+    server = controller_server(args.endpoint, controller.service, tls=tls)
+    controller.start()
+    try:
+        server.wait()
+    except KeyboardInterrupt:
+        controller.stop()
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

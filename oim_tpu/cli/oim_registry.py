@@ -1,0 +1,30 @@
+"""oim-registry daemon (reference cmd/oim-registry/main.go)."""
+
+from __future__ import annotations
+
+import argparse
+
+from oim_tpu.cli.common import add_common_flags, load_tls_flags, setup_logging
+from oim_tpu.registry import MemRegistryDB, RegistryService
+from oim_tpu.registry.registry import registry_server
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser("oim-registry")
+    parser.add_argument(
+        "--endpoint", default="tcp://0.0.0.0:8999", help="listen endpoint"
+    )
+    add_common_flags(parser)
+    args = parser.parse_args(argv)
+    setup_logging(args)
+    service = RegistryService(db=MemRegistryDB(), tls=load_tls_flags(args))
+    server = registry_server(args.endpoint, service)
+    try:
+        server.wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

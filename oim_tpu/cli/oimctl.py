@@ -1,0 +1,54 @@
+"""oimctl admin CLI: get/set registry keys over mTLS
+(reference cmd/oimctl/main.go)."""
+
+from __future__ import annotations
+
+import argparse
+
+import grpc
+
+from oim_tpu.cli.common import add_common_flags, load_tls_flags, setup_logging
+from oim_tpu.common.tlsutil import secure_channel
+from oim_tpu.spec import RegistryStub, pb
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser("oimctl")
+    parser.add_argument("--registry", required=True, help="registry address")
+    parser.add_argument("--get", default=None, metavar="PATH", help="prefix to read")
+    parser.add_argument(
+        "--set",
+        default=None,
+        metavar="PATH=VALUE",
+        help="key to set (empty VALUE deletes)",
+    )
+    add_common_flags(parser)
+    args = parser.parse_args(argv)
+    setup_logging(args)
+    tls = load_tls_flags(args, peer_name="component.registry")
+    if tls is not None:
+        channel = secure_channel(args.registry, tls)
+    else:
+        channel = grpc.insecure_channel(args.registry)
+    stub = RegistryStub(channel)
+    try:
+        if args.set is not None:
+            if "=" not in args.set:
+                raise SystemExit("--set needs PATH=VALUE")
+            path, value = args.set.split("=", 1)
+            stub.SetValue(
+                pb.SetValueRequest(value=pb.Value(path=path, value=value)), timeout=10
+            )
+        if args.get is not None:
+            reply = stub.GetValues(pb.GetValuesRequest(path=args.get), timeout=10)
+            for value in reply.values:
+                print(f"{value.path}={value.value}")
+        if args.set is None and args.get is None:
+            raise SystemExit("nothing to do: pass --get and/or --set")
+    finally:
+        channel.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

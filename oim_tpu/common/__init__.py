@@ -1,0 +1,24 @@
+"""Common infrastructure: context-attached logging, mTLS with CommonName-based
+identity, gRPC server harness, call-logging interceptors, keyed mutexes, mesh
+coordinates, registry path helpers, and a child-process death monitor.
+
+The TPU-native counterpart of the reference's L1 layer (pkg/log, pkg/oim-common,
+SURVEY.md section 2.2).
+"""
+
+from oim_tpu.common.logging import (  # noqa: F401
+    Logger,
+    from_context,
+    get_global,
+    set_global,
+    with_logger,
+)
+from oim_tpu.common.meshcoord import MeshCoord  # noqa: F401
+from oim_tpu.common.pathutil import (  # noqa: F401
+    REGISTRY_ADDRESS,
+    REGISTRY_MESH,
+    join_registry_path,
+    split_registry_path,
+)
+from oim_tpu.common.server import NonBlockingGRPCServer, parse_endpoint  # noqa: F401
+from oim_tpu.common.keymutex import KeyMutex  # noqa: F401

@@ -1,0 +1,102 @@
+"""gRPC call-logging interceptors with payload formatting and secret stripping.
+
+Re-creates the reference's active tracing layer (pkg/oim-common/tracing.go):
+unary interceptors on both client and server log method + payload pre/post
+through the context logger, with a pluggable payload formatter. The
+``StripSecrets`` formatter redacts any proto field named ``secret`` (the
+reference uses csi protosanitizer for the same purpose, tracing.go:53-66).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import grpc
+from google.protobuf.message import Message
+
+from oim_tpu.common.logging import from_context
+
+Formatter = Callable[[Any], str]
+
+
+def complete_formatter(msg: Any) -> str:
+    """Log the full payload (reference CompletePayloadFormatter)."""
+    if isinstance(msg, Message):
+        return str(msg).replace("\n", " ").strip() or "<empty>"
+    return repr(msg)
+
+
+def null_formatter(msg: Any) -> str:
+    """Log no payload (reference NullPayloadFormatter)."""
+    return "<hidden>"
+
+
+def strip_secrets(msg: Any) -> str:
+    """Redact fields named 'secret' anywhere in the message tree."""
+    if not isinstance(msg, Message):
+        return repr(msg)
+    clone = type(msg)()
+    clone.CopyFrom(msg)
+    _redact(clone)
+    return str(clone).replace("\n", " ").strip() or "<empty>"
+
+
+def _redact(msg: Message) -> None:
+    for field, value in msg.ListFields():
+        if field.name == "secret" and field.type == field.TYPE_STRING:
+            setattr(msg, field.name, "***stripped***")
+        elif field.type == field.TYPE_MESSAGE:
+            if field.is_repeated:
+                for item in value:
+                    if isinstance(item, Message):
+                        _redact(item)
+            else:
+                _redact(value)
+
+
+class LogServerInterceptor(grpc.ServerInterceptor):
+    """Log request/response around every unary handler (reference
+    LogGRPCServer, tracing.go:101-119)."""
+
+    def __init__(self, formatter: Formatter = strip_secrets):
+        self._fmt = formatter
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if handler is None or not handler.unary_unary:
+            return handler
+        method = handler_call_details.method
+        fmt = self._fmt
+        inner = handler.unary_unary
+
+        def wrapped(request, context):
+            log = from_context()
+            log.debug("handling", method=method, request=fmt(request))
+            try:
+                reply = inner(request, context)
+            except Exception as exc:  # noqa: BLE001 - log then re-raise
+                log.debug("failed", method=method, error=str(exc))
+                raise
+            log.debug("handled", method=method, reply=fmt(reply))
+            return reply
+
+        return grpc.unary_unary_rpc_method_handler(
+            wrapped,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+
+
+class LogClientInterceptor(grpc.UnaryUnaryClientInterceptor):
+    """Log calls on the client side (reference LogGRPCClient,
+    tracing.go:123-141)."""
+
+    def __init__(self, formatter: Formatter = strip_secrets):
+        self._fmt = formatter
+
+    def intercept_unary_unary(self, continuation, client_call_details, request):
+        log = from_context()
+        log.debug(
+            "calling", method=client_call_details.method, request=self._fmt(request)
+        )
+        return continuation(client_call_details, request)

@@ -1,0 +1,119 @@
+"""Structured, leveled logging attached to an ambient context.
+
+The reference attaches the logger itself to context.Context so each request can
+carry a differently-scoped logger (pkg/log/log.go:163-191). The idiomatic Python
+analog is a contextvars.ContextVar: ``with_logger()`` installs a logger for the
+current async/thread context, ``from_context()`` retrieves it (falling back to the
+global logger, log.go:126-137).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import sys
+import threading
+import time
+from typing import Any, Iterator, TextIO
+
+DEBUG, INFO, WARNING, ERROR = 10, 20, 30, 40
+
+_LEVEL_NAMES = {DEBUG: "DEBUG", INFO: "INFO", WARNING: "WARNING", ERROR: "ERROR"}
+_NAME_LEVELS = {v.lower(): k for k, v in _LEVEL_NAMES.items()}
+
+
+def parse_level(name: str) -> int:
+    """Parse a level name ('debug'..'error'), mirroring pkg/log/level/level.go."""
+    try:
+        return _NAME_LEVELS[name.lower()]
+    except KeyError:
+        raise ValueError(f"unknown log level: {name!r}") from None
+
+
+class Logger:
+    """A leveled, structured logger with immutable bound fields.
+
+    ``with_fields`` returns a child logger carrying extra key/value pairs
+    (reference Logger.With, pkg/log/log.go:37-110). Output formatting follows
+    the reference's simple logger: ``<time> <level> <msg> | k: v``
+    (pkg/log/formatter.go:18-30).
+    """
+
+    def __init__(
+        self,
+        output: TextIO | None = None,
+        level: int = INFO,
+        fields: tuple[tuple[str, Any], ...] = (),
+        _lock: threading.Lock | None = None,
+    ):
+        self._output = output if output is not None else sys.stderr
+        self.level = level
+        self._fields = fields
+        self._lock = _lock or threading.Lock()
+
+    def with_fields(self, **fields: Any) -> "Logger":
+        return Logger(
+            self._output,
+            self.level,
+            self._fields + tuple(fields.items()),
+            self._lock,
+        )
+
+    def log(self, level: int, msg: str, **fields: Any) -> None:
+        if level < self.level:
+            return
+        parts = [
+            time.strftime("%Y-%m-%d %H:%M:%S"),
+            _LEVEL_NAMES.get(level, str(level)),
+            msg,
+        ]
+        all_fields = self._fields + tuple(fields.items())
+        if all_fields:
+            parts.append("| " + " ".join(f"{k}: {v!r}" for k, v in all_fields))
+        line = " ".join(parts) + "\n"
+        with self._lock:
+            self._output.write(line)
+
+    def debug(self, msg: str, **fields: Any) -> None:
+        self.log(DEBUG, msg, **fields)
+
+    def info(self, msg: str, **fields: Any) -> None:
+        self.log(INFO, msg, **fields)
+
+    def warning(self, msg: str, **fields: Any) -> None:
+        self.log(WARNING, msg, **fields)
+
+    def error(self, msg: str, **fields: Any) -> None:
+        self.log(ERROR, msg, **fields)
+
+
+_global = Logger()
+_ctx_logger: contextvars.ContextVar[Logger | None] = contextvars.ContextVar(
+    "oim_logger", default=None
+)
+
+
+def set_global(logger: Logger) -> Logger:
+    """Install the process-global fallback logger; returns the previous one."""
+    global _global
+    prev, _global = _global, logger
+    return prev
+
+
+def get_global() -> Logger:
+    return _global
+
+
+def from_context() -> Logger:
+    """The logger attached to the current context, else the global one."""
+    return _ctx_logger.get() or _global
+
+
+@contextlib.contextmanager
+def with_logger(logger: Logger) -> Iterator[Logger]:
+    """Attach ``logger`` to the current context for the duration of the block."""
+    token = _ctx_logger.set(logger)
+    try:
+        yield logger
+    finally:
+        _ctx_logger.reset(token)

@@ -1,0 +1,79 @@
+"""ICI mesh coordinates with wildcard merging.
+
+The TPU analog of the reference's extended-BDF PCI addresses
+(pkg/oim-common/pci.go): the reference uses 0xFFFF to mean "component unset,
+fill it in from a second source" (pci.go:51-65, spec.md:150-152). Here a chip's
+position in the ICI torus is ``x,y,z[,core]`` and ``-1`` means unset; the feeder
+merges a controller's MapVolume reply with the registry's ``<id>/mesh`` default
+exactly as the reference merges PCI addresses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from oim_tpu.spec import pb
+
+UNSET = -1
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshCoord:
+    x: int = UNSET
+    y: int = UNSET
+    z: int = UNSET
+    core: int = UNSET
+
+    @classmethod
+    def parse(cls, s: str) -> "MeshCoord":
+        """Parse 'x,y,z[,core]'; '*' or '' for unset components.
+
+        Mirrors ParseBDFString (pci.go:36-47) in spirit: strict format,
+        explicit wildcard.
+        """
+        if not s:
+            return cls()
+        parts = s.split(",")
+        if len(parts) not in (3, 4):
+            raise ValueError(f"mesh coordinate must be x,y,z[,core]: {s!r}")
+        vals = []
+        for p in parts:
+            p = p.strip()
+            if p in ("*", ""):
+                vals.append(UNSET)
+            else:
+                v = int(p)
+                if v < 0:
+                    raise ValueError(f"negative mesh coordinate component: {s!r}")
+                vals.append(v)
+        while len(vals) < 4:
+            vals.append(UNSET)
+        return cls(*vals)
+
+    def format(self) -> str:
+        """Canonical string form ('*' for unset), reference PrettyPCIAddress
+        (pci.go:68-90)."""
+        comps = [self.x, self.y, self.z]
+        if self.core != UNSET:
+            comps.append(self.core)
+        return ",".join("*" if c == UNSET else str(c) for c in comps)
+
+    def complete(self, default: "MeshCoord") -> "MeshCoord":
+        """Fill unset components from ``default`` (reference
+        CompletePCIAddress, pci.go:51-65)."""
+        return MeshCoord(
+            self.x if self.x != UNSET else default.x,
+            self.y if self.y != UNSET else default.y,
+            self.z if self.z != UNSET else default.z,
+            self.core if self.core != UNSET else default.core,
+        )
+
+    def is_complete(self) -> bool:
+        return UNSET not in (self.x, self.y, self.z)
+
+    def to_proto(self) -> pb.MeshCoordinate:
+        return pb.MeshCoordinate(x=self.x, y=self.y, z=self.z, core=self.core)
+
+    @classmethod
+    def from_proto(cls, m: pb.MeshCoordinate) -> "MeshCoord":
+        return cls(m.x, m.y, m.z, m.core)

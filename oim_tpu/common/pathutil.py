@@ -1,0 +1,29 @@
+"""Registry path helpers (reference pkg/oim-common/path.go).
+
+Registry keys are ``/``-separated paths; components must not be empty, ``.``,
+or ``..``. Well-known per-controller keys: ``<id>/address`` (DCN gRPC address)
+and ``<id>/mesh`` (ICI mesh coordinate — the TPU analog of the reference's
+``<id>/pci`` key, path.go:15-21).
+"""
+
+from __future__ import annotations
+
+# Well-known registry key components.
+REGISTRY_ADDRESS = "address"
+REGISTRY_MESH = "mesh"
+
+
+def split_registry_path(path: str) -> list[str]:
+    """Split and validate a registry path (reference path.go:25-33)."""
+    parts = path.split("/")
+    for part in parts:
+        if part in ("", ".", ".."):
+            raise ValueError(f"invalid registry path: {path!r}")
+    return parts
+
+
+def join_registry_path(parts: list[str] | tuple[str, ...]) -> str:
+    """Canonical join; validates components (reference path.go:35-38)."""
+    path = "/".join(parts)
+    split_registry_path(path)
+    return path

@@ -1,0 +1,12 @@
+"""The per-host TPU controller (reference pkg/oim-controller, SURVEY.md 2.5).
+
+The controller owns staged device arrays: in production it is embedded in the
+trainer process (the JAX runtime is the data plane, the way SPDK owns the
+vhost-user shared memory in the reference), and its gRPC service is the
+control-plane face other components reach through the registry proxy.
+"""
+
+from oim_tpu.controller.backend import StageState, StagedVolume, StagingBackend  # noqa: F401
+from oim_tpu.controller.malloc_backend import MallocBackend  # noqa: F401
+from oim_tpu.controller.tpu_backend import TPUBackend  # noqa: F401
+from oim_tpu.controller.controller import Controller, ControllerService, controller_server  # noqa: F401

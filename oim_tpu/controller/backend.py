@@ -1,0 +1,133 @@
+"""Staging-backend abstraction (the reference's bdev layer, pkg/spdk/spdk.go).
+
+A backend stages a data source into its memory domain (host RAM for
+MallocBackend, device HBM for TPUBackend) asynchronously: ``stage`` returns a
+``StagedVolume`` immediately and a background thread fills it; consumers poll
+``StageState`` (the TPU analog of waiting for the kernel block device to
+appear, reference nodeserver.go:325-366).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+import time
+from typing import Any, Protocol
+
+import numpy as np
+
+from oim_tpu.spec import pb
+
+
+class StageState(enum.Enum):
+    STAGING = "staging"
+    READY = "ready"
+    FAILED = "failed"
+
+
+@dataclasses.dataclass
+class StagedVolume:
+    """Tracks one staged volume; thread-safe via the embedded condition."""
+
+    volume_id: str
+    params_key: bytes  # serialized request params, the idempotency fingerprint
+    spec: Any  # pb.ArraySpec
+    state: StageState = StageState.STAGING
+    error: str = ""
+    cancelled: bool = False  # set by unstage; stager frees device memory itself
+    bytes_staged: int = 0
+    total_bytes: int = 0
+    started_at: float = dataclasses.field(default_factory=time.monotonic)
+    finished_at: float = 0.0
+    device_id: int = -1
+    array: Any = None  # np.ndarray (malloc) or jax.Array (tpu)
+    cond: threading.Condition = dataclasses.field(default_factory=threading.Condition)
+
+    def mark_ready(self, array: Any, nbytes: int, device_id: int = -1) -> bool:
+        """Returns False if the volume was unmapped while staging ran — the
+        caller (the staging thread) must then free the array itself, so a
+        racing UnmapVolume can never strand device memory."""
+        with self.cond:
+            if self.cancelled:
+                self.finished_at = time.monotonic()
+                self.state = StageState.FAILED
+                self.error = "unmapped during staging"
+                self.cond.notify_all()
+                return False
+            self.array = array
+            self.bytes_staged = nbytes
+            self.total_bytes = nbytes
+            self.device_id = device_id
+            self.finished_at = time.monotonic()
+            self.state = StageState.READY
+            self.cond.notify_all()
+            return True
+
+    def mark_failed(self, error: str) -> None:
+        with self.cond:
+            self.error = error
+            self.finished_at = time.monotonic()
+            self.state = StageState.FAILED
+            self.cond.notify_all()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until staging finished (ready or failed); False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self.cond:
+            while self.state == StageState.STAGING:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self.cond.wait(remaining)
+        return True
+
+    @property
+    def gbps(self) -> float:
+        end = self.finished_at or time.monotonic()
+        elapsed = max(end - self.started_at, 1e-9)
+        return self.bytes_staged / elapsed / 1e9
+
+    def status_proto(self) -> pb.StageStatusReply:
+        return pb.StageStatusReply(
+            ready=self.state == StageState.READY,
+            bytes_staged=self.bytes_staged,
+            gbps=self.gbps,
+            error=self.error,
+        )
+
+
+def spec_dtype(spec) -> np.dtype:
+    """numpy dtype for an ArraySpec; bfloat16 via ml_dtypes."""
+    name = spec.dtype or "uint8"
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def reshape_to_spec(data: np.ndarray, spec) -> np.ndarray:
+    """View host data as the requested dtype/shape; -1 dims inferred.
+
+    An empty dtype keeps the source's own dtype (so e.g. .npy files carry
+    their type through); an empty shape keeps the source's shape.
+    """
+    dtype = spec_dtype(spec) if spec.dtype else data.dtype
+    flat = data.reshape(-1).view(np.uint8).view(dtype) if data.dtype != dtype else data
+    shape = tuple(int(d) for d in spec.shape) or flat.shape
+    return flat.reshape(shape)
+
+
+class StagingBackend(Protocol):
+    """What a controller needs from its memory domain."""
+
+    def provision(self, name: str, size: int) -> None: ...
+
+    def check(self, name: str) -> bool: ...
+
+    def stage(self, volume: StagedVolume, params_kind: str, params: Any) -> None:
+        """Start staging asynchronously; fill ``volume`` when done."""
+        ...
+
+    def unstage(self, volume: StagedVolume) -> None: ...

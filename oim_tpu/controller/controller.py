@@ -1,0 +1,234 @@
+"""Controller service + lifecycle (reference pkg/oim-controller/controller.go).
+
+* ``ControllerService`` implements the oim.v1.Controller RPCs with per-volume
+  keyed locking (controller.go:44-51) and strict idempotency: re-mapping an
+  existing volume with identical params returns the existing placement
+  (controller.go:96-125); unmapping an unknown volume succeeds
+  (controller.go:202-209).
+* ``Controller`` wraps the service with the self-registration loop
+  (controller.go:411-476): a background thread that (re-)registers
+  ``<id>/address`` and ``<id>/mesh`` into the registry immediately and then
+  every ``registry_delay`` seconds, dialing a fresh channel each attempt so a
+  restarted registry recovers its soft-state DB (README.md:138-143).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import grpc
+
+from oim_tpu.common.keymutex import KeyMutex
+from oim_tpu.common.logging import from_context
+from oim_tpu.common.meshcoord import MeshCoord
+from oim_tpu.common.pathutil import REGISTRY_ADDRESS, REGISTRY_MESH
+from oim_tpu.common.server import NonBlockingGRPCServer
+from oim_tpu.common.interceptors import LogServerInterceptor
+from oim_tpu.common.tlsutil import TLSConfig, dial
+from oim_tpu.controller.backend import StagedVolume, StageState, StagingBackend
+from oim_tpu.spec import ControllerServicer, RegistryStub, add_controller_to_server, pb
+
+
+class ControllerService(ControllerServicer):
+    def __init__(self, backend: StagingBackend):
+        self.backend = backend
+        self._volumes: dict[str, StagedVolume] = {}
+        self._vol_lock = threading.Lock()
+        self._keymutex = KeyMutex()
+
+    # -- helpers ----------------------------------------------------------
+
+    def get_volume(self, volume_id: str) -> StagedVolume | None:
+        with self._vol_lock:
+            return self._volumes.get(volume_id)
+
+    def _placement(self, volume: StagedVolume) -> pb.MapVolumeReply:
+        coord = MeshCoord()
+        coord_of = getattr(self.backend, "coord_of", None)
+        if coord_of is not None:
+            coord = coord_of(volume)
+        return pb.MapVolumeReply(
+            placement=pb.HBMPlacement(
+                coordinate=coord.to_proto(),
+                device_id=volume.device_id,
+                bytes=volume.bytes_staged,
+            ),
+            spec=volume.spec,
+            buffer_handle=volume.volume_id,
+        )
+
+    # -- RPCs -------------------------------------------------------------
+
+    def MapVolume(self, request, context):
+        if not request.volume_id:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "empty volume_id")
+        params_kind = request.WhichOneof("params")
+        if not params_kind:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "no volume params")
+        params_key = request.SerializeToString(deterministic=True)
+        with self._keymutex.locked(request.volume_id):
+            existing = self.get_volume(request.volume_id)
+            if existing is not None:
+                if existing.params_key != params_key:
+                    context.abort(
+                        grpc.StatusCode.ALREADY_EXISTS,
+                        f"volume {request.volume_id!r} mapped with different params",
+                    )
+                if existing.state != StageState.FAILED:
+                    return self._placement(existing)
+                # A FAILED volume must not poison its volume_id: evict it and
+                # fall through to a fresh staging attempt, so retries can
+                # succeed once the underlying fault clears.
+                with self._vol_lock:
+                    self._volumes.pop(request.volume_id, None)
+                self.backend.unstage(existing)
+            volume = StagedVolume(
+                volume_id=request.volume_id,
+                params_key=params_key,
+                spec=request.spec,
+            )
+            with self._vol_lock:
+                self._volumes[request.volume_id] = volume
+            self.backend.stage(volume, params_kind, getattr(request, params_kind))
+            from_context().info(
+                "mapping volume", volume=request.volume_id, kind=params_kind
+            )
+            return self._placement(volume)
+
+    def UnmapVolume(self, request, context):
+        if not request.volume_id:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "empty volume_id")
+        with self._keymutex.locked(request.volume_id):
+            with self._vol_lock:
+                volume = self._volumes.pop(request.volume_id, None)
+            if volume is not None:
+                # unstage is race-free against an in-flight stager: it sets
+                # volume.cancelled under the condition lock and the stager
+                # frees its own array if it loses the race (mark_ready=False).
+                self.backend.unstage(volume)
+                from_context().info("unmapped volume", volume=request.volume_id)
+            return pb.UnmapVolumeReply()
+
+    def ProvisionMallocBDev(self, request, context):
+        if not request.bdev_name:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "empty bdev_name")
+        if request.size < 0:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "negative size")
+        with self._keymutex.locked(request.bdev_name):
+            try:
+                self.backend.provision(request.bdev_name, request.size)
+            except ValueError as err:
+                context.abort(grpc.StatusCode.ALREADY_EXISTS, str(err))
+            return pb.ProvisionMallocBDevReply()
+
+    def CheckMallocBDev(self, request, context):
+        if not request.bdev_name:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "empty bdev_name")
+        if not self.backend.check(request.bdev_name):
+            context.abort(
+                grpc.StatusCode.NOT_FOUND, f"no bdev {request.bdev_name!r}"
+            )
+        return pb.CheckMallocBDevReply()
+
+    def StageStatus(self, request, context):
+        volume = self.get_volume(request.volume_id)
+        if volume is None:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND, f"no volume {request.volume_id!r}"
+            )
+        return volume.status_proto()
+
+
+class Controller:
+    """Service + registration loop + server wiring (controller.go:379-495)."""
+
+    def __init__(
+        self,
+        controller_id: str,
+        backend: StagingBackend,
+        controller_address: str = "",
+        registry_address: str = "",
+        registry_delay: float = 60.0,
+        mesh_coord: MeshCoord | None = None,
+        tls: TLSConfig | None = None,
+    ):
+        if registry_address and not controller_address:
+            raise ValueError("registration requires a controller address")
+        self.controller_id = controller_id
+        self.service = ControllerService(backend)
+        self.controller_address = controller_address
+        self.registry_address = registry_address
+        self.registry_delay = registry_delay
+        self.mesh_coord = mesh_coord
+        self.tls = tls
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- registration loop ------------------------------------------------
+
+    def register_once(self) -> None:
+        """One registration attempt over a fresh channel
+        (controller.go:448-468)."""
+        channel = dial(self.registry_address, self.tls, "component.registry")
+        try:
+            stub = RegistryStub(channel)
+            stub.SetValue(
+                pb.SetValueRequest(
+                    value=pb.Value(
+                        path=f"{self.controller_id}/{REGISTRY_ADDRESS}",
+                        value=self.controller_address,
+                    )
+                ),
+                timeout=10.0,
+            )
+            if self.mesh_coord is not None:
+                stub.SetValue(
+                    pb.SetValueRequest(
+                        value=pb.Value(
+                            path=f"{self.controller_id}/{REGISTRY_MESH}",
+                            value=self.mesh_coord.format(),
+                        )
+                    ),
+                    timeout=10.0,
+                )
+        finally:
+            channel.close()
+
+    def start(self) -> None:
+        """Begin periodic self-registration (controller.go:411-446)."""
+        if not self.registry_address:
+            return
+
+        def loop() -> None:
+            log = from_context().with_fields(controller=self.controller_id)
+            while not self._stop.is_set():
+                try:
+                    self.register_once()
+                    log.debug("registered", registry=self.registry_address)
+                except grpc.RpcError as err:
+                    log.warning(
+                        "registration failed", error=err.details() or str(err.code())
+                    )
+                if self._stop.wait(self.registry_delay):
+                    return
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+def controller_server(
+    endpoint: str, service: ControllerService, tls: TLSConfig | None = None
+) -> NonBlockingGRPCServer:
+    """Serve a controller (controller.go:479-495); also used by tests to serve
+    mocks."""
+    server = NonBlockingGRPCServer(
+        endpoint, tls=tls, interceptors=(LogServerInterceptor(),)
+    )
+    server.start(lambda s: add_controller_to_server(service, s))
+    return server

@@ -1,0 +1,73 @@
+"""Host-RAM staging backend (the reference's Malloc BDev,
+pkg/oim-controller/controller.go:215-256 + pkg/spdk ConstructMallocBDev).
+
+Fully functional without TPU hardware; the backend for ring-0 tests and
+BASELINE config 1. Buffers are named host arrays; ``MapVolume`` with
+``MallocParams`` stages the buffer named by the volume id, other params load
+their source into host memory.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+from oim_tpu.controller.backend import StagedVolume, reshape_to_spec
+from oim_tpu.controller.source import load_source
+
+
+class MallocBackend:
+    def __init__(self) -> None:
+        self._buffers: dict[str, np.ndarray] = {}
+        self._lock = threading.Lock()
+
+    # -- named buffers ----------------------------------------------------
+
+    def provision(self, name: str, size: int) -> None:
+        with self._lock:
+            existing = self._buffers.get(name)
+            if size == 0:
+                self._buffers.pop(name, None)
+                return
+            if existing is not None:
+                if existing.nbytes != size:
+                    raise ValueError(
+                        f"buffer {name!r} exists with size {existing.nbytes}, "
+                        f"requested {size}"
+                    )
+                return
+            self._buffers[name] = np.zeros(size, dtype=np.uint8)
+
+    def check(self, name: str) -> bool:
+        with self._lock:
+            return name in self._buffers
+
+    def buffer(self, name: str) -> np.ndarray:
+        with self._lock:
+            buf = self._buffers.get(name)
+        if buf is None:
+            raise KeyError(f"no malloc buffer {name!r}")
+        return buf
+
+    # -- staging ----------------------------------------------------------
+
+    def stage(self, volume: StagedVolume, params_kind: str, params: Any) -> None:
+        def work() -> None:
+            try:
+                if params_kind == "malloc":
+                    host = self.buffer(volume.volume_id)
+                else:
+                    host = load_source(params_kind, params)
+                array = reshape_to_spec(np.asarray(host), volume.spec)
+                volume.mark_ready(array, array.nbytes)
+            except Exception as exc:  # noqa: BLE001 - reported via StageStatus
+                volume.mark_failed(str(exc))
+
+        threading.Thread(target=work, daemon=True).start()
+
+    def unstage(self, volume: StagedVolume) -> None:
+        with volume.cond:
+            volume.cancelled = True
+            volume.array = None

@@ -1,0 +1,35 @@
+"""Source loading shared by staging backends: turn MapVolume params into a
+host numpy array (the role of SPDK's bdev constructors,
+pkg/spdk/spdk.go:16-104)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from oim_tpu.data import readers
+
+
+def load_source(params_kind: str, params: Any) -> np.ndarray:
+    if params_kind == "file":
+        fmt = params.format or "raw"
+        if fmt == "npy":
+            return readers.read_npy(params.path)
+        if fmt == "raw":
+            return np.frombuffer(readers.read_raw(params.path), dtype=np.uint8)
+        raise ValueError(f"unknown file format {fmt!r}")
+    if params_kind == "tfrecord":
+        return readers.read_tfrecord_batch(list(params.paths))
+    if params_kind == "webdataset":
+        # WebDataset shards are tar files; for local paths we treat each shard
+        # as opaque bytes concatenated in order (decode happens in the input
+        # pipeline, not the staging path).
+        chunks = [readers.read_raw(u) for u in params.shard_urls]
+        return np.frombuffer(b"".join(chunks), dtype=np.uint8)
+    if params_kind == "ceph":
+        # Reference parity (ceph-csi.go): requires a cluster; surfaced as a
+        # staging error rather than a protocol error so callers see it in
+        # StageStatus.
+        raise ValueError("ceph source requires a reachable cluster (not configured)")
+    raise ValueError(f"unknown params kind {params_kind!r}")

@@ -1,0 +1,110 @@
+"""TPU staging backend: host memory -> device HBM as jax.Arrays.
+
+The data-plane half of the controller (the role SPDK's vhost daemon plays in
+the reference, SURVEY.md section 2.8): sources are read into host buffers
+(through the C++ staging engine when built, oim_tpu/data/staging.py) and
+DMA'd into HBM with ``jax.device_put`` — asynchronously, so MapVolume returns
+immediately and StageStatus/feeder-wait reports materialization (the TPU
+analog of waiting for the kernel block device, nodeserver.go:325-366).
+
+Sharded placement: when the ArraySpec names mesh axes, the array is put with a
+``NamedSharding`` over the backend's mesh, so one MapVolume can scatter a
+global array across every chip of a slice in a single call.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+from oim_tpu.common.meshcoord import MeshCoord
+from oim_tpu.controller.backend import StagedVolume, reshape_to_spec
+from oim_tpu.controller.malloc_backend import MallocBackend
+
+
+def device_mesh_coord(device) -> MeshCoord:
+    """ICI coordinate of a jax device; UNSET components off-TPU."""
+    coords = getattr(device, "coords", None)
+    if coords is None:
+        return MeshCoord()
+    core = getattr(device, "core_on_chip", -1)
+    xyz = tuple(coords) + (0,) * (3 - len(coords))
+    return MeshCoord(xyz[0], xyz[1], xyz[2], core)
+
+
+class TPUBackend(MallocBackend):
+    """Extends MallocBackend (named host buffers still work) with device
+    placement."""
+
+    def __init__(self, mesh=None, devices=None):
+        super().__init__()
+        import jax
+
+        self._jax = jax
+        self.mesh = mesh
+        self.devices = list(devices) if devices is not None else jax.local_devices()
+        self._next_device = 0
+        self._device_lock = threading.Lock()
+
+    def _pick_device(self):
+        """Round-robin across local devices (the analog of the reference's
+        first-free-SCSI-target scan, controller.go:131-148)."""
+        with self._device_lock:
+            dev = self.devices[self._next_device % len(self.devices)]
+            self._next_device += 1
+            return dev
+
+    def _sharding_for(self, spec):
+        axes = [a or None for a in spec.sharding_axes]
+        if any(axes):
+            if self.mesh is None:
+                # Never silently collapse a requested sharding onto one chip:
+                # that either OOMs the chip or trains on misplaced data.
+                raise ValueError(
+                    f"spec requests sharding over axes {spec.sharding_axes} "
+                    "but this controller has no mesh configured"
+                )
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            return NamedSharding(self.mesh, PartitionSpec(*axes))
+        from jax.sharding import SingleDeviceSharding
+
+        return SingleDeviceSharding(self._pick_device())
+
+    def stage(self, volume: StagedVolume, params_kind: str, params: Any) -> None:
+        def work() -> None:
+            try:
+                if params_kind == "malloc":
+                    host = self.buffer(volume.volume_id)
+                else:
+                    from oim_tpu.controller.source import load_source
+
+                    host = load_source(params_kind, params)
+                host = reshape_to_spec(np.asarray(host), volume.spec)
+                sharding = self._sharding_for(volume.spec)
+                arr = self._jax.device_put(host, sharding)
+                arr.block_until_ready()
+                dev_ids = sorted(d.id for d in arr.sharding.device_set)
+                if not volume.mark_ready(arr, arr.nbytes, device_id=dev_ids[0]):
+                    arr.delete()  # unmapped while we were staging
+            except Exception as exc:  # noqa: BLE001 - reported via StageStatus
+                volume.mark_failed(str(exc))
+
+        threading.Thread(target=work, daemon=True).start()
+
+    def unstage(self, volume: StagedVolume) -> None:
+        with volume.cond:
+            volume.cancelled = True  # in-flight stager frees its own array
+            arr, volume.array = volume.array, None
+        if arr is not None and hasattr(arr, "delete"):
+            arr.delete()  # free HBM eagerly; leaks here are device OOM
+
+    def coord_of(self, volume: StagedVolume) -> MeshCoord:
+        if volume.device_id < 0:
+            return MeshCoord()
+        for d in self.devices:
+            if d.id == volume.device_id:
+                return device_mesh_coord(d)
+        return MeshCoord()

@@ -1,0 +1,6 @@
+"""Data sources and host->HBM staging.
+
+``readers`` parse on-disk formats (raw, npy, TFRecord) into host arrays;
+``staging`` drives the C++ staging engine (native/staging.cc — the SPDK-daemon
+role, SURVEY.md section 2.8) with a pure-python fallback.
+"""

@@ -1,0 +1,72 @@
+"""Shard-source readers: raw files, .npy, TFRecord.
+
+These fill the role of SPDK's bdev modules (the reference's pluggable block
+backends — malloc, RBD, ...; SURVEY.md section 2.8): a reader turns a source
+descriptor into host-memory bytes ready for DMA into HBM. The TFRecord framing
+is parsed directly (length/crc framing per the TFRecord spec) so the hot path
+does not depend on TensorFlow.
+"""
+
+from __future__ import annotations
+
+import struct
+from pathlib import Path
+from typing import Iterator
+
+import numpy as np
+
+
+def read_raw(path: str | Path) -> bytes:
+    return Path(path).read_bytes()
+
+
+def read_npy(path: str | Path) -> np.ndarray:
+    return np.load(str(path), allow_pickle=False)
+
+
+def iter_tfrecords(path: str | Path) -> Iterator[bytes]:
+    """Iterate records in a TFRecord file.
+
+    Framing: uint64 length, uint32 masked-crc(length), payload, uint32
+    masked-crc(payload). CRCs are not verified on the hot path (integrity is
+    the storage system's job, matching the reference's stance of trusting the
+    block layer).
+    """
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if not header:
+                return
+            if len(header) < 12:
+                raise IOError(f"truncated TFRecord header in {path}")
+            (length,) = struct.unpack("<Q", header[:8])
+            payload = f.read(length)
+            if len(payload) < length:
+                raise IOError(f"truncated TFRecord payload in {path}")
+            f.read(4)  # payload crc
+            yield payload
+
+
+def write_tfrecords(path: str | Path, records: list[bytes]) -> None:
+    """Write a TFRecord file (tests + benchmarks); masked crc32c of the
+    spec is filled with zeros, which readers here do not verify."""
+    with open(path, "wb") as f:
+        for rec in records:
+            f.write(struct.pack("<Q", len(rec)))
+            f.write(b"\0\0\0\0")
+            f.write(rec)
+            f.write(b"\0\0\0\0")
+
+
+def read_tfrecord_batch(paths: list[str], record_bytes: int | None = None) -> np.ndarray:
+    """Read all records across ``paths`` into a [num_records, record_bytes]
+    uint8 array (fixed-size records), or a flat uint8 array when sizes vary."""
+    records = [rec for p in paths for rec in iter_tfrecords(p)]
+    if not records:
+        return np.zeros((0,), dtype=np.uint8)
+    sizes = {len(r) for r in records}
+    if len(sizes) == 1 and (record_bytes is None or sizes == {record_bytes}):
+        return np.frombuffer(b"".join(records), dtype=np.uint8).reshape(
+            len(records), -1
+        )
+    return np.frombuffer(b"".join(records), dtype=np.uint8)

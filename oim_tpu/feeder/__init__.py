@@ -1,0 +1,15 @@
+"""The feeder: publishes staged HBM shards to consumers.
+
+TPU-native counterpart of the reference's CSI driver (pkg/oim-csi-driver,
+SURVEY.md 2.6): "publish" makes a staged volume visible to the training
+process — NodePublishVolume becomes MapVolume-through-the-registry-proxy plus
+wait-for-materialization (the waitForDevice analog), and "mount" degenerates to
+jax.Array handle passing because the trainer process owns the JAX runtime.
+"""
+
+from oim_tpu.feeder.driver import Feeder, PublishedVolume  # noqa: F401
+from oim_tpu.feeder.emulation import (  # noqa: F401
+    emulations,
+    map_volume_params,
+    register_emulation,
+)

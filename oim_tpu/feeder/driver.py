@@ -1,0 +1,259 @@
+"""Feeder driver: the NodePublishVolume path, TPU-style.
+
+Reference flow (pkg/oim-csi-driver/nodeserver.go:76-310): lock by volume name,
+idempotency check, read the controller's default PCI address from the registry,
+MapVolume through the registry proxy with ``controllerid`` metadata, merge the
+returned PCI address with the registry default, wait for the kernel block
+device, mount. Here: lock, idempotency check, read the ``<id>/mesh`` default,
+MapVolume (direct in local mode, through the proxy in remote mode), merge mesh
+coordinates, wait for HBM materialization via StageStatus, and hand back the
+staged array handle.
+
+Two mutually exclusive modes, validated at construction like the reference's
+``New`` (oim-driver.go:174-184): **local** (an in-process ControllerService —
+the SPDK-socket mode analog, and the production trainer configuration where
+controller and trainer share the JAX runtime) and **remote** (registry address
++ controller ID + TLS — data lands in the remote controller's runtime; the
+feeder sees placement metadata and polls readiness).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Mapping
+
+import grpc
+
+from oim_tpu.common.keymutex import KeyMutex
+from oim_tpu.common.logging import from_context
+from oim_tpu.common.meshcoord import MeshCoord
+from oim_tpu.common.pathutil import REGISTRY_MESH
+from oim_tpu.common.tlsutil import TLSConfig, dial
+from oim_tpu.controller.controller import ControllerService
+from oim_tpu.feeder.emulation import map_volume_params
+from oim_tpu.registry.registry import CONTROLLER_ID_META
+from oim_tpu.spec import ControllerStub, RegistryStub, pb
+
+
+class PublishError(Exception):
+    pass
+
+
+class DeadlineExceeded(PublishError):
+    """Staging did not materialize before the deadline (the analog of the
+    reference's device-wait hitting its context deadline,
+    nodeserver.go:348-351)."""
+
+
+@dataclasses.dataclass
+class PublishedVolume:
+    volume_id: str
+    coordinate: MeshCoord
+    device_id: int
+    bytes: int
+    handle: str
+    array: Any = None  # populated in local mode
+
+
+class Feeder:
+    def __init__(
+        self,
+        controller: ControllerService | None = None,
+        registry_address: str = "",
+        controller_id: str = "",
+        tls: TLSConfig | None = None,
+    ):
+        local = controller is not None
+        remote = bool(registry_address or controller_id)
+        if local == remote:
+            raise ValueError(
+                "exactly one of local (controller=) or remote "
+                "(registry_address= + controller_id=) mode required"
+            )
+        if remote and not (registry_address and controller_id):
+            raise ValueError("remote mode needs registry_address and controller_id")
+        self.controller = controller
+        self.registry_address = registry_address
+        self.controller_id = controller_id
+        self.tls = tls
+        self._published: dict[str, PublishedVolume] = {}
+        self._lock = threading.Lock()
+        self._keymutex = KeyMutex()
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _registry_channel(self) -> grpc.Channel:
+        """Fresh dial per operation (reference DialRegistry,
+        oim-driver.go:219-232)."""
+        return dial(self.registry_address, self.tls, "component.registry")
+
+    def _default_mesh(self, registry: RegistryStub) -> MeshCoord:
+        reply = registry.GetValues(
+            pb.GetValuesRequest(path=f"{self.controller_id}/{REGISTRY_MESH}"),
+            timeout=10.0,
+        )
+        for value in reply.values:
+            try:
+                return MeshCoord.parse(value.value)
+            except ValueError:
+                pass
+        return MeshCoord()
+
+    class _LocalContext:
+        """Adapts grpc abort() to exceptions for in-process calls."""
+
+        def abort(self, code, details):
+            raise PublishError(f"{code.name}: {details}")
+
+    # -- the NodePublishVolume analog --------------------------------------
+
+    def publish(
+        self,
+        request: pb.MapVolumeRequest,
+        timeout: float = 30.0,
+    ) -> PublishedVolume:
+        if not request.volume_id:
+            raise PublishError("empty volume_id")
+        with self._keymutex.locked(request.volume_id):
+            existing = self._published.get(request.volume_id)
+            if existing is not None:
+                # Idempotency: already published (nodeserver.go:95-109).
+                return existing
+            deadline = time.monotonic() + timeout
+            if self.controller is not None:
+                published = self._publish_local(request, deadline)
+            else:
+                published = self._publish_remote(request, deadline)
+            with self._lock:
+                self._published[request.volume_id] = published
+            from_context().info(
+                "published volume",
+                volume=request.volume_id,
+                coord=published.coordinate.format(),
+                bytes=published.bytes,
+            )
+            return published
+
+    def publish_emulated(
+        self,
+        emulate: str,
+        volume_id: str,
+        attributes: Mapping[str, str],
+        secrets: Mapping[str, str] | None = None,
+        timeout: float = 30.0,
+    ) -> PublishedVolume:
+        """Publish via an emulation personality (reference --emulate flow,
+        nodeserver.go:239-247)."""
+        return self.publish(
+            map_volume_params(emulate, volume_id, attributes, secrets), timeout
+        )
+
+    def _publish_local(self, request, deadline) -> PublishedVolume:
+        reply = self.controller.MapVolume(request, self._LocalContext())
+        volume = self.controller.get_volume(request.volume_id)
+        if volume is None:
+            # Concurrently unmapped between MapVolume and here.
+            raise PublishError(f"volume {request.volume_id!r} vanished during publish")
+        if not volume.wait(timeout=deadline - time.monotonic()):
+            raise DeadlineExceeded(f"staging {request.volume_id!r} timed out")
+        if volume.error:
+            raise PublishError(volume.error)
+        reply = self.controller.MapVolume(request, self._LocalContext())
+        coord = MeshCoord.from_proto(reply.placement.coordinate)
+        return PublishedVolume(
+            volume_id=request.volume_id,
+            coordinate=coord,
+            device_id=reply.placement.device_id,
+            bytes=reply.placement.bytes,
+            handle=reply.buffer_handle,
+            array=volume.array,
+        )
+
+    def _publish_remote(self, request, deadline) -> PublishedVolume:
+        channel = self._registry_channel()
+        try:
+            registry = RegistryStub(channel)
+            default_coord = self._default_mesh(registry)
+            # The proxy routes Controller methods by metadata
+            # (nodeserver.go:230-251).
+            stub = ControllerStub(channel)
+            metadata = [(CONTROLLER_ID_META, self.controller_id)]
+            try:
+                reply = stub.MapVolume(
+                    request,
+                    metadata=metadata,
+                    timeout=deadline - time.monotonic(),
+                )
+                # Wait for materialization (the waitForDevice analog,
+                # nodeserver.go:325-366): poll StageStatus until ready. Every
+                # RPC is bounded by the caller's remaining deadline.
+                def remaining() -> float:
+                    rem = deadline - time.monotonic()
+                    if rem <= 0:
+                        raise DeadlineExceeded(
+                            f"staging {request.volume_id!r} timed out"
+                        )
+                    return rem
+
+                while True:
+                    status = stub.StageStatus(
+                        pb.StageStatusRequest(volume_id=request.volume_id),
+                        metadata=metadata,
+                        timeout=remaining(),
+                    )
+                    if status.error:
+                        raise PublishError(status.error)
+                    if status.ready:
+                        break
+                    time.sleep(min(0.05, remaining()))
+                reply = stub.MapVolume(
+                    request, metadata=metadata, timeout=remaining()
+                )  # refresh placement with final byte count
+            except grpc.RpcError as err:
+                if err.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
+                    raise DeadlineExceeded(err.details()) from err
+                raise PublishError(
+                    f"{err.code().name}: {err.details()}"
+                ) from err
+            # Merge returned coordinate with the registry default, exactly
+            # CompletePCIAddress (nodeserver.go:253-273, pci.go:51-65).
+            coord = MeshCoord.from_proto(reply.placement.coordinate).complete(
+                default_coord
+            )
+            return PublishedVolume(
+                volume_id=request.volume_id,
+                coordinate=coord,
+                device_id=reply.placement.device_id,
+                bytes=reply.placement.bytes,
+                handle=reply.buffer_handle,
+            )
+        finally:
+            channel.close()
+
+    # -- unpublish ---------------------------------------------------------
+
+    def unpublish(self, volume_id: str) -> None:
+        """Idempotent unpublish (reference NodeUnpublishVolume,
+        nodeserver.go:451-515)."""
+        with self._keymutex.locked(volume_id):
+            with self._lock:
+                self._published.pop(volume_id, None)
+            if self.controller is not None:
+                self.controller.UnmapVolume(
+                    pb.UnmapVolumeRequest(volume_id=volume_id), self._LocalContext()
+                )
+                return
+            channel = self._registry_channel()
+            try:
+                stub = ControllerStub(channel)
+                stub.UnmapVolume(
+                    pb.UnmapVolumeRequest(volume_id=volume_id),
+                    metadata=[(CONTROLLER_ID_META, self.controller_id)],
+                    timeout=30.0,
+                )
+            except grpc.RpcError as err:
+                raise PublishError(f"{err.code().name}: {err.details()}") from err
+            finally:
+                channel.close()

@@ -1,0 +1,66 @@
+"""Registry database interface + in-memory implementation
+(reference pkg/oim-registry/memdb.go, registry.go:31-51).
+
+The DB is deliberately soft-state: controllers re-register every
+registry_delay, so losing it merely delays topology convergence
+(README.md:138-143). A durable backend can implement the same interface.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Protocol
+
+
+class RegistryDB(Protocol):
+    def get(self, path: str) -> str: ...
+
+    def set(self, path: str, value: str) -> None:
+        """Empty value deletes the key."""
+        ...
+
+    def foreach(self, fn: Callable[[str, str], bool]) -> None:
+        """Call fn(path, value) for each entry until it returns False."""
+        ...
+
+
+class MemRegistryDB:
+    """Mutex-guarded dict (reference memdb.go:15-52)."""
+
+    def __init__(self) -> None:
+        self._data: dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def get(self, path: str) -> str:
+        with self._lock:
+            return self._data.get(path, "")
+
+    def set(self, path: str, value: str) -> None:
+        with self._lock:
+            if value == "":
+                self._data.pop(path, None)
+            else:
+                self._data[path] = value
+
+    def foreach(self, fn: Callable[[str, str], bool]) -> None:
+        with self._lock:
+            items = list(self._data.items())
+        for path, value in items:
+            if not fn(path, value):
+                return
+
+
+def get_registry_entries(db: RegistryDB, prefix: str) -> dict[str, str]:
+    """All entries at or under ``prefix`` (reference GetRegistryEntries,
+    registry.go:44-51); empty prefix returns everything."""
+    parts = prefix.split("/") if prefix else []
+    out: dict[str, str] = {}
+
+    def visit(path: str, value: str) -> bool:
+        elems = path.split("/")
+        if elems[: len(parts)] == parts:
+            out[path] = value
+        return True
+
+    db.foreach(visit)
+    return out

@@ -1,0 +1,208 @@
+"""Registry service: KV store with CommonName authorization + transparent proxy.
+
+Reference: pkg/oim-registry/registry.go. Authorization (registry.go:100-109):
+``user.admin`` may set any key; ``controller.<id>`` may set only its own
+``<id>/address`` and ``<id>/mesh`` keys. (The reference restricts controllers
+to ``<id>/address`` and has the admin seed ``<id>/pci``; here ``<id>/mesh`` is
+self-reported under the same trust already extended to the address key — a
+controller that can redirect its own traffic can equally mis-place itself, so
+this widens no trust boundary. Operators can still override it as admin.)
+
+The transparent proxy (registry.go:149-210): every gRPC method outside
+``oim.v1.Registry`` is forwarded to the controller named in the
+``controllerid`` request metadata. The caller's CN must be ``host.<id>`` for
+that exact controller id; the registry looks up ``<id>/address`` in its DB and
+dials per-call with the far end's identity pinned to ``controller.<id>``
+(ssl_target_name_override), closing the channel when the call completes —
+control connections are short-lived by design (README.md:39-40).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import grpc
+
+from oim_tpu.common.logging import from_context
+from oim_tpu.common.pathutil import (
+    REGISTRY_ADDRESS,
+    REGISTRY_MESH,
+    split_registry_path,
+)
+from oim_tpu.common.server import NonBlockingGRPCServer
+from oim_tpu.common.interceptors import LogServerInterceptor
+from oim_tpu.common.tlsutil import TLSConfig, dial, peer_common_name
+from oim_tpu.registry.db import MemRegistryDB, RegistryDB, get_registry_entries
+from oim_tpu.spec import (
+    REGISTRY_SERVICE,
+    RegistryServicer,
+    add_registry_to_server,
+    pb,
+)
+
+CONTROLLER_ID_META = "controllerid"
+
+
+class RegistryService(RegistryServicer):
+    def __init__(self, db: RegistryDB | None = None, tls: TLSConfig | None = None):
+        self.db: RegistryDB = db if db is not None else MemRegistryDB()
+        self.tls = tls
+
+    # -- authorization ----------------------------------------------------
+
+    def _peer(self, context: grpc.ServicerContext) -> str:
+        """Verified peer CN; empty for insecure servers (test-only)."""
+        if self.tls is None:
+            return "user.admin"  # insecure mode trusts everyone (tests only)
+        cn = peer_common_name(context)
+        if not cn:
+            context.abort(grpc.StatusCode.UNAUTHENTICATED, "no verified peer identity")
+        return cn
+
+    @staticmethod
+    def _may_set(peer: str, path_parts: list[str]) -> bool:
+        """Reference registry.go:100-109, extended with the mesh key."""
+        if peer == "user.admin":
+            return True
+        if peer.startswith("controller."):
+            controller_id = peer[len("controller."):]
+            return (
+                len(path_parts) == 2
+                and path_parts[0] == controller_id
+                and path_parts[1] in (REGISTRY_ADDRESS, REGISTRY_MESH)
+            )
+        return False
+
+    # -- service methods --------------------------------------------------
+
+    def SetValue(self, request, context):
+        peer = self._peer(context)
+        try:
+            parts = split_registry_path(request.value.path)
+        except ValueError as err:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(err))
+        if not self._may_set(peer, parts):
+            context.abort(
+                grpc.StatusCode.PERMISSION_DENIED,
+                f"{peer!r} may not set {request.value.path!r}",
+            )
+        self.db.set(request.value.path, request.value.value)
+        return pb.SetValueReply()
+
+    def GetValues(self, request, context):
+        # Reads need any authenticated identity; prefix-match semantics
+        # (registry.go:129-144).
+        self._peer(context)
+        if request.path:
+            try:
+                split_registry_path(request.path)
+            except ValueError as err:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(err))
+        entries = get_registry_entries(self.db, request.path)
+        return pb.GetValuesReply(
+            values=[pb.Value(path=k, value=v) for k, v in sorted(entries.items())]
+        )
+
+
+_IDENTITY = lambda b: b  # noqa: E731 - bytes pass-through serdes for proxying
+
+
+class TransparentProxy(grpc.GenericRpcHandler):
+    """Forward unknown methods to the controller in `controllerid` metadata.
+
+    The Python analog of grpc.UnknownServiceHandler(proxy.TransparentHandler)
+    + proxy.Codec() (reference registry.go:248-261): a generic handler with
+    identity (bytes) serializers so payloads stream through untouched.
+    """
+
+    def __init__(
+        self,
+        service: RegistryService,
+        dial: Callable[[str, str], grpc.Channel] | None = None,
+    ):
+        self._service = service
+        # dial(address, expected_peer_name) -> channel; overridable for tests.
+        self._dial = dial or self._default_dial
+
+    def _default_dial(self, address: str, peer_name: str) -> grpc.Channel:
+        return dial(address, self._service.tls, peer_name)
+
+    def service(self, handler_call_details):
+        method = handler_call_details.method
+        if method.startswith(f"/{REGISTRY_SERVICE}/"):
+            # Never proxy registry methods (reference registry.go:158-161);
+            # unknown Registry methods fail as unimplemented.
+            return None
+        # Keep the original (multi-valued) metadata tuple; only routing reads
+        # need a dict view.
+        metadata = tuple(handler_call_details.invocation_metadata or ())
+
+        def handler(request_iterator, context):
+            return self._forward(method, metadata, request_iterator, context)
+
+        return grpc.stream_stream_rpc_method_handler(
+            handler, request_deserializer=_IDENTITY, response_serializer=_IDENTITY
+        )
+
+    def _forward(self, method, metadata, request_iterator, context):
+        log = from_context()
+        controller_id = next(
+            (v for k, v in metadata if k == CONTROLLER_ID_META), ""
+        )
+        if not controller_id:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"missing {CONTROLLER_ID_META} metadata",
+            )
+        # Authorization: only the host assigned to this controller may talk to
+        # it (reference registry.go:176-184).
+        if self._service.tls is not None:
+            peer = peer_common_name(context)
+            if peer != f"host.{controller_id}":
+                context.abort(
+                    grpc.StatusCode.PERMISSION_DENIED,
+                    f"{peer!r} may not access controller {controller_id!r}",
+                )
+        address = self._service.db.get(f"{controller_id}/{REGISTRY_ADDRESS}")
+        if not address:
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                f"no address registered for controller {controller_id!r}",
+            )
+        log.debug("proxying", method=method, controller=controller_id, address=address)
+        # Per-call dialing with pinned far-end identity (registry.go:191-210).
+        channel = self._dial(address, f"controller.{controller_id}")
+        try:
+            call = channel.stream_stream(
+                method, request_serializer=_IDENTITY, response_deserializer=_IDENTITY
+            )(
+                request_iterator,
+                timeout=context.time_remaining(),
+                metadata=[(k, v) for k, v in metadata if k != CONTROLLER_ID_META],
+            )
+            try:
+                for response in call:
+                    yield response
+            except grpc.RpcError as err:
+                context.abort(err.code(), err.details())
+        finally:
+            channel.close()
+
+
+def registry_server(
+    endpoint: str,
+    service: RegistryService,
+    dial: Callable[[str, str], grpc.Channel] | None = None,
+) -> NonBlockingGRPCServer:
+    """Build the registry's server with the proxy attached
+    (reference registry.go:248-261)."""
+    server = NonBlockingGRPCServer(
+        endpoint, tls=service.tls, interceptors=(LogServerInterceptor(),)
+    )
+
+    def register(grpc_server: grpc.Server) -> None:
+        add_registry_to_server(service, grpc_server)
+        grpc_server.add_generic_rpc_handlers((TransparentProxy(service, dial),))
+
+    server.start(register)
+    return server

@@ -1,0 +1,20 @@
+"""oim-tpu wire protocol: generated protobuf messages + hand-written gRPC bindings.
+
+The .proto is extracted from the repo-root ``spec.md`` (the single source of truth,
+mirroring the reference's spec-as-markdown discipline, /root/reference/Makefile:78-103)
+by ``scripts/gen_proto.py``. Service stubs/servicers are hand-written in
+``services.py`` because the image ships ``protoc`` without the grpc python plugin —
+they are the same thin wrappers grpc_tools would emit.
+"""
+
+from oim_tpu.spec import oim_pb2 as pb  # noqa: F401
+from oim_tpu.spec.services import (  # noqa: F401
+    ControllerStub,
+    ControllerServicer,
+    RegistryStub,
+    RegistryServicer,
+    add_controller_to_server,
+    add_registry_to_server,
+    CONTROLLER_SERVICE,
+    REGISTRY_SERVICE,
+)

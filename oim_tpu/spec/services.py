@@ -1,0 +1,108 @@
+"""Hand-written gRPC service bindings for the oim.v1 protocol.
+
+Equivalent to what grpc_tools' protoc plugin would generate (the reference commits
+its generated bindings too, pkg/spec/oim/v0/oim.pb.go). Kept deliberately thin:
+serializer tables + stub/servicer/registration helpers, driven by a declarative
+method table so the registry's transparent proxy can share it.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from oim_tpu.spec import oim_pb2 as pb
+
+REGISTRY_SERVICE = "oim.v1.Registry"
+CONTROLLER_SERVICE = "oim.v1.Controller"
+
+# method name -> (request class, reply class)
+REGISTRY_METHODS = {
+    "SetValue": (pb.SetValueRequest, pb.SetValueReply),
+    "GetValues": (pb.GetValuesRequest, pb.GetValuesReply),
+}
+
+CONTROLLER_METHODS = {
+    "MapVolume": (pb.MapVolumeRequest, pb.MapVolumeReply),
+    "UnmapVolume": (pb.UnmapVolumeRequest, pb.UnmapVolumeReply),
+    "ProvisionMallocBDev": (pb.ProvisionMallocBDevRequest, pb.ProvisionMallocBDevReply),
+    "CheckMallocBDev": (pb.CheckMallocBDevRequest, pb.CheckMallocBDevReply),
+    "StageStatus": (pb.StageStatusRequest, pb.StageStatusReply),
+}
+
+
+class _Stub:
+    """Unary-unary stub over a method table."""
+
+    _service: str = ""
+    _methods: dict = {}
+
+    def __init__(self, channel: grpc.Channel):
+        for name, (req_cls, reply_cls) in self._methods.items():
+            setattr(
+                self,
+                name,
+                channel.unary_unary(
+                    f"/{self._service}/{name}",
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=reply_cls.FromString,
+                ),
+            )
+
+
+class RegistryStub(_Stub):
+    _service = REGISTRY_SERVICE
+    _methods = REGISTRY_METHODS
+
+
+class ControllerStub(_Stub):
+    _service = CONTROLLER_SERVICE
+    _methods = CONTROLLER_METHODS
+
+
+class RegistryServicer:
+    """Subclass and override; unimplemented methods abort with UNIMPLEMENTED."""
+
+    def SetValue(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "SetValue not implemented")
+
+    def GetValues(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "GetValues not implemented")
+
+
+class ControllerServicer:
+    def MapVolume(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "MapVolume not implemented")
+
+    def UnmapVolume(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "UnmapVolume not implemented")
+
+    def ProvisionMallocBDev(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "ProvisionMallocBDev not implemented")
+
+    def CheckMallocBDev(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "CheckMallocBDev not implemented")
+
+    def StageStatus(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "StageStatus not implemented")
+
+
+def _add_service(server: grpc.Server, servicer, service: str, methods: dict) -> None:
+    handlers = {
+        name: grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req_cls.FromString,
+            response_serializer=reply_cls.SerializeToString,
+        )
+        for name, (req_cls, reply_cls) in methods.items()
+    }
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(service, handlers),)
+    )
+
+
+def add_registry_to_server(servicer: RegistryServicer, server: grpc.Server) -> None:
+    _add_service(server, servicer, REGISTRY_SERVICE, REGISTRY_METHODS)
+
+
+def add_controller_to_server(servicer: ControllerServicer, server: grpc.Server) -> None:
+    _add_service(server, servicer, CONTROLLER_SERVICE, CONTROLLER_METHODS)

@@ -1,0 +1,44 @@
+#!/usr/bin/env python3
+"""Extract the protobuf from spec.md and compile it with protoc.
+
+Mirrors the reference's spec-as-markdown discipline (/root/reference/Makefile:78-103):
+spec.md is the single source of truth; the extracted .proto and the generated
+oim_pb2.py are committed, and tests/test_spec.py fails if they drift.
+"""
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+SPEC_MD = REPO / "spec.md"
+PROTO_DIR = REPO / "oim_tpu" / "spec"
+PROTO = PROTO_DIR / "oim.proto"
+
+
+def extract_proto(text: str) -> str:
+    m = re.search(r"```proto\n(.*?)```", text, re.DOTALL)
+    if not m:
+        raise SystemExit("no ```proto block in spec.md")
+    return m.group(1)
+
+
+def main(check: bool = False) -> int:
+    proto_src = extract_proto(SPEC_MD.read_text())
+    if check:
+        if PROTO.read_text() != proto_src:
+            print("spec.md and oim.proto have drifted; run scripts/gen_proto.py")
+            return 1
+        return 0
+    PROTO_DIR.mkdir(parents=True, exist_ok=True)
+    PROTO.write_text(proto_src)
+    subprocess.run(
+        ["protoc", f"--python_out={PROTO_DIR}", f"-I{PROTO_DIR}", str(PROTO)],
+        check=True,
+    )
+    print(f"wrote {PROTO} and oim_pb2.py")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(check="--check" in sys.argv))

@@ -1,0 +1,43 @@
+"""Test configuration.
+
+Ring-0/1 tests run on a virtual 8-device CPU mesh (the analog of the
+reference's QEMU multi-VM rig, SURVEY.md section 4.3): JAX_PLATFORMS=cpu +
+xla_force_host_platform_device_count=8 must be set before jax initializes, so
+this conftest sets them at import time. Real-TPU runs (bench.py,
+__graft_entry__.py) never import this file.
+
+Ring-2 tests that need real hardware gate on the OIM_TEST_TPU env var and skip
+otherwise, mirroring the reference's TEST_SPDK_VHOST_* env gating
+(test/test.make:1-20).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def ca():
+    """A real CA shared by the TLS test suite."""
+    from oim_tpu.common.ca import CertAuthority
+
+    return CertAuthority("oim-test-ca")
+
+
+@pytest.fixture(scope="session")
+def evil_ca():
+    """A deliberately untrusted CA for MITM tests (reference _work/evil-ca,
+    README.md:558-563)."""
+    from oim_tpu.common.ca import CertAuthority
+
+    return CertAuthority("oim-evil-ca")

@@ -1,0 +1,178 @@
+"""Ring-0 unit tests for the common layer (model: reference pkg/oim-common
+path_test.go, pci_test.go, server_test.go, cmdmonitor_test.go and pkg/log
+tests)."""
+
+import io
+import subprocess
+import sys
+import time
+
+import grpc
+import pytest
+
+from oim_tpu.common import (
+    KeyMutex,
+    Logger,
+    MeshCoord,
+    NonBlockingGRPCServer,
+    from_context,
+    join_registry_path,
+    parse_endpoint,
+    split_registry_path,
+    with_logger,
+)
+from oim_tpu.common import logging as oim_logging
+from oim_tpu.common.cmdmonitor import monitored_popen
+from oim_tpu.common.meshcoord import UNSET
+from oim_tpu.spec import pb, RegistryServicer, RegistryStub, add_registry_to_server
+
+
+class TestRegistryPath:
+    def test_roundtrip(self):
+        assert split_registry_path("host-0/address") == ["host-0", "address"]
+        assert join_registry_path(["host-0", "mesh"]) == "host-0/mesh"
+
+    @pytest.mark.parametrize("bad", ["", "a//b", "a/./b", "../a", "a/.."])
+    def test_rejects_traversal(self, bad):
+        with pytest.raises(ValueError):
+            split_registry_path(bad)
+
+
+class TestMeshCoord:
+    def test_parse_format(self):
+        c = MeshCoord.parse("1,2,3")
+        assert (c.x, c.y, c.z, c.core) == (1, 2, 3, UNSET)
+        assert c.format() == "1,2,3"
+        assert MeshCoord.parse("1,2,3,0").format() == "1,2,3,0"
+        assert MeshCoord.parse("*,2,*").format() == "*,2,*"
+
+    @pytest.mark.parametrize("bad", ["1,2", "1,2,3,4,5", "a,b,c", "-2,1,1"])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            MeshCoord.parse(bad)
+
+    def test_complete_merges_wildcards(self):
+        # The reference's CompletePCIAddress semantics (pci.go:51-65).
+        got = MeshCoord.parse("*,2,*").complete(MeshCoord.parse("7,8,9,1"))
+        assert got == MeshCoord(7, 2, 9, 1)
+        assert got.is_complete()
+        assert not MeshCoord.parse("*,2,3").is_complete()
+
+    def test_proto_roundtrip(self):
+        c = MeshCoord(1, 2, 3, 0)
+        assert MeshCoord.from_proto(c.to_proto()) == c
+
+
+class TestLogging:
+    def test_context_attachment(self):
+        buf = io.StringIO()
+        logger = Logger(output=buf).with_fields(component="test")
+        assert from_context() is oim_logging.get_global()
+        with with_logger(logger):
+            assert from_context() is logger
+            from_context().info("hello", n=1)
+        assert from_context() is oim_logging.get_global()
+        line = buf.getvalue()
+        assert "hello" in line and "component: 'test'" in line and "n: 1" in line
+
+    def test_level_threshold(self):
+        buf = io.StringIO()
+        logger = Logger(output=buf, level=oim_logging.WARNING)
+        logger.info("quiet")
+        logger.warning("loud")
+        assert "quiet" not in buf.getvalue()
+        assert "loud" in buf.getvalue()
+
+    def test_parse_level(self):
+        assert oim_logging.parse_level("debug") == oim_logging.DEBUG
+        with pytest.raises(ValueError):
+            oim_logging.parse_level("bogus")
+
+
+class TestParseEndpoint:
+    def test_forms(self):
+        assert parse_endpoint("unix:///tmp/x.sock") == ("unix", "/tmp/x.sock")
+        assert parse_endpoint("unix://rel.sock") == ("unix", "rel.sock")
+        assert parse_endpoint("tcp://1.2.3.4:5") == ("tcp", "1.2.3.4:5")
+        assert parse_endpoint("localhost:0") == ("tcp", "localhost:0")
+
+    @pytest.mark.parametrize("bad", ["", "unix://", "http://x", "tcp://"])
+    def test_rejects(self, bad):
+        with pytest.raises(ValueError):
+            parse_endpoint(bad)
+
+
+class _EchoRegistry(RegistryServicer):
+    def GetValues(self, request, context):
+        return pb.GetValuesReply(values=[pb.Value(path=request.path, value="v")])
+
+
+class TestServer:
+    def test_tcp_port_discovery_and_stop(self):
+        srv = NonBlockingGRPCServer("tcp://localhost:0")
+        srv.start(lambda s: add_registry_to_server(_EchoRegistry(), s))
+        assert not srv.addr.endswith(":0")
+        with grpc.insecure_channel(srv.addr) as ch:
+            reply = RegistryStub(ch).GetValues(pb.GetValuesRequest(path="k"))
+        assert reply.values[0].path == "k"
+        srv.stop()
+
+    def test_unix_socket_cleanup(self, tmp_path):
+        sock = tmp_path / "srv.sock"
+        sock.write_text("stale")  # stale socket from a "previous run"
+        srv = NonBlockingGRPCServer(f"unix://{sock}")
+        srv.start(lambda s: add_registry_to_server(_EchoRegistry(), s))
+        with grpc.insecure_channel(srv.addr) as ch:
+            RegistryStub(ch).GetValues(pb.GetValuesRequest(path="k"))
+        srv.stop()
+        assert not sock.exists()
+
+
+class TestKeyMutex:
+    def test_serializes_same_key(self):
+        import threading
+
+        km = KeyMutex()
+        order = []
+
+        def worker(tag, delay):
+            with km.locked("vol-1"):
+                order.append(("start", tag))
+                time.sleep(delay)
+                order.append(("end", tag))
+
+        t1 = threading.Thread(target=worker, args=("a", 0.05))
+        t1.start()
+        time.sleep(0.01)
+        t2 = threading.Thread(target=worker, args=("b", 0))
+        t2.start()
+        t1.join()
+        t2.join()
+        # b must not start until a ended
+        assert order.index(("end", "a")) < order.index(("start", "b"))
+
+
+class TestCmdMonitor:
+    def test_detects_death(self):
+        proc, mon = monitored_popen([sys.executable, "-c", "import time; time.sleep(0.2)"])
+        assert not mon.died.is_set()
+        assert mon.died.wait(5.0)
+        proc.wait()
+
+    def test_survives_while_running(self):
+        proc, mon = monitored_popen(
+            [sys.executable, "-c", "import time; time.sleep(10)"],
+            stdout=subprocess.DEVNULL,
+        )
+        assert not mon.died.wait(0.3)
+        proc.kill()
+        assert mon.died.wait(5.0)
+        proc.wait()
+
+
+class TestSpecDrift:
+    def test_proto_matches_spec_md(self):
+        # CI drift check, reference Makefile:78-103 discipline.
+        import scripts.gen_proto as gen
+
+        assert gen.main(check=True) == 0

@@ -1,0 +1,263 @@
+"""Controller tests: malloc backend semantics, MapVolume idempotency, the
+registration lifecycle (model: reference pkg/oim-controller/controller_test.go,
+incl. the re-registration test at controller_test.go:107-127)."""
+
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from oim_tpu.controller import Controller, ControllerService, MallocBackend
+from oim_tpu.controller.backend import StageState
+from oim_tpu.registry import MemRegistryDB, RegistryService
+from oim_tpu.registry.registry import registry_server
+from oim_tpu.spec import ControllerStub, pb
+from oim_tpu.controller.controller import controller_server
+
+
+class _Ctx:
+    """Minimal in-process servicer context."""
+
+    def abort(self, code, details):
+        raise grpc.RpcError(f"{code}: {details}")
+
+
+def wait_for(predicate, timeout=5.0, interval=0.01):
+    """Eventually-style polling assertion (reference Gomega Eventually)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+@pytest.fixture
+def service():
+    return ControllerService(MallocBackend())
+
+
+def map_malloc(service, volume_id="vol-0"):
+    return service.MapVolume(
+        pb.MapVolumeRequest(volume_id=volume_id, malloc=pb.MallocParams()), _Ctx()
+    )
+
+
+class TestMallocBackend:
+    def test_provision_check_delete(self):
+        b = MallocBackend()
+        b.provision("bdev0", 4096)
+        assert b.check("bdev0")
+        b.provision("bdev0", 4096)  # idempotent same-size re-provision
+        with pytest.raises(ValueError):
+            b.provision("bdev0", 8192)  # size mismatch (controller.go:230-240)
+        b.provision("bdev0", 0)  # delete
+        assert not b.check("bdev0")
+
+    def test_buffer_contents_staged(self, service):
+        service.backend.provision("vol-0", 1024)
+        service.backend.buffer("vol-0")[:] = 7
+        map_malloc(service)
+        vol = service.get_volume("vol-0")
+        assert vol.wait(5.0) and vol.state == StageState.READY
+        assert vol.array.shape == (1024,) and int(vol.array[0]) == 7
+
+    def test_spec_reshape(self, service):
+        service.backend.provision("vol-0", 64)
+        req = pb.MapVolumeRequest(
+            volume_id="vol-0",
+            malloc=pb.MallocParams(),
+            spec=pb.ArraySpec(shape=[4, 4], dtype="float32"),
+        )
+        service.MapVolume(req, _Ctx())
+        vol = service.get_volume("vol-0")
+        assert vol.wait(5.0)
+        assert vol.array.shape == (4, 4) and vol.array.dtype == np.float32
+
+
+class TestControllerService:
+    def test_map_is_idempotent(self, service):
+        service.backend.provision("vol-0", 128)
+        r1 = map_malloc(service)
+        service.get_volume("vol-0").wait(5.0)
+        r2 = map_malloc(service)
+        assert r2.buffer_handle == r1.buffer_handle
+        assert r2.placement.bytes == 128  # refreshed after staging
+
+    def test_map_conflicting_params_rejected(self, service):
+        service.backend.provision("vol-0", 128)
+        map_malloc(service)
+        with pytest.raises(grpc.RpcError, match="ALREADY_EXISTS"):
+            service.MapVolume(
+                pb.MapVolumeRequest(
+                    volume_id="vol-0", file=pb.FileParams(path="/nope")
+                ),
+                _Ctx(),
+            )
+
+    def test_map_missing_buffer_fails_via_status(self, service):
+        map_malloc(service, "ghost")
+        vol = service.get_volume("ghost")
+        assert vol.wait(5.0) and vol.state == StageState.FAILED
+        status = service.StageStatus(pb.StageStatusRequest(volume_id="ghost"), _Ctx())
+        assert not status.ready and "ghost" in status.error
+
+    def test_failed_volume_can_be_retried(self, service):
+        # A FAILED staging must not poison the volume_id: a retry with the
+        # same params gets a fresh staging attempt.
+        map_malloc(service, "vol-r")  # no buffer yet -> staging fails
+        assert wait_for(
+            lambda: service.get_volume("vol-r").state == StageState.FAILED
+        )
+        service.backend.provision("vol-r", 64)  # fault cleared
+        map_malloc(service, "vol-r")
+        vol = service.get_volume("vol-r")
+        assert vol.wait(5.0) and vol.state == StageState.READY
+
+    def test_unmap_during_staging_frees_array(self, service):
+        # Unmap racing an in-flight stager: the stager must free its own
+        # array (mark_ready returns False) rather than strand it.
+        import threading
+
+        from oim_tpu.controller.backend import StagedVolume
+
+        release = threading.Event()
+
+        class SlowBackend(MallocBackend):
+            def stage(self, volume: StagedVolume, params_kind, params):
+                def work():
+                    release.wait(5.0)
+                    if volume.mark_ready(np.zeros(8), 8):
+                        raise AssertionError("expected cancellation")
+
+                threading.Thread(target=work, daemon=True).start()
+
+        service.backend = SlowBackend()
+        map_malloc(service, "vol-s")
+        vol = service.get_volume("vol-s")
+        service.UnmapVolume(pb.UnmapVolumeRequest(volume_id="vol-s"), _Ctx())
+        release.set()
+        assert vol.wait(5.0)
+        assert vol.state == StageState.FAILED and vol.array is None
+
+    def test_unmap_idempotent(self, service):
+        service.backend.provision("vol-0", 128)
+        map_malloc(service)
+        service.UnmapVolume(pb.UnmapVolumeRequest(volume_id="vol-0"), _Ctx())
+        assert service.get_volume("vol-0") is None
+        # unknown volume: still succeeds (controller.go:202-209)
+        service.UnmapVolume(pb.UnmapVolumeRequest(volume_id="vol-0"), _Ctx())
+
+    def test_file_source(self, service, tmp_path):
+        data = np.arange(12, dtype=np.int32)
+        np.save(tmp_path / "a.npy", data)
+        service.MapVolume(
+            pb.MapVolumeRequest(
+                volume_id="f",
+                file=pb.FileParams(path=str(tmp_path / "a.npy"), format="npy"),
+            ),
+            _Ctx(),
+        )
+        vol = service.get_volume("f")
+        assert vol.wait(5.0) and vol.state == StageState.READY
+        np.testing.assert_array_equal(vol.array, data)
+
+    def test_check_bdev_rpc(self, service):
+        with pytest.raises(grpc.RpcError, match="NOT_FOUND"):
+            service.CheckMallocBDev(pb.CheckMallocBDevRequest(bdev_name="x"), _Ctx())
+        service.ProvisionMallocBDev(
+            pb.ProvisionMallocBDevRequest(bdev_name="x", size=64), _Ctx()
+        )
+        service.CheckMallocBDev(pb.CheckMallocBDevRequest(bdev_name="x"), _Ctx())
+
+
+class TestRegistrationLoop:
+    @pytest.fixture
+    def registry(self):
+        service = RegistryService(db=MemRegistryDB())
+        server = registry_server("tcp://localhost:0", service)
+        yield server, service
+        server.force_stop()
+
+    def test_registers_and_reregisters(self, registry):
+        server, service = registry
+        controller = Controller(
+            controller_id="host-0",
+            backend=MallocBackend(),
+            controller_address="tcp://c0:1234",
+            registry_address=server.addr,
+            registry_delay=0.1,
+        )
+        from oim_tpu.common.meshcoord import MeshCoord
+
+        controller.mesh_coord = MeshCoord.parse("1,2,3")
+        controller.start()
+        try:
+            assert wait_for(lambda: service.db.get("host-0/address") == "tcp://c0:1234")
+            assert service.db.get("host-0/mesh") == "1,2,3"
+            # Soft-state recovery: delete the entry, it must come back
+            # (controller_test.go:107-127, README.md:138-143).
+            service.db.set("host-0/address", "")
+            assert wait_for(lambda: service.db.get("host-0/address") == "tcp://c0:1234")
+        finally:
+            controller.stop()
+
+    def test_stop_stops_registering(self, registry):
+        server, service = registry
+        controller = Controller(
+            controller_id="host-0",
+            backend=MallocBackend(),
+            controller_address="a",
+            registry_address=server.addr,
+            registry_delay=0.05,
+        )
+        controller.start()
+        assert wait_for(lambda: service.db.get("host-0/address") == "a")
+        controller.stop()
+        service.db.set("host-0/address", "")
+        # Consistently-style check: must NOT re-register after stop.
+        time.sleep(0.3)
+        assert service.db.get("host-0/address") == ""
+
+    def test_requires_address_for_registration(self):
+        with pytest.raises(ValueError):
+            Controller(
+                controller_id="c", backend=MallocBackend(), registry_address="r"
+            )
+
+    def test_tolerates_unreachable_registry(self):
+        controller = Controller(
+            controller_id="host-0",
+            backend=MallocBackend(),
+            controller_address="a",
+            registry_address="localhost:1",  # nothing listens here
+            registry_delay=0.05,
+        )
+        controller.start()
+        time.sleep(0.2)  # loop must survive dial failures (controller.go:432)
+        controller.stop()
+
+
+class TestControllerOverGRPC:
+    def test_served_controller_roundtrip(self):
+        service = ControllerService(MallocBackend())
+        server = controller_server("tcp://localhost:0", service)
+        try:
+            with grpc.insecure_channel(server.addr) as ch:
+                stub = ControllerStub(ch)
+                stub.ProvisionMallocBDev(
+                    pb.ProvisionMallocBDevRequest(bdev_name="v", size=256), timeout=5
+                )
+                stub.MapVolume(
+                    pb.MapVolumeRequest(volume_id="v", malloc=pb.MallocParams()),
+                    timeout=5,
+                )
+                assert wait_for(
+                    lambda: stub.StageStatus(
+                        pb.StageStatusRequest(volume_id="v"), timeout=5
+                    ).ready
+                )
+                stub.UnmapVolume(pb.UnmapVolumeRequest(volume_id="v"), timeout=5)
+        finally:
+            server.force_stop()

@@ -1,0 +1,203 @@
+"""Feeder tests: local + remote publish, full three-component wiring
+(driver -> registry -> controller) in one process, deadline semantics, and the
+emulation plug-in registry.
+
+Model: reference pkg/oim-csi-driver/oim-driver_test.go (TestMockOIM at
+oim-driver_test.go:148-226, asserting DeadlineExceeded when the device can
+never appear) and nodeserver_test.go wait semantics."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from oim_tpu.common.meshcoord import MeshCoord
+from oim_tpu.controller import ControllerService, MallocBackend
+from oim_tpu.controller.backend import StagedVolume
+from oim_tpu.controller.controller import controller_server
+from oim_tpu.feeder import Feeder, map_volume_params
+from oim_tpu.feeder.driver import DeadlineExceeded, PublishError
+from oim_tpu.registry import MemRegistryDB, RegistryService
+from oim_tpu.registry.registry import registry_server
+from oim_tpu.spec import pb
+
+
+class TestModeValidation:
+    def test_exactly_one_mode(self):
+        with pytest.raises(ValueError):
+            Feeder()
+        with pytest.raises(ValueError):
+            Feeder(
+                controller=ControllerService(MallocBackend()),
+                registry_address="x",
+                controller_id="y",
+            )
+        with pytest.raises(ValueError):
+            Feeder(registry_address="x")  # missing controller_id
+
+
+class TestLocalPublish:
+    @pytest.fixture
+    def feeder(self):
+        service = ControllerService(MallocBackend())
+        service.backend.provision("vol-0", 256)
+        return Feeder(controller=service)
+
+    def test_publish_returns_array(self, feeder):
+        pub = feeder.publish(
+            pb.MapVolumeRequest(volume_id="vol-0", malloc=pb.MallocParams())
+        )
+        assert pub.bytes == 256
+        assert isinstance(pub.array, np.ndarray)
+        # idempotent re-publish returns the same volume (nodeserver.go:95-109)
+        again = feeder.publish(
+            pb.MapVolumeRequest(volume_id="vol-0", malloc=pb.MallocParams())
+        )
+        assert again is pub
+
+    def test_publish_failure_surfaces(self, feeder):
+        with pytest.raises(PublishError, match="ghost"):
+            feeder.publish(
+                pb.MapVolumeRequest(volume_id="ghost", malloc=pb.MallocParams())
+            )
+
+    def test_unpublish(self, feeder):
+        feeder.publish(
+            pb.MapVolumeRequest(volume_id="vol-0", malloc=pb.MallocParams())
+        )
+        feeder.unpublish("vol-0")
+        assert feeder.controller.get_volume("vol-0") is None
+        feeder.unpublish("vol-0")  # idempotent
+
+
+class StuckBackend(MallocBackend):
+    """A backend whose staging never completes (the analog of the reference's
+    block device that never appears, oim-driver_test.go:148-226)."""
+
+    def stage(self, volume: StagedVolume, params_kind, params):
+        pass  # never marks ready
+
+
+class TestMockOIM:
+    """Full wiring: feeder -> registry proxy -> controller, one process,
+    insecure loopback (the TLS path is covered by test_registry.py)."""
+
+    @pytest.fixture
+    def cluster(self):
+        db = MemRegistryDB()
+        registry_service = RegistryService(db=db)
+        registry = registry_server("tcp://localhost:0", registry_service)
+        controller_service = ControllerService(MallocBackend())
+        controller = controller_server("tcp://localhost:0", controller_service)
+        db.set("host-0/address", controller.addr)
+        db.set("host-0/mesh", "5,6,7")
+        yield registry, controller_service
+        registry.force_stop()
+        controller.force_stop()
+
+    def feeder_for(self, registry):
+        return Feeder(registry_address=registry.addr, controller_id="host-0")
+
+    def test_remote_publish_and_coordinate_merge(self, cluster):
+        registry, controller_service = cluster
+        controller_service.backend.provision("vol-0", 512)
+        feeder = self.feeder_for(registry)
+        pub = feeder.publish(
+            pb.MapVolumeRequest(volume_id="vol-0", malloc=pb.MallocParams())
+        )
+        assert pub.bytes == 512
+        # Controller (malloc backend) reports no coordinate; the registry's
+        # <id>/mesh default fills it in (nodeserver.go:253-273 analog).
+        assert pub.coordinate == MeshCoord(5, 6, 7)
+        assert pub.array is None  # data lives in the controller's runtime
+        feeder.unpublish("vol-0")
+        assert controller_service.get_volume("vol-0") is None
+
+    def test_remote_publish_failure(self, cluster):
+        registry, _ = cluster
+        feeder = self.feeder_for(registry)
+        with pytest.raises(PublishError, match="ghost"):
+            feeder.publish(
+                pb.MapVolumeRequest(volume_id="ghost", malloc=pb.MallocParams())
+            )
+
+    def test_deadline_exceeded_when_never_ready(self, cluster):
+        registry, controller_service = cluster
+        controller_service.backend = StuckBackend()
+        feeder = self.feeder_for(registry)
+        with pytest.raises(DeadlineExceeded):
+            feeder.publish(
+                pb.MapVolumeRequest(volume_id="v", malloc=pb.MallocParams()),
+                timeout=0.5,
+            )
+
+    def test_concurrent_publishers_one_staging(self, cluster):
+        registry, controller_service = cluster
+        controller_service.backend.provision("vol-c", 128)
+        feeder = self.feeder_for(registry)
+        results, errors = [], []
+
+        def run():
+            try:
+                results.append(
+                    feeder.publish(
+                        pb.MapVolumeRequest(
+                            volume_id="vol-c", malloc=pb.MallocParams()
+                        )
+                    )
+                )
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [threading.Thread(target=run) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert len({id(r) for r in results}) == 1  # all saw the same publish
+
+
+class TestEmulation:
+    def test_ceph_csi_translation(self):
+        req = map_volume_params(
+            "ceph-csi",
+            "img-1",
+            {"monitors": "mon1:6789", "pool": "rbd", "adminid": "admin"},
+            {"admin": "sekrit"},
+        )
+        assert req.WhichOneof("params") == "ceph"
+        assert req.ceph.monitors == "mon1:6789"
+        assert req.ceph.secret == "sekrit"
+        assert req.ceph.image == "img-1"
+
+    def test_ceph_csi_missing_attrs(self):
+        with pytest.raises(ValueError, match="monitors"):
+            map_volume_params("ceph-csi", "v", {"pool": "rbd"})
+
+    def test_tfrecord_translation(self):
+        req = map_volume_params(
+            "tfrecord",
+            "ds",
+            {"paths": "/a,/b", "shape": "2,3", "dtype": "float32"},
+        )
+        assert list(req.tfrecord.paths) == ["/a", "/b"]
+        assert list(req.spec.shape) == [2, 3]
+        assert req.spec.dtype == "float32"
+
+    def test_unknown_emulation(self):
+        with pytest.raises(ValueError, match="unknown emulation"):
+            map_volume_params("nope", "v", {})
+
+    def test_secret_stripping_in_logs(self):
+        from oim_tpu.common.interceptors import strip_secrets
+
+        req = map_volume_params(
+            "ceph-csi",
+            "img",
+            {"monitors": "m", "pool": "p"},
+            {"admin": "hunter2"},
+        )
+        formatted = strip_secrets(req)
+        assert "hunter2" not in formatted
+        assert "***stripped***" in formatted

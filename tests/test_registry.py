@@ -1,0 +1,280 @@
+"""Registry tests: KV semantics, CN authorization, the full mTLS matrix
+(including evil-CA MITM both directions), and the transparent proxy.
+
+Model: reference pkg/oim-registry/registry_test.go (TLS matrix at
+registry_test.go:251-390) and the proxy director behavior
+(registry.go:149-210)."""
+
+import grpc
+import pytest
+
+from oim_tpu.common.tlsutil import TLSConfig, secure_channel
+from oim_tpu.controller.controller import controller_server
+from oim_tpu.registry import MemRegistryDB, RegistryService
+from oim_tpu.registry.db import get_registry_entries
+from oim_tpu.registry.registry import CONTROLLER_ID_META, registry_server
+from oim_tpu.spec import ControllerServicer, ControllerStub, RegistryStub, pb
+
+
+def tls_for(ca, cn, peer_name=""):
+    key_pem, cert_pem = ca.issue(cn)
+    return TLSConfig(
+        ca_pem=ca.cert_pem, key_pem=key_pem, cert_pem=cert_pem, peer_name=peer_name
+    )
+
+
+class MockController(ControllerServicer):
+    """Records requests, returns canned replies (reference MockController,
+    registry_test.go:27-53)."""
+
+    def __init__(self):
+        self.requests = []
+
+    def MapVolume(self, request, context):
+        self.requests.append(request)
+        return pb.MapVolumeReply(
+            placement=pb.HBMPlacement(device_id=3, bytes=512),
+            buffer_handle=request.volume_id,
+        )
+
+    def StageStatus(self, request, context):
+        return pb.StageStatusReply(ready=True, bytes_staged=512)
+
+
+@pytest.fixture
+def db():
+    return MemRegistryDB()
+
+
+class TestMemDB:
+    def test_set_get_delete(self, db):
+        db.set("a/b", "1")
+        assert db.get("a/b") == "1"
+        db.set("a/b", "")  # empty value deletes (memdb.go:28-33)
+        assert db.get("a/b") == ""
+
+    def test_prefix_match(self, db):
+        db.set("host-0/address", "a0")
+        db.set("host-0/mesh", "0,0,0")
+        db.set("host-10/address", "a10")
+        got = get_registry_entries(db, "host-0")
+        # component-wise prefix: host-10 must NOT match host-0
+        # (registry.go:129-144 semantics).
+        assert got == {"host-0/address": "a0", "host-0/mesh": "0,0,0"}
+        assert len(get_registry_entries(db, "")) == 3
+
+
+class TestInsecureRegistry:
+    """Service semantics without TLS (insecure mode trusts everyone)."""
+
+    @pytest.fixture
+    def server_and_stub(self, db):
+        service = RegistryService(db=db)
+        server = registry_server("tcp://localhost:0", service)
+        channel = grpc.insecure_channel(server.addr)
+        yield server, RegistryStub(channel)
+        channel.close()
+        server.force_stop()
+
+    def test_set_get(self, server_and_stub):
+        _, stub = server_and_stub
+        stub.SetValue(
+            pb.SetValueRequest(value=pb.Value(path="host-0/address", value="x"))
+        )
+        reply = stub.GetValues(pb.GetValuesRequest(path="host-0"))
+        assert [(v.path, v.value) for v in reply.values] == [("host-0/address", "x")]
+
+    def test_invalid_path_rejected(self, server_and_stub):
+        _, stub = server_and_stub
+        with pytest.raises(grpc.RpcError) as err:
+            stub.SetValue(
+                pb.SetValueRequest(value=pb.Value(path="../etc", value="x"))
+            )
+        assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+class TestTLSMatrix:
+    """The authorization matrix over real mTLS connections."""
+
+    @pytest.fixture
+    def registry(self, ca, db):
+        service = RegistryService(db=db, tls=tls_for(ca, "component.registry"))
+        server = registry_server("tcp://localhost:0", service)
+        yield server
+        server.force_stop()
+
+    def dial(self, registry, cfg):
+        return secure_channel(registry.addr, cfg, "component.registry")
+
+    def test_admin_may_set_anything(self, registry, ca):
+        with self.dial(registry, tls_for(ca, "user.admin")) as ch:
+            RegistryStub(ch).SetValue(
+                pb.SetValueRequest(value=pb.Value(path="host-0/address", value="a"))
+            )
+
+    def test_controller_may_set_own_address_and_mesh(self, registry, ca):
+        with self.dial(registry, tls_for(ca, "controller.host-0")) as ch:
+            stub = RegistryStub(ch)
+            stub.SetValue(
+                pb.SetValueRequest(value=pb.Value(path="host-0/address", value="a"))
+            )
+            stub.SetValue(
+                pb.SetValueRequest(value=pb.Value(path="host-0/mesh", value="0,0,0"))
+            )
+
+    @pytest.mark.parametrize(
+        "path", ["host-1/address", "host-0/other", "host-0/address/deep", "host-0"]
+    )
+    def test_controller_denied_foreign_or_odd_keys(self, registry, ca, path):
+        with self.dial(registry, tls_for(ca, "controller.host-0")) as ch:
+            with pytest.raises(grpc.RpcError) as err:
+                RegistryStub(ch).SetValue(
+                    pb.SetValueRequest(value=pb.Value(path=path, value="a"))
+                )
+            assert err.value.code() == grpc.StatusCode.PERMISSION_DENIED
+
+    def test_host_cert_may_not_set(self, registry, ca):
+        with self.dial(registry, tls_for(ca, "host.host-0")) as ch:
+            with pytest.raises(grpc.RpcError) as err:
+                RegistryStub(ch).SetValue(
+                    pb.SetValueRequest(value=pb.Value(path="host-0/address", value="a"))
+                )
+            assert err.value.code() == grpc.StatusCode.PERMISSION_DENIED
+
+    def test_evil_ca_client_rejected(self, registry, evil_ca, ca):
+        # Client cert from an untrusted CA: the server must refuse the
+        # handshake (reference registry_test.go evil-CA rows).
+        evil_key, evil_cert = evil_ca.issue("user.admin")
+        cfg = TLSConfig(
+            ca_pem=ca.cert_pem,  # trusts the real server...
+            key_pem=evil_key,
+            cert_pem=evil_cert,
+        )
+        with secure_channel(registry.addr, cfg, "component.registry") as ch:
+            with pytest.raises(grpc.RpcError) as err:
+                RegistryStub(ch).SetValue(
+                    pb.SetValueRequest(value=pb.Value(path="x/y", value="1")),
+                    timeout=5,
+                )
+            assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+
+    def test_client_rejects_evil_registry(self, ca, evil_ca, db):
+        # A MITM registry presenting an evil-CA cert: the client must refuse.
+        service = RegistryService(db=db, tls=tls_for(evil_ca, "component.registry"))
+        server = registry_server("tcp://localhost:0", service)
+        try:
+            with self.dial(server, tls_for(ca, "user.admin")) as ch:
+                with pytest.raises(grpc.RpcError) as err:
+                    RegistryStub(ch).GetValues(pb.GetValuesRequest(path=""), timeout=5)
+                assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+        finally:
+            server.force_stop()
+
+    def test_client_rejects_wrong_server_name(self, ca, db):
+        # Registry presenting a valid cert with the WRONG identity: the
+        # client's peer-name pinning must refuse it.
+        service = RegistryService(db=db, tls=tls_for(ca, "controller.host-0"))
+        server = registry_server("tcp://localhost:0", service)
+        try:
+            with self.dial(server, tls_for(ca, "user.admin")) as ch:
+                with pytest.raises(grpc.RpcError) as err:
+                    RegistryStub(ch).GetValues(pb.GetValuesRequest(path=""), timeout=5)
+                assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+        finally:
+            server.force_stop()
+
+
+class TestTransparentProxy:
+    """Metadata-routed forwarding with per-call dialing and identity pinning."""
+
+    @pytest.fixture
+    def cluster(self, ca, db):
+        """registry + mock controller, both with TLS, controller registered."""
+        mock = MockController()
+        controller = controller_server(
+            "tcp://localhost:0", mock, tls=tls_for(ca, "controller.host-0")
+        )
+        service = RegistryService(db=db, tls=tls_for(ca, "component.registry"))
+        registry = registry_server("tcp://localhost:0", service)
+        db.set("host-0/address", controller.addr)
+        yield registry, controller, mock
+        registry.force_stop()
+        controller.force_stop()
+
+    def proxy_stub(self, registry, ca, cn):
+        channel = secure_channel(registry.addr, tls_for(ca, cn), "component.registry")
+        return ControllerStub(channel), channel
+
+    def test_forwards_to_controller(self, cluster, ca):
+        registry, _, mock = cluster
+        stub, ch = self.proxy_stub(registry, ca, "host.host-0")
+        with ch:
+            reply = stub.MapVolume(
+                pb.MapVolumeRequest(volume_id="vol1", malloc=pb.MallocParams()),
+                metadata=[(CONTROLLER_ID_META, "host-0")],
+                timeout=10,
+            )
+        assert reply.placement.device_id == 3
+        assert [r.volume_id for r in mock.requests] == ["vol1"]
+
+    def test_missing_metadata(self, cluster, ca):
+        registry, _, _ = cluster
+        stub, ch = self.proxy_stub(registry, ca, "host.host-0")
+        with ch:
+            with pytest.raises(grpc.RpcError) as err:
+                stub.MapVolume(pb.MapVolumeRequest(volume_id="v"), timeout=10)
+            assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    def test_wrong_host_identity_denied(self, cluster, ca):
+        # host.host-1 may not reach controller host-0 (registry.go:176-184).
+        registry, _, _ = cluster
+        for cn in ("host.host-1", "user.admin"):
+            stub, ch = self.proxy_stub(registry, ca, cn)
+            with ch:
+                with pytest.raises(grpc.RpcError) as err:
+                    stub.MapVolume(
+                        pb.MapVolumeRequest(volume_id="v"),
+                        metadata=[(CONTROLLER_ID_META, "host-0")],
+                        timeout=10,
+                    )
+                assert err.value.code() == grpc.StatusCode.PERMISSION_DENIED
+
+    def test_unknown_controller_unavailable(self, cluster, ca):
+        registry, _, _ = cluster
+        stub, ch = self.proxy_stub(registry, ca, "host.host-9")
+        with ch:
+            with pytest.raises(grpc.RpcError) as err:
+                stub.MapVolume(
+                    pb.MapVolumeRequest(volume_id="v"),
+                    metadata=[(CONTROLLER_ID_META, "host-9")],
+                    timeout=10,
+                )
+            assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+
+    def test_registry_methods_never_proxied(self, cluster, ca):
+        # An unknown method under oim.v1.Registry must not be forwarded
+        # (registry.go:158-161).
+        registry, _, _ = cluster
+        cfg = tls_for(ca, "host.host-0")
+        with secure_channel(registry.addr, cfg, "component.registry") as ch:
+            call = ch.unary_unary(
+                "/oim.v1.Registry/Bogus",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+            with pytest.raises(grpc.RpcError) as err:
+                call(b"", metadata=[(CONTROLLER_ID_META, "host-0")], timeout=10)
+            assert err.value.code() == grpc.StatusCode.UNIMPLEMENTED
+
+    def test_controller_error_propagates(self, cluster, ca):
+        registry, _, _ = cluster
+        stub, ch = self.proxy_stub(registry, ca, "host.host-0")
+        with ch:
+            with pytest.raises(grpc.RpcError) as err:
+                # MockController leaves UnmapVolume unimplemented.
+                stub.UnmapVolume(
+                    pb.UnmapVolumeRequest(volume_id="v"),
+                    metadata=[(CONTROLLER_ID_META, "host-0")],
+                    timeout=10,
+                )
+            assert err.value.code() == grpc.StatusCode.UNIMPLEMENTED
