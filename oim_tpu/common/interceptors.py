@@ -19,6 +19,21 @@ from oim_tpu.common.logging import from_context
 Formatter = Callable[[Any], str]
 
 
+class _Lazy:
+    """Defers payload formatting until the log line is actually rendered —
+    the logger formats fields with !r after its level check, so a disabled
+    DEBUG costs nothing (reference delayedFormatter, tracing.go:69-82)."""
+
+    __slots__ = ("_fmt", "_msg")
+
+    def __init__(self, fmt: Formatter, msg: Any):
+        self._fmt = fmt
+        self._msg = msg
+
+    def __repr__(self) -> str:
+        return self._fmt(self._msg)
+
+
 def complete_formatter(msg: Any) -> str:
     """Log the full payload (reference CompletePayloadFormatter)."""
     if isinstance(msg, Message):
@@ -71,13 +86,13 @@ class LogServerInterceptor(grpc.ServerInterceptor):
 
         def wrapped(request, context):
             log = from_context()
-            log.debug("handling", method=method, request=fmt(request))
+            log.debug("handling", method=method, request=_Lazy(fmt, request))
             try:
                 reply = inner(request, context)
             except Exception as exc:  # noqa: BLE001 - log then re-raise
                 log.debug("failed", method=method, error=str(exc))
                 raise
-            log.debug("handled", method=method, reply=fmt(reply))
+            log.debug("handled", method=method, reply=_Lazy(fmt, reply))
             return reply
 
         return grpc.unary_unary_rpc_method_handler(
@@ -97,6 +112,8 @@ class LogClientInterceptor(grpc.UnaryUnaryClientInterceptor):
     def intercept_unary_unary(self, continuation, client_call_details, request):
         log = from_context()
         log.debug(
-            "calling", method=client_call_details.method, request=self._fmt(request)
+            "calling",
+            method=client_call_details.method,
+            request=_Lazy(self._fmt, request),
         )
         return continuation(client_call_details, request)

@@ -1,0 +1,206 @@
+"""Attention: pallas flash kernel (TPU) + jnp reference (everywhere).
+
+The flash kernel streams K/V blocks through VMEM with an online softmax so
+the [T, T] score matrix never materializes in HBM — the standard TPU
+blockwise pattern: sequential innermost grid dimension carries the
+accumulator in VMEM scratch across K blocks.
+
+Backward pass: recompute-based (jax.custom_vjp over the reference math under
+jax.checkpoint semantics). O(T^2) transient in the bwd only; long-context
+training routes through ring attention (oim_tpu/parallel/ring.py) where the
+per-chip T is small. A pallas bwd kernel is a planned upgrade.
+
+Shapes: [batch, seq, heads, head_dim] ("BTHD"). GQA: kv heads may divide q
+heads.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _expand_gqa(q, k, v):
+    """Repeat K/V heads when num_q_heads > num_kv_heads."""
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq == hkv:
+        return k, v
+    if hq % hkv:
+        raise ValueError(f"q heads {hq} not divisible by kv heads {hkv}")
+    rep = hq // hkv
+    k = jnp.repeat(k, rep, axis=2)
+    v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
+def mha_reference(q, k, v, causal: bool = True, scale: float | None = None):
+    """Plain jnp attention; the numerical ground truth for the kernels."""
+    k, v = _expand_gqa(q, k, v)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        # Bottom-right aligned (flash-attention convention): with tq < tk the
+        # queries are the LAST tq positions, so a decode step (tq=1) attends
+        # to the whole cache.
+        tq, tk = q.shape[1], k.shape[1]
+        q_pos = (tk - tq) + jnp.arange(tq)
+        mask = q_pos[:, None] >= jnp.arange(tk)[None, :]
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------- pallas ----
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k, q_offset):
+    """One (q-block, k-block) cell; innermost grid dim walks k blocks
+    sequentially so the VMEM scratch (acc/m/l) carries across them."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # q_offset = tk - tq bottom-right-aligns the causal mask (decode: the
+    # queries are the last tq positions of the key sequence).
+    q_start = qi * block_q + q_offset
+    k_start = kj * block_k
+
+    def _compute():
+        q = q_ref[0]  # [block_q, d]
+        k = k_ref[0]  # [block_k, d]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_k]
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_ref[:, 0]  # [block_q]
+        block_max = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, block_max)
+        p = jnp.exp(s - m_new[:, None])
+        if causal:
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[:, 0] = l_ref[:, 0] * corr + jnp.sum(p, axis=-1)
+        m_ref[:, 0] = m_new
+        acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        # Blocks strictly above the diagonal contribute nothing: skip them
+        # (predicated out, the TPU grid still visits the cell).
+        pl.when(k_start <= q_start + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kj == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[:, 0], 1e-30)
+        o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    # Kernel works in [B*H, T, D] layout: heads become grid rows and every
+    # block is a clean (T_block, d) tile for the MXU.
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    if tq % block_q or tk % block_k:
+        raise ValueError(f"seq lens ({tq},{tk}) not divisible by blocks ({block_q},{block_k})")
+    grid = (b * h, tq // block_q, tk // block_k)
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, q_offset=tk - tq,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(
+    q, k, v,
+    causal: bool = True,
+    scale: float | None = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+):
+    """Pallas flash attention. Requires q/kv head counts equal (expand GQA
+    first) and seq lengths divisible by the block sizes."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: mha_reference(q, k, v, causal, scale), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def attention(q, k, v, causal: bool = True, scale: float | None = None):
+    """Dispatch: pallas flash on TPU when block-aligned, reference otherwise."""
+    on_tpu = jax.default_backend() == "tpu"
+    tq, tk = q.shape[1], k.shape[1]
+    d = q.shape[-1]
+    aligned = tq % 128 == 0 and tk % 128 == 0 and d % 128 == 0
+    if on_tpu and aligned:
+        k, v = _expand_gqa(q, k, v)
+        bq = 512 if tq % 512 == 0 else 128
+        bk = 512 if tk % 512 == 0 else 128
+        return flash_attention(q, k, v, causal, scale, bq, bk)
+    return mha_reference(q, k, v, causal, scale)

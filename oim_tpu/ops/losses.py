@@ -1,0 +1,32 @@
+"""Losses.
+
+Cross entropy takes logits in any dtype, reduces in float32, and never
+materializes one-hot targets (take_along_axis on the log-softmax).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits, labels, ignore_index: int | None = None):
+    """Mean token cross entropy.
+
+    logits: [..., vocab]; labels: [...] int. ``ignore_index`` labels are
+    masked out of the mean (padding).
+    """
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(
+        logits, labels[..., None], axis=-1
+    )[..., 0]
+    nll = logz - label_logits
+    if ignore_index is not None:
+        mask = (labels != ignore_index).astype(jnp.float32)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)
+
+
+def accuracy(logits, labels):
+    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))

@@ -1,0 +1,47 @@
+"""Parallelism machinery: mesh construction from registry topology, named
+sharding rules, collective wrappers, and sequence parallelism (ring attention
+and Ulysses-style all-to-all).
+
+The reference has no model parallelism (SURVEY.md section 2.9) — its
+"topology" is the registry's ``<id>/pci`` key mapping controllers to PCI
+positions. Here the same KV (``<id>/mesh``) is the source of truth for the
+``jax.sharding.Mesh`` over which everything trains.
+"""
+
+from oim_tpu.parallel.mesh import (
+    MeshAxes,
+    build_mesh,
+    local_mesh,
+    mesh_from_topology,
+    topology_from_registry,
+)
+from oim_tpu.parallel.sharding import (
+    BATCH,
+    EXPERT,
+    HEAD,
+    MLP,
+    SEQ,
+    VOCAB,
+    ShardingRules,
+    logical_sharding,
+    shard_batch,
+    shard_params,
+)
+
+__all__ = [
+    "MeshAxes",
+    "build_mesh",
+    "local_mesh",
+    "mesh_from_topology",
+    "topology_from_registry",
+    "ShardingRules",
+    "logical_sharding",
+    "shard_batch",
+    "shard_params",
+    "BATCH",
+    "SEQ",
+    "HEAD",
+    "MLP",
+    "VOCAB",
+    "EXPERT",
+]

@@ -1,0 +1,65 @@
+"""Collective wrappers over named mesh axes.
+
+The distributed-communication backend of the framework (SURVEY.md section
+5.8): where the reference separates control RPC (gRPC/mTLS) from its
+shared-memory data plane, here the control plane stays gRPC over DCN
+(oim_tpu/registry) and ALL inter-chip traffic is XLA collectives over ICI —
+emitted by the compiler from these primitives under jit/shard_map. No NCCL,
+no MPI: the "backend" is the XLA runtime itself.
+"""
+
+from __future__ import annotations
+
+
+def psum(x, axis: str):
+    from jax import lax
+
+    return lax.psum(x, axis)
+
+
+def pmean(x, axis: str):
+    from jax import lax
+
+    return lax.pmean(x, axis)
+
+
+def all_gather(x, axis: str, *, tiled: bool = True, gather_dim: int = 0):
+    from jax import lax
+
+    return lax.all_gather(x, axis, axis=gather_dim, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str, *, scatter_dim: int = 0):
+    from jax import lax
+
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True)
+
+
+def all_to_all(x, axis: str, *, split_dim: int, concat_dim: int):
+    from jax import lax
+
+    return lax.all_to_all(
+        x, axis, split_axis=split_dim, concat_axis=concat_dim, tiled=True
+    )
+
+
+def ppermute_ring(x, axis: str, *, shift: int = 1):
+    """Rotate shards ``shift`` steps around a ring axis (the primitive under
+    ring attention, oim_tpu/parallel/ring.py)."""
+    from jax import lax
+
+    size = lax.psum(1, axis)  # concrete under shard_map
+    perm = [(i, (i + shift) % size) for i in range(size)]
+    return lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: str):
+    from jax import lax
+
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str):
+    from jax import lax
+
+    return lax.psum(1, axis)

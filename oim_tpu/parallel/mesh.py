@@ -1,0 +1,146 @@
+"""Mesh construction: registry topology -> jax.sharding.Mesh.
+
+The registry KV is the cluster's source of truth (reference README.md:108-121:
+``<id>/address`` + ``<id>/pci``; here ``<id>/address`` + ``<id>/mesh``, see
+oim_tpu/common/pathutil.py). Controllers self-register their ICI coordinates
+(oim_tpu/controller/controller.py, mirroring controller.go:448-468), and the
+trainer builds its device mesh from that map so that mesh axes ride ICI — the
+TPU analog of the reference wiring the vhost-user device to the right QEMU
+node by PCI address (qemu.go:90-101).
+
+Axis convention (innermost-last = fastest-varying = most ICI-local):
+``("data", "fsdp", "seq", "model")`` — gradient allreduce over ``data``
+crosses the slowest links, tensor-parallel collectives over ``model`` stay on
+neighbouring chips.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping, Sequence
+
+import numpy as np
+
+from oim_tpu.common.meshcoord import MeshCoord
+from oim_tpu.common.pathutil import REGISTRY_MESH
+
+MeshAxes = Sequence[tuple[str, int]]
+
+
+def _check_sizes(axes: MeshAxes, n_devices: int) -> list[tuple[str, int]]:
+    axes = [(str(name), int(size)) for name, size in axes]
+    total = int(np.prod([s for _, s in axes])) if axes else 1
+    if total != n_devices:
+        raise ValueError(
+            f"mesh axes {axes} require {total} devices, have {n_devices}"
+        )
+    return axes
+
+
+def build_mesh(axes: MeshAxes, devices: Sequence | None = None):
+    """A Mesh over ``devices`` (default: all of ``jax.devices()``).
+
+    On TPU, ``mesh_utils.create_device_mesh`` picks a physical->logical
+    assignment that keeps each axis contiguous on the ICI torus; elsewhere a
+    plain reshape is used (CPU "devices" have no interconnect geometry).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    axes = _check_sizes(axes, len(devices))
+    names = tuple(n for n, _ in axes)
+    shape = tuple(s for _, s in axes)
+    if devices and devices[0].platform == "tpu":
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    else:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def local_mesh(axes: MeshAxes | None = None):
+    """Single-host mesh over local devices; default one "data" axis."""
+    import jax
+
+    devices = jax.local_devices()
+    if axes is None:
+        axes = [("data", len(devices))]
+    return build_mesh(axes, devices)
+
+
+def topology_from_registry(entries: Mapping[str, str]) -> dict[str, MeshCoord]:
+    """Controller ID -> ICI coordinate from registry entries.
+
+    ``entries`` is the {path: value} map returned by GetValues("") (see
+    oim_tpu/registry/db.py get_registry_entries); only ``<id>/mesh`` keys
+    participate.
+    """
+    topo: dict[str, MeshCoord] = {}
+    for path, value in entries.items():
+        parts = path.split("/")
+        if len(parts) == 2 and parts[1] == REGISTRY_MESH:
+            topo[parts[0]] = MeshCoord.parse(value)
+    return topo
+
+
+def mesh_from_topology(
+    topology: Mapping[str, MeshCoord],
+    axes: MeshAxes,
+    devices: Sequence | None = None,
+):
+    """Build a mesh whose device order follows the registry's coordinates.
+
+    Devices are sorted by (x, y, z, core) of their host controller's
+    registered coordinate, so a contiguous span of any mesh axis maps to a
+    contiguous span of the physical torus. Local devices whose own
+    ``device.coords`` disagree with the registry raise — the reconciliation
+    check of SURVEY.md section 7.4 item 6 (registry truth must agree with
+    ``jax.devices()``).
+    """
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+
+    def sort_key(dev):
+        coords = getattr(dev, "coords", None)
+        if coords is not None:
+            core = getattr(dev, "core_on_chip", 0)
+            return tuple(coords) + (core,)
+        return (dev.id,)
+
+    on_tpu = devices and devices[0].platform == "tpu"
+    if on_tpu and topology:
+        registered = {
+            (c.x, c.y, c.z) for c in topology.values() if c.x >= 0 and c.y >= 0
+        }
+        local = {tuple(getattr(d, "coords", ())) [:3] for d in devices}
+        local = {t + (0,) * (3 - len(t)) for t in local if t}
+        missing = local - registered
+        if registered and missing:
+            raise ValueError(
+                "local TPU coordinates not present in registry topology: "
+                f"{sorted(missing)} (registered: {sorted(registered)})"
+            )
+    devices.sort(key=sort_key)
+    return build_mesh(axes, devices)
+
+
+def default_axes(
+    n_devices: int,
+    data: int = 0,
+    fsdp: int = 1,
+    seq: int = 1,
+    model: int = 1,
+) -> list[tuple[str, int]]:
+    """Fill the ``data`` axis with whatever the other axes leave over."""
+    rest = fsdp * seq * model
+    if data == 0:
+        if n_devices % rest:
+            raise ValueError(f"{n_devices} devices not divisible by {rest}")
+        data = n_devices // rest
+    return [("data", data), ("fsdp", fsdp), ("seq", seq), ("model", model)]

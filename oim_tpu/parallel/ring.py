@@ -1,0 +1,158 @@
+"""Sequence parallelism for long context: ring attention and Ulysses.
+
+Long sequences are sharded over the ``seq`` mesh axis. Two interchangeable
+attention strategies:
+
+- **Ring attention** (`ring_attention`): K/V shards rotate around the ring
+  with ``lax.ppermute`` while each chip accumulates its queries' attention
+  with an online (log-sum-exp-carrying) softmax. Communication of the next
+  K/V block overlaps the current block's matmuls — XLA schedules the
+  ppermute concurrently because the compute consumes the *current* block.
+  Memory per chip is O(T/n), enabling context lengths no single HBM holds.
+
+- **Ulysses** (`ulysses_attention`): two ``all_to_all``s swap the sharded
+  dimension from sequence to heads, run dense local attention, and swap
+  back. Cheaper collectives for moderate sequence lengths; requires
+  heads % seq_axis_size == 0.
+
+The reference has no sequence dimension (SURVEY.md section 5.7); its closest
+shape is chunked movement of a large object through bounded staging slots
+(SCSI targets 0..7, controller.go:127-148) — here the bounded resource is
+HBM and the chunks ride the ICI ring.
+
+All shapes are [batch, seq, heads, head_dim] per chip.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_accum(q, k, v, o, m, l, q_off, k_off, causal, scale):
+    """One online-softmax accumulation step.
+
+    q: [B, Tq, H, D]; k, v: [B, Tk, H, D]
+    o: [B, Tq, H, D] f32 numerator; m, l: [B, Tq, H] f32 running max / denom.
+    """
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale  # [B, H, Tq, Tk]
+    if causal:
+        q_pos = q_off + jnp.arange(q.shape[1])
+        k_pos = k_off + jnp.arange(k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+    block_max = jnp.max(scores, axis=-1)  # [B, H, Tq]
+    m_bhq = jnp.moveaxis(m, -1, 1)  # [B, H, Tq]
+    m_new = jnp.maximum(m_bhq, block_max)
+    p = jnp.exp(scores - m_new[..., None])
+    if causal:
+        p = jnp.where(mask[None, None], p, 0.0)
+    correction = jnp.exp(m_bhq - m_new)  # [B, H, Tq]
+    l_new = jnp.moveaxis(l, -1, 1) * correction + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o_new = o * jnp.moveaxis(correction, 1, -1)[..., None] + pv
+    return o_new, jnp.moveaxis(m_new, 1, -1), jnp.moveaxis(l_new, 1, -1)
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True):
+    """Ring attention over the ``axis_name`` mesh axis.
+
+    Must run inside shard_map/jit with ``axis_name`` bound; q/k/v are the
+    local sequence shards [B, T_local, H, D]. Returns [B, T_local, H, D] in
+    q's dtype.
+    """
+    from oim_tpu.parallel.collectives import ppermute_ring
+
+    size = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    scale = q.shape[-1] ** -0.5
+
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    m0 = jnp.full(q.shape[:3], NEG_INF, jnp.float32)  # [B, Tq, H]
+    l0 = jnp.zeros(q.shape[:3], jnp.float32)
+
+    def step(carry, i):
+        o, m, l, k_cur, v_cur = carry
+        # Rotate first: the sends depend only on k_cur/v_cur, so XLA overlaps
+        # them with the block matmuls below.
+        k_next = ppermute_ring(k_cur, axis_name)
+        v_next = ppermute_ring(v_cur, axis_name)
+        src = (my - i) % size  # whose K/V shard we currently hold
+        o, m, l = _block_accum(
+            q, k_cur, v_cur, o, m, l,
+            q_off=my * t_local, k_off=src * t_local,
+            causal=causal, scale=scale,
+        )
+        return (o, m, l, k_next, v_next), None
+
+    (o, m, l, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k, v), jnp.arange(size)
+    )
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = True):
+    """All-to-all (DeepSpeed-Ulysses-style) sequence-parallel attention.
+
+    Swaps sharding seq->heads with one tiled all_to_all each way; local
+    attention in between sees the full sequence for heads/size heads.
+    """
+    size = lax.psum(1, axis_name)  # concrete under shard_map
+    if q.shape[2] % size:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[2]}) divisible by the "
+            f"{axis_name!r} axis size ({size})"
+        )
+
+    def seq_to_heads(x):  # [B, T/s, H, D] -> [B, T, H/s, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):  # [B, T, H/s, D] -> [B, T/s, H, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    from oim_tpu.ops.attention import attention as local_attention
+
+    out = local_attention(qg, kg, vg, causal=causal)
+    return heads_to_seq(out)
+
+
+def make_sequence_parallel_attention(
+    mesh, kind: str = "ring", axis: str = "seq", causal: bool = True,
+    batch_axes: tuple[str, ...] | None = None,
+):
+    """shard_map-wrapped sequence-parallel attention over ``mesh``.
+
+    Batch rides ``batch_axes`` (default: every mesh axis except ``axis`` and
+    the tensor-parallel axes "model"/"expert"); sequence is sharded over
+    ``axis``. Returns fn(q, k, v) on globally-shaped arrays.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    inner = ring_attention if kind == "ring" else ulysses_attention
+    if batch_axes is None:
+        batch_axes = tuple(
+            n for n in mesh.axis_names if n not in (axis, "model", "expert")
+        )
+    spec = P(batch_axes or None, axis, None, None)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    def fn(q, k, v):
+        return inner(q, k, v, axis_name=axis, causal=causal)
+
+    return fn

@@ -1,0 +1,141 @@
+"""Named sharding rules: logical array dimensions -> mesh axes.
+
+Models annotate every parameter/activation dimension with a *logical* name
+(``"batch"``, ``"embed"``, ``"heads"``, ...); a ``ShardingRules`` table maps
+logical names to mesh axis names (or None = replicate). Changing the
+parallelism strategy (DP -> FSDP -> TP/SP) is a rules change, not a model
+change — the named-axes recipe of the scaling book, kept deliberately simple
+(no flax metadata machinery; rules are plain dicts over plain pytrees).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+# Logical dimension names used by the models in oim_tpu/models.
+BATCH = "batch"
+SEQ = "sequence"
+EMBED = "embed"
+HEAD = "heads"
+KV_HEAD = "kv_heads"
+HEAD_DIM = "head_dim"
+MLP = "mlp"
+VOCAB = "vocab"
+EXPERT = "expert"
+CONV_IN = "conv_in"
+CONV_OUT = "conv_out"
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    """logical name -> mesh axis name (or tuple of axes, or None)."""
+
+    rules: tuple[tuple[str, Any], ...]
+
+    @classmethod
+    def of(cls, **rules: Any) -> "ShardingRules":
+        return cls(tuple(rules.items()))
+
+    def axis_for(self, logical: str | None):
+        if logical is None:
+            return None
+        for name, axis in self.rules:
+            if name == logical:
+                return axis
+        return None
+
+    def spec(self, logical_axes: tuple[str | None, ...]):
+        from jax.sharding import PartitionSpec
+
+        return PartitionSpec(*(self.axis_for(a) for a in logical_axes))
+
+
+# Pure data parallelism: only the batch is split.
+DP_RULES = ShardingRules.of(**{BATCH: "data"})
+
+# FSDP: batch split over (data, fsdp); parameters sharded over fsdp along
+# their largest dimension (embed for transformers, conv_out for convnets).
+FSDP_RULES = ShardingRules.of(
+    **{
+        BATCH: ("data", "fsdp"),
+        EMBED: "fsdp",
+        CONV_OUT: "fsdp",
+    }
+)
+
+# Megatron-style tensor parallelism + sequence parallelism for long context:
+# heads/mlp/vocab split over "model", the sequence dimension over "seq".
+TP_SP_RULES = ShardingRules.of(
+    **{
+        BATCH: ("data", "fsdp"),
+        SEQ: "seq",
+        EMBED: "fsdp",
+        HEAD: "model",
+        KV_HEAD: "model",
+        MLP: "model",
+        VOCAB: "model",
+        EXPERT: "expert",
+    }
+)
+
+
+def logical_sharding(mesh, rules: ShardingRules, logical_axes):
+    """NamedSharding for one array's logical axes."""
+    from jax.sharding import NamedSharding
+
+    return NamedSharding(mesh, rules.spec(tuple(logical_axes)))
+
+
+def shard_params(mesh, rules: ShardingRules, params, logical_axes):
+    """Apply shardings to a parameter pytree.
+
+    ``logical_axes`` is a matching pytree whose leaves are tuples of logical
+    dimension names (models provide it, e.g. models.llama.param_logical_axes).
+    """
+    import jax
+
+    def place(p, axes):
+        return jax.device_put(p, logical_sharding(mesh, rules, axes))
+
+    return jax.tree.map(place, params, logical_axes)
+
+
+def param_shardings(mesh, rules: ShardingRules, logical_axes):
+    """Pytree of NamedShardings (for jit in_shardings/out_shardings)."""
+    import jax
+
+    return jax.tree.map(
+        lambda axes: logical_sharding(mesh, rules, axes),
+        logical_axes,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def shard_batch(mesh, rules: ShardingRules, batch, logical_axes=None):
+    """Place a host batch onto the mesh split along the batch dimension.
+
+    Default logical layout: leading dim = batch, rest replicated.
+    """
+    import jax
+
+    def place(x):
+        axes = (BATCH,) + (None,) * (x.ndim - 1)
+        return jax.device_put(x, logical_sharding(mesh, rules, axes))
+
+    if logical_axes is not None:
+        return jax.tree.map(
+            lambda x, a: jax.device_put(x, logical_sharding(mesh, rules, a)),
+            batch,
+            logical_axes,
+        )
+    return jax.tree.map(place, batch)
+
+
+def constrain(x, mesh, rules: ShardingRules, logical_axes):
+    """with_sharding_constraint by logical names (inside jit)."""
+    import jax
+
+    return jax.lax.with_sharding_constraint(
+        x, logical_sharding(mesh, rules, tuple(logical_axes))
+    )

@@ -14,7 +14,12 @@ otherwise, mirroring the reference's TEST_SPDK_VHOST_* env gating
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the environment preselects a TPU platform: ring-0/1
+# tests always run on the virtual CPU mesh; ring-2 tests gate on OIM_TEST_TPU.
+# The env var alone is not enough — the machine's TPU boot hook
+# (sitecustomize) overrides the jax config after env parsing, so the config
+# itself is re-overridden below, before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -22,6 +27,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,143 @@
+"""Ring-0 tests for oim_tpu.ops: pallas kernels (interpret mode) vs the jnp
+reference math."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oim_tpu.ops import (
+    apply_rope,
+    attention,
+    flash_attention,
+    mha_reference,
+    layernorm,
+    rmsnorm,
+    rope_frequencies,
+    softmax_cross_entropy,
+)
+
+
+def _qkv(b=2, t=256, h=4, hkv=None, d=64, seed=0, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    hkv = hkv or h
+    q = jnp.asarray(rng.randn(b, t, h, d), dtype)
+    k = jnp.asarray(rng.randn(b, t, hkv, d), dtype)
+    v = jnp.asarray(rng.randn(b, t, hkv, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference(causal):
+    q, k, v = _qkv()
+    ref = mha_reference(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal, None, 64, 64, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_uneven_blocks_causal():
+    # block_k > block_q: some k-blocks fully mask some q rows; exercises the
+    # fully-masked-row path of the online softmax.
+    q, k, v = _qkv(t=256)
+    ref = mha_reference(q, k, v, causal=True)
+    out = flash_attention(q, k, v, True, None, 32, 128, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_gradients_flow():
+    q, k, v = _qkv(b=1, t=64, h=2, d=32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True, None, 32, 32, True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_causal_decode_attends_full_cache():
+    # tq=1 vs tk=64 (KV-cache decode): bottom-right-aligned mask must let the
+    # single query attend to ALL keys, i.e. match non-causal attention.
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(2, 1, 4, 32), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 64, 4, 32), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 64, 4, 32), jnp.float32)
+    causal = mha_reference(q, k, v, causal=True)
+    full = mha_reference(q, k, v, causal=False)
+    np.testing.assert_allclose(np.asarray(causal), np.asarray(full), atol=1e-6)
+
+
+def test_flash_decode_shape_causal():
+    rng = np.random.RandomState(8)
+    q = jnp.asarray(rng.randn(1, 32, 2, 16), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 128, 2, 16), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 128, 2, 16), jnp.float32)
+    ref = mha_reference(q, k, v, causal=True)
+    out = flash_attention(q, k, v, True, None, 32, 32, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_attention_dispatch_gqa():
+    q, k, v = _qkv(h=8, hkv=2)
+    ref = mha_reference(q, k, v, causal=True)
+    out = attention(q, k, v, causal=True)  # CPU -> reference path
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+def test_rmsnorm():
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 8, 16), jnp.float32)
+    w = jnp.ones(16) * 2.0
+    out = rmsnorm(x, w)
+    expected = x / np.sqrt(np.mean(np.asarray(x) ** 2, -1, keepdims=True) + 1e-6) * 2.0
+    np.testing.assert_allclose(np.asarray(out), expected, atol=1e-5)
+
+
+def test_layernorm_zero_mean_unit_var():
+    x = jnp.asarray(np.random.RandomState(0).randn(4, 16) * 3 + 5, jnp.float32)
+    out = np.asarray(layernorm(x, jnp.ones(16), jnp.zeros(16)))
+    np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(out.var(-1), 1.0, atol=1e-3)
+
+
+def test_rope_preserves_norm_and_relative_phase():
+    cos, sin = rope_frequencies(32, 128)
+    x = jnp.asarray(np.random.RandomState(1).randn(2, 128, 4, 32), jnp.float32)
+    out = apply_rope(x, cos, sin)
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(out), axis=-1),
+        np.linalg.norm(np.asarray(x), axis=-1),
+        atol=1e-4,
+    )
+    # Position 0 is the identity rotation.
+    np.testing.assert_allclose(
+        np.asarray(out[:, 0]), np.asarray(x[:, 0]), atol=1e-6
+    )
+
+
+def test_rope_explicit_positions():
+    cos, sin = rope_frequencies(16, 64)
+    x = jnp.asarray(np.random.RandomState(2).randn(1, 8, 2, 16), jnp.float32)
+    default = apply_rope(x, cos, sin)
+    explicit = apply_rope(x, cos, sin, positions=jnp.arange(8))
+    np.testing.assert_allclose(np.asarray(default), np.asarray(explicit), atol=1e-6)
+
+
+def test_cross_entropy_matches_naive():
+    rng = np.random.RandomState(3)
+    logits = jnp.asarray(rng.randn(6, 10), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 10, 6))
+    loss = softmax_cross_entropy(logits, labels)
+    p = jax.nn.softmax(logits, -1)
+    naive = -np.mean(np.log(np.asarray(p)[np.arange(6), np.asarray(labels)]))
+    np.testing.assert_allclose(float(loss), naive, atol=1e-5)
+
+
+def test_cross_entropy_ignore_index():
+    logits = jnp.zeros((4, 5), jnp.float32)
+    labels = jnp.asarray([1, 2, -1, -1])
+    loss = softmax_cross_entropy(logits, labels, ignore_index=-1)
+    np.testing.assert_allclose(float(loss), np.log(5.0), atol=1e-5)

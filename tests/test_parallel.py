@@ -1,0 +1,124 @@
+"""Ring-0/1 tests for oim_tpu.parallel on the virtual 8-device CPU mesh
+(conftest.py sets xla_force_host_platform_device_count=8 — the analog of the
+reference's 4-VM QEMU rig, SURVEY.md section 4.3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from oim_tpu.common.meshcoord import MeshCoord
+from oim_tpu.ops import mha_reference
+from oim_tpu.parallel import (
+    build_mesh,
+    local_mesh,
+    mesh_from_topology,
+    topology_from_registry,
+)
+from oim_tpu.parallel.mesh import default_axes
+from oim_tpu.parallel.ring import make_sequence_parallel_attention
+from oim_tpu.parallel.sharding import (
+    BATCH,
+    DP_RULES,
+    EMBED,
+    TP_SP_RULES,
+    logical_sharding,
+    shard_batch,
+)
+
+
+def test_build_mesh_sizes():
+    mesh = build_mesh([("data", 2), ("model", 4)])
+    assert mesh.shape == {"data": 2, "model": 4}
+    with pytest.raises(ValueError):
+        build_mesh([("data", 3)])
+
+
+def test_local_mesh_default():
+    mesh = local_mesh()
+    assert mesh.shape == {"data": 8}
+
+
+def test_default_axes():
+    assert default_axes(8, model=2) == [
+        ("data", 4), ("fsdp", 1), ("seq", 1), ("model", 2)
+    ]
+    with pytest.raises(ValueError):
+        default_axes(8, model=3)
+
+
+def test_topology_from_registry():
+    entries = {
+        "host-0/mesh": "0,0,0",
+        "host-0/address": "dns:///h0:8999",
+        "host-1/mesh": "1,0,0",
+    }
+    topo = topology_from_registry(entries)
+    assert topo == {"host-0": MeshCoord(0, 0, 0), "host-1": MeshCoord(1, 0, 0)}
+
+
+def test_mesh_from_topology_cpu():
+    topo = {"host-0": MeshCoord(0, 0, 0)}
+    mesh = mesh_from_topology(topo, [("data", 8)])
+    assert mesh.shape == {"data": 8}
+    # CPU devices sort by id.
+    assert [d.id for d in mesh.devices.flat] == list(range(8))
+
+
+def test_sharding_rules_spec():
+    from jax.sharding import PartitionSpec as P
+
+    assert DP_RULES.spec((BATCH, None, None)) == P("data", None, None)
+    assert TP_SP_RULES.spec((BATCH, EMBED)) == P(("data", "fsdp"), "fsdp")
+
+
+def test_shard_batch_places_on_mesh():
+    mesh = local_mesh([("data", 8)])
+    batch = {"x": np.ones((16, 4), np.float32)}
+    placed = shard_batch(mesh, DP_RULES, batch)
+    x = placed["x"]
+    assert x.sharding.spec == logical_sharding(mesh, DP_RULES, (BATCH, None)).spec
+    assert len(x.addressable_shards) == 8
+    assert x.addressable_shards[0].data.shape == (2, 4)
+
+
+@pytest.mark.parametrize("kind", ["ring", "ulysses"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_sequence_parallel_attention_matches_reference(kind, causal):
+    mesh = build_mesh([("data", 2), ("fsdp", 1), ("seq", 4)])
+    rng = np.random.RandomState(0)
+    b, t, h, d = 4, 64, 4, 16
+    q = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    fn = make_sequence_parallel_attention(mesh, kind=kind, causal=causal)
+    out = jax.jit(fn)(q, k, v)
+    ref = mha_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_sequence_parallel_custom_mesh_axes():
+    # A mesh without an "fsdp" axis must work: batch axes are derived from
+    # the mesh itself.
+    mesh = build_mesh([("data", 2), ("seq", 4)])
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(2, 32, 2, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 32, 2, 8), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 32, 2, 8), jnp.float32)
+    fn = make_sequence_parallel_attention(mesh, kind="ring", causal=True)
+    out = jax.jit(fn)(q, k, v)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_attention_long_context_gradients():
+    mesh = build_mesh([("data", 1), ("fsdp", 1), ("seq", 8)])
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(1, 128, 2, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 128, 2, 8), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 128, 2, 8), jnp.float32)
+    ring = make_sequence_parallel_attention(mesh, kind="ring", causal=True)
+
+    g_ring = jax.grad(lambda q: jnp.sum(ring(q, k, v) ** 2))(q)
+    g_ref = jax.grad(lambda q: jnp.sum(mha_reference(q, k, v, True) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref), atol=1e-4)
