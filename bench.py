@@ -1,0 +1,152 @@
+"""Benchmark: ResNet-50 images/sec/chip, fed solely through the OIM feeder
+path (BASELINE.md forward baseline; the reference publishes no numbers, so
+vs_baseline is measured MFU against the north-star 70% target).
+
+Flow (config-3/4 shape, single chip):
+1. Write a synthetic uint8 image volume to disk.
+2. Publish it through the control plane: in-process controller + TPUBackend,
+   MapVolume(file) -> HBM-resident jax.Array (C++ staging engine underneath
+   when built) — records stage GB/s.
+3. Train ResNet-50 (bf16) on device-resident slices of that volume;
+   measure steady-state images/sec and MFU.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import lax
+
+    on_tpu = jax.default_backend() == "tpu"
+    # CPU fallback keeps the bench runnable anywhere (tiny sizes). On the
+    # tunneled dev chip each dispatch costs ~50-100ms RTT, so the batch is
+    # large to amortize it.
+    if on_tpu:
+        n_images, image, batch, warmup, steps = 1024, 224, 512, 3, 10
+    else:
+        n_images, image, batch, warmup, steps = 64, 64, 16, 1, 3
+
+    from oim_tpu.controller.controller import ControllerService
+    from oim_tpu.controller.tpu_backend import TPUBackend
+    from oim_tpu.feeder import Feeder
+    from oim_tpu.models import resnet
+    from oim_tpu.ops.losses import softmax_cross_entropy
+    from oim_tpu.spec import pb
+    from oim_tpu.train.state import make_optimizer
+    from oim_tpu.train.trainer import peak_flops_per_device
+
+    # Build the C++ staging engine up front (controllers never build from
+    # inside an RPC; the bench is its own process startup).
+    from oim_tpu.data import staging
+
+    staging.build()
+
+    # ---- 1. synthetic image volume on disk -----------------------------
+    rng = np.random.RandomState(0)
+    raw = rng.randint(0, 256, (n_images, image, image, 3), dtype=np.uint8)
+    tmp = tempfile.NamedTemporaryFile(suffix=".bin", delete=False)
+    tmp.write(raw.tobytes())
+    tmp.close()
+
+    # ---- 2. stage through the control plane ----------------------------
+    controller = ControllerService(TPUBackend())
+    feeder = Feeder(controller=controller)
+    t0 = time.monotonic()
+    pub = feeder.publish(
+        pb.MapVolumeRequest(
+            volume_id="bench-images",
+            spec=pb.ArraySpec(
+                shape=[n_images, image, image, 3], dtype="uint8"
+            ),
+            file=pb.FileParams(path=tmp.name, format="raw"),
+        ),
+        timeout=300.0,
+    )
+    stage_s = time.monotonic() - t0
+    stage_gbps = pub.bytes / stage_s / 1e9
+    data = pub.array  # device-resident uint8 [N, H, W, 3]
+    os.unlink(tmp.name)
+
+    # ---- 3. ResNet-50 train steps on the staged volume -----------------
+    cfg = resnet.Config(num_classes=1000, dtype=jnp.bfloat16)
+    params, bn_state = resnet.init(jax.random.PRNGKey(0), cfg)
+    tx = make_optimizer(lr=1e-3, warmup_steps=10, total_steps=100)
+    opt_state = tx.init(params)
+    labels = jnp.asarray(rng.randint(0, 1000, (n_images,)), jnp.int32)
+
+    def train_step(params, bn_state, opt_state, data, labels, start):
+        imgs = lax.dynamic_slice_in_dim(data, start, batch)
+        ys = lax.dynamic_slice_in_dim(labels, start, batch)
+        imgs = imgs.astype(jnp.bfloat16) / 255.0
+
+        def loss_fn(params, bn_state):
+            logits, new_bn = resnet.apply(params, bn_state, imgs, cfg, training=True)
+            return softmax_cross_entropy(logits, ys), new_bn
+
+        (loss, new_bn), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, bn_state)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_bn, new_opt, loss
+
+    jstep = jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    starts = [int(i * batch % (n_images - batch + 1)) for i in range(warmup + steps)]
+    for i in range(warmup):
+        params, bn_state, opt_state, loss = jstep(
+            params, bn_state, opt_state, data, labels, starts[i])
+    # Fetch the VALUE to force completion: on remote-execution backends
+    # block_until_ready returns before the computation has run.
+    float(loss)
+    t0 = time.monotonic()
+    for i in range(steps):
+        params, bn_state, opt_state, loss = jstep(
+            params, bn_state, opt_state, data, labels, starts[warmup + i])
+    float(loss)
+    dt = (time.monotonic() - t0) / steps
+
+    images_per_sec = batch / dt
+    flops = 3 * resnet.num_flops_per_image(image) * batch
+    peak = peak_flops_per_device()
+    mfu = flops / dt / peak if peak else 0.0
+    # North star: >=70% MFU through the OIM feed path (BASELINE.md).
+    vs_baseline = mfu / 0.70 if peak else 1.0
+
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(images_per_sec, 2),
+        "unit": "images/s",
+        "vs_baseline": round(vs_baseline, 4),
+        "extras": {
+            "stage_gbps": round(stage_gbps, 3),
+            "staged_bytes": int(pub.bytes),
+            "mfu": round(mfu, 4),
+            "step_seconds": round(dt, 5),
+            "batch": batch,
+            "image": image,
+            "backend": jax.default_backend(),
+            "device": jax.devices()[0].device_kind,
+            "final_loss": round(float(loss), 4),
+        },
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

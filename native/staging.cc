@@ -1,0 +1,313 @@
+// OIM-TPU staging engine: the data-plane role SPDK's vhost daemon plays in
+// the reference (vendor/github.com/spdk/spdk app/vhost; SURVEY.md §2.8),
+// rebuilt for the host->HBM path: pinned host buffers + read-ahead worker
+// threads feeding double-buffered chunks that Python hands to the PJRT
+// device transfer (jax.device_put) while the next chunk is still on disk.
+//
+// The DPDK hugepage environment maps to mlock'ed, page-aligned allocations
+// (madvise(HUGEPAGE) where available); the JSON-RPC control socket maps to
+// this flat C ABI consumed over ctypes (oim_tpu/data/staging.py) — an
+// in-process "socket" with the same command surface shape.
+//
+// Build: make -C native   (g++ -O3 -fPIC -shared -pthread)
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr size_t kAlign = 2 * 1024 * 1024;  // hugepage-aligned
+
+struct PinnedBuf {
+  uint8_t* data = nullptr;
+  size_t cap = 0;
+  size_t len = 0;       // valid bytes after a read
+  int64_t offset = -1;  // file offset this chunk came from
+
+  void alloc(size_t n, bool pin) {
+    cap = n;
+    void* p = nullptr;
+    if (posix_memalign(&p, kAlign, n) != 0) {
+      p = malloc(n);
+    }
+    data = static_cast<uint8_t*>(p);
+#ifdef MADV_HUGEPAGE
+    madvise(data, n, MADV_HUGEPAGE);
+#endif
+    if (pin) {
+      // Best-effort: RLIMIT_MEMLOCK may cap this; staging still works
+      // unpinned, just with pageable-memory DMA speed.
+      mlock(data, n);
+    }
+  }
+  void release() {
+    if (data) {
+      munlock(data, cap);
+      free(data);
+      data = nullptr;
+    }
+  }
+};
+
+// A read-ahead stream over one file: N pinned buffers cycle between a
+// filler thread (pread) and the consumer (Python -> device_put).
+struct Stream {
+  int fd = -1;
+  size_t chunk = 0;
+  int64_t file_size = 0;
+  int64_t read_pos = 0;   // next offset the filler will read
+  std::vector<PinnedBuf> bufs;
+  std::deque<PinnedBuf*> free_q;   // filler takes from here
+  std::deque<PinnedBuf*> ready_q;  // consumer takes from here
+  std::mutex mu;
+  std::condition_variable cv_free, cv_ready;
+  std::thread filler;
+  std::atomic<bool> stop{false};
+  std::string error;
+  // throughput accounting
+  std::atomic<int64_t> bytes_read{0};
+  std::chrono::steady_clock::time_point t0;
+
+  ~Stream() { close(); }
+
+  bool open(const char* path, size_t chunk_bytes, int n_buffers, bool pin) {
+    fd = ::open(path, O_RDONLY);
+    if (fd < 0) {
+      error = std::string("open failed: ") + strerror(errno);
+      return false;
+    }
+    struct stat st;
+    if (fstat(fd, &st) != 0) {
+      error = std::string("fstat failed: ") + strerror(errno);
+      return false;
+    }
+    file_size = st.st_size;
+#ifdef POSIX_FADV_SEQUENTIAL
+    posix_fadvise(fd, 0, 0, POSIX_FADV_SEQUENTIAL);
+#endif
+    chunk = chunk_bytes;
+    bufs.resize(n_buffers);
+    for (auto& b : bufs) {
+      b.alloc(chunk_bytes, pin);
+      free_q.push_back(&b);
+    }
+    t0 = std::chrono::steady_clock::now();
+    filler = std::thread([this] { fill_loop(); });
+    return true;
+  }
+
+  void fill_loop() {
+    for (;;) {
+      PinnedBuf* b = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_free.wait(lk, [&] { return stop.load() || !free_q.empty(); });
+        if (stop.load()) return;
+        if (read_pos >= file_size) {
+          // EOF sentinel: a null entry on the ready queue.
+          ready_q.push_back(nullptr);
+          cv_ready.notify_all();
+          return;
+        }
+        b = free_q.front();
+        free_q.pop_front();
+      }
+      size_t want = chunk;
+      if (read_pos + static_cast<int64_t>(want) > file_size)
+        want = static_cast<size_t>(file_size - read_pos);
+      size_t got = 0;
+      while (got < want) {
+        ssize_t n = pread(fd, b->data + got, want - got, read_pos + got);
+        if (n < 0) {
+          if (errno == EINTR) continue;
+          std::lock_guard<std::mutex> lk(mu);
+          error = std::string("pread failed: ") + strerror(errno);
+          ready_q.push_back(nullptr);
+          cv_ready.notify_all();
+          return;
+        }
+        if (n == 0) break;  // truncated file
+        got += static_cast<size_t>(n);
+      }
+      b->len = got;
+      b->offset = read_pos;
+      read_pos += static_cast<int64_t>(got);
+      bytes_read.fetch_add(static_cast<int64_t>(got));
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        ready_q.push_back(b);
+      }
+      cv_ready.notify_all();
+    }
+  }
+
+  // Returns chunk length; 0 on EOF; -1 on error. *data/*offset set on >0.
+  int64_t next(void** data, int64_t* offset) {
+    std::unique_lock<std::mutex> lk(mu);
+    cv_ready.wait(lk, [&] { return !ready_q.empty(); });
+    PinnedBuf* b = ready_q.front();
+    ready_q.pop_front();
+    if (b == nullptr) return error.empty() ? 0 : -1;
+    *data = b->data;
+    *offset = b->offset;
+    return static_cast<int64_t>(b->len);
+  }
+
+  void release_buf(void* data) {
+    std::lock_guard<std::mutex> lk(mu);
+    for (auto& b : bufs) {
+      if (b.data == data) {
+        free_q.push_back(&b);
+        cv_free.notify_all();
+        return;
+      }
+    }
+  }
+
+  double gbps() const {
+    auto dt = std::chrono::duration<double>(
+                  std::chrono::steady_clock::now() - t0).count();
+    return dt > 0 ? bytes_read.load() / dt / 1e9 : 0.0;
+  }
+
+  void close() {
+    stop.store(true);
+    cv_free.notify_all();
+    if (filler.joinable()) filler.join();
+    for (auto& b : bufs) b.release();
+    bufs.clear();
+    if (fd >= 0) {
+      ::close(fd);
+      fd = -1;
+    }
+  }
+};
+
+thread_local std::string g_error;
+
+}  // namespace
+
+extern "C" {
+
+// ---- version / capability probe --------------------------------------
+int oim_staging_abi_version() { return 1; }
+
+// ---- pinned allocations ----------------------------------------------
+void* oim_pinned_alloc(size_t nbytes) {
+  PinnedBuf b;
+  b.alloc(nbytes, /*pin=*/true);
+  return b.data;  // ownership passes to caller; cap tracked by caller
+}
+
+void oim_pinned_free(void* p, size_t nbytes) {
+  if (p) {
+    munlock(p, nbytes);
+    free(p);
+  }
+}
+
+// ---- whole-file parallel read ----------------------------------------
+// Reads [offset, offset+len) of path into dst using n_threads preads.
+// Returns bytes read, or -1 (error text via oim_last_error).
+int64_t oim_read_into(const char* path, void* dst, int64_t offset,
+                      int64_t len, int n_threads) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) {
+    g_error = std::string("open failed: ") + strerror(errno);
+    return -1;
+  }
+  if (n_threads < 1) n_threads = 1;
+  std::atomic<int64_t> total{0};
+  std::atomic<bool> failed{false};
+  int64_t per = (len + n_threads - 1) / n_threads;
+  // Align spans to 4 MiB so each thread issues large sequential preads.
+  constexpr int64_t kSpanAlign = 4 << 20;
+  per = ((per + kSpanAlign - 1) / kSpanAlign) * kSpanAlign;
+  std::vector<std::thread> workers;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t begin = t * per;
+    if (begin >= len) break;
+    int64_t end = std::min(begin + per, len);
+    workers.emplace_back([&, begin, end] {
+      int64_t got = 0;
+      while (begin + got < end && !failed.load()) {
+        ssize_t n = pread(fd, static_cast<uint8_t*>(dst) + begin + got,
+                          static_cast<size_t>(end - begin - got),
+                          offset + begin + got);
+        if (n < 0) {
+          if (errno == EINTR) continue;
+          g_error = std::string("pread failed: ") + strerror(errno);
+          failed.store(true);
+          return;
+        }
+        if (n == 0) break;
+        got += n;
+      }
+      total.fetch_add(got);
+    });
+  }
+  for (auto& w : workers) w.join();
+  ::close(fd);
+  return failed.load() ? -1 : total.load();
+}
+
+int64_t oim_file_size(const char* path) {
+  struct stat st;
+  if (stat(path, &st) != 0) {
+    g_error = std::string("stat failed: ") + strerror(errno);
+    return -1;
+  }
+  return st.st_size;
+}
+
+const char* oim_last_error() { return g_error.c_str(); }
+
+// ---- read-ahead chunk streams ----------------------------------------
+void* oim_stream_open(const char* path, size_t chunk_bytes, int n_buffers,
+                      int pin) {
+  auto* s = new Stream();
+  if (!s->open(path, chunk_bytes, n_buffers < 2 ? 2 : n_buffers, pin != 0)) {
+    g_error = s->error;
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int64_t oim_stream_next(void* stream, void** data, int64_t* offset) {
+  auto* s = static_cast<Stream*>(stream);
+  int64_t n = s->next(data, offset);
+  if (n < 0) g_error = s->error;
+  return n;
+}
+
+void oim_stream_release(void* stream, void* data) {
+  static_cast<Stream*>(stream)->release_buf(data);
+}
+
+double oim_stream_gbps(void* stream) {
+  return static_cast<Stream*>(stream)->gbps();
+}
+
+int64_t oim_stream_file_size(void* stream) {
+  return static_cast<Stream*>(stream)->file_size;
+}
+
+void oim_stream_close(void* stream) { delete static_cast<Stream*>(stream); }
+
+}  // extern "C"

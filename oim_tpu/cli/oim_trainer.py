@@ -1,0 +1,176 @@
+"""oim-trainer: JAX training over OIM-staged data (new scope per
+BASELINE.json — the reference has no trainer; this is ``cmd/oim-trainer``).
+
+Data path options:
+- --synthetic (default): host-generated batches, for smoke runs/benchmarks.
+- --registry + --controller-id (+ --volume): publish the named volume
+  through the feeder (the NodePublishVolume analog) and train on the staged
+  array — the "CSI-mounted HBM shards" configuration.
+
+Mesh options: --mesh "data=4,model=2" (axis order = ICI locality order);
+default is pure DP over all visible devices. With --registry the mesh device
+order follows the registry's topology map (oim_tpu/parallel/mesh.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from oim_tpu.cli.common import add_common_flags, load_tls_flags, setup_logging
+from oim_tpu.common.logging import from_context
+from oim_tpu.train import TrainConfig, Trainer
+
+
+def parse_mesh(spec: str):
+    """'data=4,model=2' -> [("data", 4), ("model", 2)]."""
+    if not spec:
+        return None
+    axes = []
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        if not size:
+            raise SystemExit(f"bad --mesh component {part!r} (want name=size)")
+        axes.append((name.strip(), int(size)))
+    return axes
+
+
+def feeder_batches(args, cfg: TrainConfig, tls):
+    """Batches sliced from a feeder-published volume (config-3 style: the
+    whole shard lands in the training process, batches are views)."""
+    from oim_tpu.feeder import Feeder
+    from oim_tpu.spec import pb
+
+    feeder = Feeder(
+        registry_address=args.registry,
+        controller_id=args.controller_id,
+        tls=tls,
+    )
+    req = pb.MapVolumeRequest(volume_id=args.volume)
+    if args.volume_file:
+        req.file.path = args.volume_file
+        req.file.format = "npy" if args.volume_file.endswith(".npy") else "raw"
+    else:
+        req.malloc.SetInParent()
+    pub = feeder.publish(req, timeout=args.publish_timeout)
+    # Local mode hands back the live array; remote mode streams the data
+    # window through the proxy (ReadVolume).
+    data = np.asarray(pub.array) if pub.array is not None else feeder.fetch(
+        args.volume, timeout=args.publish_timeout)
+    from_context().info(
+        "volume published", volume=args.volume, shape=str(data.shape)
+    )
+    i = 0
+    if cfg.model.startswith("llama"):
+        tokens = data.reshape(-1)
+        span = cfg.seq_len + 1
+        n = (tokens.size // span) * span
+        tokens = tokens[:n].reshape(-1, span).astype(np.int32)
+        while True:
+            idx = np.arange(i, i + cfg.batch_size) % tokens.shape[0]
+            yield {"tokens": tokens[idx]}
+            i += cfg.batch_size
+    else:
+        images = data.astype(np.float32)
+        labels = np.zeros((images.shape[0],), np.int32)
+        while True:
+            idx = np.arange(i, i + cfg.batch_size) % images.shape[0]
+            yield {"images": images[idx], "labels": labels[idx]}
+            i += cfg.batch_size
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser("oim-trainer")
+    parser.add_argument("--model", default="llama-tiny",
+                        choices=("llama-tiny", "llama3-8b", "resnet50"))
+    parser.add_argument("--rules", default="dp", choices=("dp", "fsdp", "tp_sp"))
+    parser.add_argument("--seq-parallel", default="ring",
+                        choices=("ring", "ulysses"))
+    parser.add_argument("--mesh", default="", help="e.g. data=4,model=2")
+    parser.add_argument("--steps", type=int, default=100)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--seq-len", type=int, default=128)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--lr", type=float, default=3e-4)
+    parser.add_argument("--warmup-steps", type=int, default=100)
+    parser.add_argument("--log-every", type=int, default=10)
+    parser.add_argument("--checkpoint-dir", default="")
+    parser.add_argument("--checkpoint-every", type=int, default=0)
+    parser.add_argument("--metrics-port", type=int, default=-1,
+                        help=">=0 serves GET /metrics (0 = ephemeral port)")
+    parser.add_argument("--smoke", action="store_true",
+                        help="tiny model, 5 steps, CPU-friendly")
+    # Data source (feeder mode).
+    parser.add_argument("--synthetic", action="store_true", default=False)
+    parser.add_argument("--registry", default="")
+    parser.add_argument("--controller-id", default="")
+    parser.add_argument("--volume", default="train-data")
+    parser.add_argument("--volume-file", default="",
+                        help="stage this file as the training volume")
+    parser.add_argument("--publish-timeout", type=float, default=60.0)
+    parser.add_argument(
+        "--platform", default="",
+        help="force a jax platform (e.g. 'cpu' for a virtual multi-device "
+             "mesh via --xla_force_host_platform_device_count)",
+    )
+    add_common_flags(parser)
+    args = parser.parse_args(argv)
+    setup_logging(args)
+    log = from_context()
+
+    if args.platform:
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", args.platform)
+
+    if args.smoke:
+        import jax
+
+        args.model = "llama-tiny"
+        args.steps = min(args.steps, 5)
+        args.batch_size = min(args.batch_size, 2)
+        args.seq_len = min(args.seq_len, 32)
+        args.log_every = 1
+        if not args.mesh:
+            args.mesh = f"data={min(args.batch_size, len(jax.devices()))}"
+
+    cfg = TrainConfig(
+        model=args.model,
+        rules=args.rules,
+        seq_parallel=args.seq_parallel,
+        batch_size=args.batch_size,
+        seq_len=args.seq_len,
+        image_size=args.image_size,
+        lr=args.lr,
+        warmup_steps=args.warmup_steps,
+        total_steps=args.steps,
+        log_every=args.log_every,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+    )
+
+    server = None
+    if args.metrics_port >= 0:
+        from oim_tpu.common.metrics import MetricsServer
+
+        server = MetricsServer(port=args.metrics_port).start()
+        log.info("metrics", port=server.port)
+
+    data = None
+    if args.registry:
+        tls = load_tls_flags(args)
+        data = feeder_batches(args, cfg, tls)
+    elif not args.synthetic:
+        args.synthetic = True
+
+    trainer = Trainer(cfg, axes=parse_mesh(args.mesh))
+    loss = trainer.run(steps=args.steps, data=data)
+    log.info("done", final_loss=round(loss, 4))
+    if server is not None:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

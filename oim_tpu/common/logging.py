@@ -46,7 +46,9 @@ class Logger:
         fields: tuple[tuple[str, Any], ...] = (),
         _lock: threading.Lock | None = None,
     ):
-        self._output = output if output is not None else sys.stderr
+        # None = resolve sys.stderr at write time: a captured-at-construction
+        # stream may be replaced/closed later (pytest capsys, daemon redirects).
+        self._output = output
         self.level = level
         self._fields = fields
         self._lock = _lock or threading.Lock()
@@ -72,7 +74,11 @@ class Logger:
             parts.append("| " + " ".join(f"{k}: {v!r}" for k, v in all_fields))
         line = " ".join(parts) + "\n"
         with self._lock:
-            self._output.write(line)
+            out = self._output if self._output is not None else sys.stderr
+            try:
+                out.write(line)
+            except ValueError:
+                pass  # stream closed under us (interpreter/test teardown)
 
     def debug(self, msg: str, **fields: Any) -> None:
         self.log(DEBUG, msg, **fields)

@@ -1,0 +1,147 @@
+"""Metrics: thread-safe counters/gauges + a Prometheus-text HTTP endpoint.
+
+The reference vendors go-grpc-prometheus but never wires it (SURVEY.md
+section 5.5); the BASELINE metrics (stage GB/s, images/sec/chip) must be
+first-class here, so this is a real registry: controllers count staged
+bytes, the trainer publishes step time / throughput / MFU, and anything can
+scrape ``GET /metrics``.
+"""
+
+from __future__ import annotations
+
+import http.server
+import threading
+import time
+from typing import Iterable
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def render(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} counter"
+        yield f"{self.name} {self.value}"
+
+
+class Gauge(Counter):
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = value
+
+    def render(self) -> Iterable[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} gauge"
+        yield f"{self.name} {self.value}"
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: dict[str, Counter] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get(name, help_, Counter)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get(name, help_, Gauge)
+
+    def _get(self, name, help_, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help_)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise ValueError(f"metric {name!r} already registered as {type(m).__name__}")
+            return m
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: list[str] = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+DEFAULT = Registry()
+
+# Canonical framework metrics (names are API).
+STAGED_BYTES = DEFAULT.counter(
+    "oim_staged_bytes_total", "bytes staged into the backend memory domain")
+STAGE_SECONDS = DEFAULT.counter(
+    "oim_stage_seconds_total", "wall seconds spent staging")
+STAGE_GBPS = DEFAULT.gauge(
+    "oim_stage_gbps", "throughput of the most recent staging operation")
+TRAIN_STEP_SECONDS = DEFAULT.gauge(
+    "oim_train_step_seconds", "duration of the most recent training step")
+TRAIN_EXAMPLES_PER_SEC = DEFAULT.gauge(
+    "oim_train_examples_per_sec", "examples/sec of the most recent step")
+TRAIN_MFU = DEFAULT.gauge(
+    "oim_train_mfu", "model flops utilization of the most recent step")
+
+
+class MetricsServer:
+    """Serves ``registry.render()`` on ``GET /metrics`` in a daemon thread."""
+
+    def __init__(self, registry: Registry | None = None, port: int = 0):
+        self.registry = registry or DEFAULT
+        registry_ref = self.registry
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - http.server API
+                if self.path != "/metrics":
+                    self.send_error(404)
+                    return
+                body = registry_ref.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-request stderr lines
+                pass
+
+        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+
+    def start(self) -> "MetricsServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class Timer:
+    """Context manager feeding a gauge (seconds)."""
+
+    def __init__(self, gauge: Gauge):
+        self.gauge = gauge
+        self.elapsed = 0.0
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.monotonic() - self._t0
+        self.gauge.set(self.elapsed)
+        return False

@@ -138,6 +138,55 @@ class ControllerService(ControllerServicer):
             )
         return volume.status_proto()
 
+    # Must leave headroom under gRPC's 4 MiB default max message size: the
+    # chunk rides in a message with framing + (on the first chunk) spec and
+    # total_bytes fields.
+    DEFAULT_READ_CHUNK = 3 << 20
+
+    def ReadVolume(self, request, context):
+        """Stream a staged volume back to a cross-process consumer — the
+        data window of remote mode (spec.md ReadVolume; the vhost-user
+        shared-memory analog, reference README.md:153-170)."""
+        volume = self.get_volume(request.volume_id)
+        if volume is None:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND, f"no volume {request.volume_id!r}"
+            )
+        if volume.state != StageState.READY:
+            code = (
+                grpc.StatusCode.FAILED_PRECONDITION
+                if volume.state == StageState.STAGING
+                else grpc.StatusCode.INTERNAL
+            )
+            context.abort(code, f"volume {request.volume_id!r}: {volume.state.value}"
+                          + (f" ({volume.error})" if volume.error else ""))
+        import numpy as np
+
+        # np.asarray pulls device arrays back host-side (device->host DMA);
+        # host-RAM volumes are zero-copy.
+        data = np.ascontiguousarray(np.asarray(volume.array))
+        raw = data.view(np.uint8).reshape(-1)
+        start = int(request.offset)
+        if start < 0 or start > raw.size:
+            context.abort(grpc.StatusCode.OUT_OF_RANGE, f"offset {start}")
+        end = raw.size if request.length == 0 else min(start + int(request.length), raw.size)
+        chunk = int(request.chunk_bytes) or self.DEFAULT_READ_CHUNK
+        chunk = max(1, min(chunk, self.DEFAULT_READ_CHUNK))
+        first = True
+        for off in range(start, end, chunk) if start < end else [start]:
+            stop = min(off + chunk, end)
+            msg = pb.ReadVolumeChunk(
+                data=raw[off:stop].tobytes(), offset=off
+            )
+            if first:
+                msg.spec.CopyFrom(volume.spec)
+                msg.spec.dtype = msg.spec.dtype or str(data.dtype)
+                if not msg.spec.shape:
+                    msg.spec.shape.extend(data.shape)
+                msg.total_bytes = raw.size
+                first = False
+            yield msg
+
 
 class Controller:
     """Service + registration loop + server wiring (controller.go:379-495)."""

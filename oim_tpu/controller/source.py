@@ -17,7 +17,12 @@ def load_source(params_kind: str, params: Any) -> np.ndarray:
         if fmt == "npy":
             return readers.read_npy(params.path)
         if fmt == "raw":
-            return np.frombuffer(readers.read_raw(params.path), dtype=np.uint8)
+            # Raw bytes ride the C++ staging engine when built: parallel
+            # preads into a pinned buffer the device DMA can pull from
+            # directly (pure-Python fallback inside read_pinned otherwise).
+            from oim_tpu.data import staging
+
+            return staging.read_pinned(params.path)
         raise ValueError(f"unknown file format {fmt!r}")
     if params_kind == "tfrecord":
         return readers.read_tfrecord_batch(list(params.paths))

@@ -232,6 +232,49 @@ class Feeder:
         finally:
             channel.close()
 
+    # -- data window --------------------------------------------------------
+
+    def fetch(self, volume_id: str, timeout: float = 120.0):
+        """The staged volume's data as a host numpy array.
+
+        Local mode: the live array, zero-copy from the shared runtime.
+        Remote mode: streamed through the registry proxy via ReadVolume
+        (the vhost-user data-window analog, spec.md ReadVolume).
+        """
+        import numpy as np
+
+        from oim_tpu.controller.backend import spec_dtype
+
+        if self.controller is not None:
+            volume = self.controller.get_volume(volume_id)
+            if volume is None:
+                raise PublishError(f"no volume {volume_id!r}")
+            return np.asarray(volume.array)
+        channel = self._registry_channel()
+        try:
+            stub = ControllerStub(channel)
+            parts: list[bytes] = []
+            spec = None
+            try:
+                for chunk in stub.ReadVolume(
+                    pb.ReadVolumeRequest(volume_id=volume_id),
+                    metadata=[(CONTROLLER_ID_META, self.controller_id)],
+                    timeout=timeout,
+                ):
+                    if spec is None and chunk.HasField("spec"):
+                        spec = chunk.spec
+                    parts.append(chunk.data)
+            except grpc.RpcError as err:
+                raise PublishError(f"{err.code().name}: {err.details()}") from err
+            raw = np.frombuffer(b"".join(parts), dtype=np.uint8)
+            if spec is None:
+                return raw
+            arr = raw.view(spec_dtype(spec))
+            shape = tuple(int(d) for d in spec.shape)
+            return arr.reshape(shape) if shape else arr
+        finally:
+            channel.close()
+
     # -- unpublish ---------------------------------------------------------
 
     def unpublish(self, volume_id: str) -> None:

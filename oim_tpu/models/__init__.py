@@ -1,0 +1,15 @@
+"""Model zoo: the two model families named by BASELINE.json's config ladder —
+ResNet-50 (configs 3-4, OIM-fed ImageNet) and a Llama-family transformer
+(config 5, long-context pretrain).
+
+Models are pure functions over plain dict pytrees: ``init(rng, cfg)`` makes
+params, ``apply(params, batch, ...)`` runs forward, and
+``param_logical_axes(cfg)`` returns a matching pytree of logical dimension
+names consumed by oim_tpu/parallel/sharding.py. No module framework — the
+pytree IS the interface, which keeps pjit shardings, checkpointing, and the
+C++ staging path all speaking the same language.
+"""
+
+from oim_tpu.models import llama, resnet
+
+__all__ = ["llama", "resnet"]

@@ -1,0 +1,199 @@
+"""ResNet-50 (v1.5), TPU-first.
+
+Design notes:
+- NHWC layout + HWIO kernels (XLA's native TPU conv layout; the MXU sees
+  convs as large implicit matmuls).
+- bfloat16 activations/weights with float32 batch-norm statistics.
+- Batch norm is computed over the *global* batch: under jit with the batch
+  sharded over ("data","fsdp"), jnp.mean over the batch axes IS the global
+  mean — XLA inserts the cross-chip allreduce. No pmap-style manual
+  cross_replica_mean needed.
+- apply() is stateless-functional: training mode returns updated BN state.
+
+Reference capability being served: BASELINE.json configs 3-4 (ImageNet
+staged via MapVolume; DP training over the registry-built mesh).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from oim_tpu.parallel.sharding import CONV_IN, CONV_OUT, EMBED, VOCAB
+
+STAGES = (3, 4, 6, 3)  # ResNet-50 bottleneck counts
+STAGE_WIDTHS = (64, 128, 256, 512)
+EXPANSION = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+
+
+def _conv_init(rng, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    std = (2.0 / fan_in) ** 0.5
+    return (jax.random.normal(rng, (kh, kw, cin, cout)) * std).astype(dtype)
+
+
+def _bn_params(c):
+    return {"scale": jnp.ones((c,), jnp.float32), "bias": jnp.zeros((c,), jnp.float32)}
+
+
+def _bn_state(c):
+    return {"mean": jnp.zeros((c,), jnp.float32), "var": jnp.ones((c,), jnp.float32)}
+
+
+def _bottleneck_init(rng, cin, width, stride, dtype):
+    cout = width * EXPANSION
+    ks = jax.random.split(rng, 4)
+    block = {
+        "conv1": _conv_init(ks[0], 1, 1, cin, width, dtype),
+        "bn1": _bn_params(width),
+        "conv2": _conv_init(ks[1], 3, 3, width, width, dtype),
+        "bn2": _bn_params(width),
+        "conv3": _conv_init(ks[2], 1, 1, width, cout, dtype),
+        "bn3": _bn_params(cout),
+    }
+    state = {"bn1": _bn_state(width), "bn2": _bn_state(width), "bn3": _bn_state(cout)}
+    if stride != 1 or cin != cout:
+        block["proj"] = _conv_init(ks[3], 1, 1, cin, cout, dtype)
+        block["bn_proj"] = _bn_params(cout)
+        state["bn_proj"] = _bn_state(cout)
+    return block, state
+
+
+def init(rng, cfg: Config = Config()):
+    """Returns (params, bn_state)."""
+    rngs = jax.random.split(rng, 2 + sum(STAGES))
+    params: dict = {
+        "stem": _conv_init(rngs[0], 7, 7, 3, cfg.width, cfg.dtype),
+        "bn_stem": _bn_params(cfg.width),
+    }
+    state: dict = {"bn_stem": _bn_state(cfg.width)}
+    cin = cfg.width
+    i = 1
+    for s, (n_blocks, w) in enumerate(zip(STAGES, STAGE_WIDTHS)):
+        for b in range(n_blocks):
+            stride = 2 if (b == 0 and s > 0) else 1
+            block, bstate = _bottleneck_init(rngs[i], cin, w, stride, cfg.dtype)
+            params[f"stage{s}_block{b}"] = block
+            state[f"stage{s}_block{b}"] = bstate
+            cin = w * EXPANSION
+            i += 1
+    head_std = cin**-0.5
+    params["head"] = {
+        "kernel": (jax.random.normal(rngs[i], (cin, cfg.num_classes)) * head_std
+                   ).astype(cfg.dtype),
+        "bias": jnp.zeros((cfg.num_classes,), jnp.float32),
+    }
+    return params, state
+
+
+def _batchnorm(x, p, s, training, momentum, eps):
+    """Float32 statistics over (N, H, W); bf16 in/out."""
+    xf = x.astype(jnp.float32)
+    if training:
+        mean = jnp.mean(xf, axis=(0, 1, 2))
+        var = jnp.var(xf, axis=(0, 1, 2))
+        new_s = {
+            "mean": momentum * s["mean"] + (1 - momentum) * mean,
+            "var": momentum * s["var"] + (1 - momentum) * var,
+        }
+    else:
+        mean, var = s["mean"], s["var"]
+        new_s = s
+    out = (xf - mean) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+    return out.astype(x.dtype), new_s
+
+
+def _conv(x, kernel, stride=1, padding="SAME"):
+    # No preferred_element_type: the MXU accumulates bf16 convs in f32
+    # internally, and a f32 preference breaks the conv transpose (bwd)
+    # dtype matching. Output dtype == input dtype.
+    return jax.lax.conv_general_dilated(
+        x, kernel,
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _bottleneck(x, p, s, stride, training, momentum, eps):
+    new_s = {}
+    y, new_s["bn1"] = _batchnorm(_conv(x, p["conv1"]), p["bn1"], s["bn1"],
+                                 training, momentum, eps)
+    y = jax.nn.relu(y)
+    y, new_s["bn2"] = _batchnorm(_conv(y, p["conv2"], stride), p["bn2"], s["bn2"],
+                                 training, momentum, eps)
+    y = jax.nn.relu(y)
+    y, new_s["bn3"] = _batchnorm(_conv(y, p["conv3"]), p["bn3"], s["bn3"],
+                                 training, momentum, eps)
+    if "proj" in p:
+        x, new_s["bn_proj"] = _batchnorm(
+            _conv(x, p["proj"], stride), p["bn_proj"], s["bn_proj"],
+            training, momentum, eps)
+    return jax.nn.relu(y + x), new_s
+
+
+def apply(params, state, images, cfg: Config = Config(), training: bool = False):
+    """images: [N, H, W, 3] (any float dtype). Returns (logits_f32, new_state)."""
+    x = images.astype(cfg.dtype)
+    new_state: dict = {}
+    x = _conv(x, params["stem"], stride=2)
+    x, new_state["bn_stem"] = _batchnorm(
+        x, params["bn_stem"], state["bn_stem"], training, cfg.bn_momentum, cfg.bn_eps)
+    x = jax.nn.relu(x)
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+    for s_idx, n_blocks in enumerate(STAGES):
+        for b in range(n_blocks):
+            name = f"stage{s_idx}_block{b}"
+            stride = 2 if (b == 0 and s_idx > 0) else 1
+            x, new_state[name] = _bottleneck(
+                x, params[name], state[name], stride, training,
+                cfg.bn_momentum, cfg.bn_eps)
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))  # global average pool
+    logits = x @ params["head"]["kernel"].astype(jnp.float32) + params["head"]["bias"]
+    return logits, new_state
+
+
+def param_logical_axes(cfg: Config = Config()):
+    """Pytree matching init()[0] with logical dimension names per axis."""
+    conv_axes = (None, None, CONV_IN, CONV_OUT)
+    bn_axes = {"scale": (CONV_OUT,), "bias": (CONV_OUT,)}
+
+    def like_block(block):
+        axes = {}
+        for k in block:
+            if k.startswith("conv") or k == "proj":
+                axes[k] = conv_axes
+            else:
+                axes[k] = bn_axes
+        return axes
+
+    params, _ = jax.eval_shape(lambda: init(jax.random.PRNGKey(0), cfg))
+    axes: dict = {}
+    for k, v in params.items():
+        if k == "stem":
+            axes[k] = conv_axes
+        elif k == "bn_stem":
+            axes[k] = bn_axes
+        elif k == "head":
+            axes[k] = {"kernel": (EMBED, VOCAB), "bias": (VOCAB,)}
+        else:
+            axes[k] = like_block(v)
+    return axes
+
+
+def num_flops_per_image(image_size: int = 224) -> float:
+    """Approximate forward-pass FLOPs (the standard ~4.1 GFLOPs at 224)."""
+    return 4.1e9 * (image_size / 224.0) ** 2

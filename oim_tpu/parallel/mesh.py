@@ -29,7 +29,7 @@ MeshAxes = Sequence[tuple[str, int]]
 def _check_sizes(axes: MeshAxes, n_devices: int) -> list[tuple[str, int]]:
     axes = [(str(name), int(size)) for name, size in axes]
     total = int(np.prod([s for _, s in axes])) if axes else 1
-    if total != n_devices:
+    if total > n_devices:
         raise ValueError(
             f"mesh axes {axes} require {total} devices, have {n_devices}"
         )
@@ -52,6 +52,9 @@ def build_mesh(axes: MeshAxes, devices: Sequence | None = None):
     axes = _check_sizes(axes, len(devices))
     names = tuple(n for n, _ in axes)
     shape = tuple(s for _, s in axes)
+    # A mesh over a subset is allowed (e.g. a 2-device debug mesh on an
+    # 8-device host): take the first prod(shape) devices.
+    devices = devices[: int(np.prod(shape))]
     if devices and devices[0].platform == "tpu":
         from jax.experimental import mesh_utils
 

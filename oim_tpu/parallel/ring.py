@@ -67,8 +67,10 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
     local sequence shards [B, T_local, H, D]. Returns [B, T_local, H, D] in
     q's dtype.
     """
+    from oim_tpu.ops.attention import _expand_gqa
     from oim_tpu.parallel.collectives import ppermute_ring
 
+    k, v = _expand_gqa(q, k, v)
     size = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     t_local = q.shape[1]
@@ -105,6 +107,9 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True):
     Swaps sharding seq->heads with one tiled all_to_all each way; local
     attention in between sees the full sequence for heads/size heads.
     """
+    from oim_tpu.ops.attention import _expand_gqa
+
+    k, v = _expand_gqa(q, k, v)
     size = lax.psum(1, axis_name)  # concrete under shard_map
     if q.shape[2] % size:
         raise ValueError(

@@ -29,12 +29,18 @@ CONTROLLER_METHODS = {
     "StageStatus": (pb.StageStatusRequest, pb.StageStatusReply),
 }
 
+# unary-stream methods (server streams the reply type).
+CONTROLLER_STREAM_METHODS = {
+    "ReadVolume": (pb.ReadVolumeRequest, pb.ReadVolumeChunk),
+}
+
 
 class _Stub:
-    """Unary-unary stub over a method table."""
+    """Stub over method tables (unary-unary + unary-stream)."""
 
     _service: str = ""
     _methods: dict = {}
+    _stream_methods: dict = {}
 
     def __init__(self, channel: grpc.Channel):
         for name, (req_cls, reply_cls) in self._methods.items():
@@ -42,6 +48,16 @@ class _Stub:
                 self,
                 name,
                 channel.unary_unary(
+                    f"/{self._service}/{name}",
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=reply_cls.FromString,
+                ),
+            )
+        for name, (req_cls, reply_cls) in self._stream_methods.items():
+            setattr(
+                self,
+                name,
+                channel.unary_stream(
                     f"/{self._service}/{name}",
                     request_serializer=req_cls.SerializeToString,
                     response_deserializer=reply_cls.FromString,
@@ -57,6 +73,7 @@ class RegistryStub(_Stub):
 class ControllerStub(_Stub):
     _service = CONTROLLER_SERVICE
     _methods = CONTROLLER_METHODS
+    _stream_methods = CONTROLLER_STREAM_METHODS
 
 
 class RegistryServicer:
@@ -85,8 +102,14 @@ class ControllerServicer:
     def StageStatus(self, request, context):
         context.abort(grpc.StatusCode.UNIMPLEMENTED, "StageStatus not implemented")
 
+    def ReadVolume(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "ReadVolume not implemented")
 
-def _add_service(server: grpc.Server, servicer, service: str, methods: dict) -> None:
+
+def _add_service(
+    server: grpc.Server, servicer, service: str, methods: dict,
+    stream_methods: dict | None = None,
+) -> None:
     handlers = {
         name: grpc.unary_unary_rpc_method_handler(
             getattr(servicer, name),
@@ -95,6 +118,12 @@ def _add_service(server: grpc.Server, servicer, service: str, methods: dict) -> 
         )
         for name, (req_cls, reply_cls) in methods.items()
     }
+    for name, (req_cls, reply_cls) in (stream_methods or {}).items():
+        handlers[name] = grpc.unary_stream_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req_cls.FromString,
+            response_serializer=reply_cls.SerializeToString,
+        )
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(service, handlers),)
     )
@@ -105,4 +134,7 @@ def add_registry_to_server(servicer: RegistryServicer, server: grpc.Server) -> N
 
 
 def add_controller_to_server(servicer: ControllerServicer, server: grpc.Server) -> None:
-    _add_service(server, servicer, CONTROLLER_SERVICE, CONTROLLER_METHODS)
+    _add_service(
+        server, servicer, CONTROLLER_SERVICE, CONTROLLER_METHODS,
+        CONTROLLER_STREAM_METHODS,
+    )

@@ -1,0 +1,22 @@
+"""Training stack: the ``oim-trainer`` entrypoint's machinery.
+
+The reference has no trainer — this is the new scope BASELINE.json adds
+(``cmd/oim-trainer``: a JAX training loop over CSI-mounted HBM shards with
+allreduce over ICI). Structure:
+
+- state.py:     TrainState pytree + optimizer factory (optax)
+- checkpoint.py: orbax-backed save/restore with resume (new scope per
+                 SURVEY.md section 5.4 — the reference checkpoints nothing)
+- trainer.py:   mesh-aware jitted train step + the Trainer loop
+"""
+
+from oim_tpu.train.state import TrainState, make_optimizer
+from oim_tpu.train.trainer import Trainer, TrainConfig, make_train_step
+
+__all__ = [
+    "TrainState",
+    "make_optimizer",
+    "Trainer",
+    "TrainConfig",
+    "make_train_step",
+]

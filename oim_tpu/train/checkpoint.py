@@ -1,0 +1,54 @@
+"""Checkpoint / resume (orbax-backed).
+
+New scope relative to the reference, which persists nothing and rebuilds
+state by querying the device (SURVEY.md section 5.4). The trainer keeps that
+stance for *staging* state (re-query the controller) and adds durable
+checkpoints only for model/optimizer state. Sharded arrays save/restore with
+their shardings preserved (orbax handles jax.Array natively), so resume onto
+the same mesh needs no resharding pass.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+
+class Checkpointer:
+    """Thin orbax CheckpointManager wrapper: save(step, state) / restore()."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state: Any, wait: bool = False) -> None:
+        self._mgr.save(step, args=self._ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, abstract_state: Any, step: int | None = None) -> Any:
+        """Restore into the structure/shardings of ``abstract_state`` (a
+        matching pytree of jax.ShapeDtypeStructs or concrete arrays)."""
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        return self._mgr.restore(
+            step, args=self._ocp.args.StandardRestore(abstract_state)
+        )
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

@@ -1,0 +1,58 @@
+"""Train state: one pytree holding everything a step mutates.
+
+Registered as a jax pytree so it passes through jit/device_put/orbax
+directly; ``extra`` carries model-specific mutable state (ResNet BN stats);
+donate-safe (the trainer donates the previous state buffer each step).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import optax
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    step: Any  # int32 scalar array
+    params: Any
+    opt_state: Any
+    extra: Any  # model-specific mutable state ({} if none)
+
+    @classmethod
+    def create(cls, params, tx: optax.GradientTransformation, extra=None):
+        import jax.numpy as jnp
+
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            extra=extra if extra is not None else {},
+        )
+
+
+def make_optimizer(
+    lr: float = 3e-4,
+    warmup_steps: int = 100,
+    total_steps: int = 10_000,
+    weight_decay: float = 0.1,
+    b1: float = 0.9,
+    b2: float = 0.95,
+    grad_clip: float = 1.0,
+) -> optax.GradientTransformation:
+    """AdamW with linear warmup + cosine decay and global-norm clipping —
+    the standard large-batch recipe for both model families."""
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=lr,
+        warmup_steps=warmup_steps,
+        decay_steps=max(total_steps, warmup_steps + 1),
+        end_value=lr * 0.1,
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
+    )

@@ -30,8 +30,10 @@ from oim_tpu.parallel.sharding import (
 def test_build_mesh_sizes():
     mesh = build_mesh([("data", 2), ("model", 4)])
     assert mesh.shape == {"data": 2, "model": 4}
+    # Subset meshes are allowed; oversubscription is not.
+    assert build_mesh([("data", 2)]).shape == {"data": 2}
     with pytest.raises(ValueError):
-        build_mesh([("data", 3)])
+        build_mesh([("data", 16)])
 
 
 def test_local_mesh_default():

@@ -1,0 +1,111 @@
+"""Tests for the C++ staging engine + its Python binding (native/staging.cc,
+oim_tpu/data/staging.py). The library is built in-fixture via make (skip when
+no toolchain); the fallback path is tested by forcing the lib away."""
+
+import numpy as np
+import pytest
+
+from oim_tpu.data import staging
+
+
+@pytest.fixture(scope="module")
+def native():
+    if not staging.build():
+        pytest.skip("no C++ toolchain to build libstaging.so")
+    lib = staging.native_lib()
+    if lib is None:
+        pytest.skip("libstaging.so unavailable")
+    return lib
+
+
+@pytest.fixture()
+def datafile(tmp_path):
+    rng = np.random.RandomState(0)
+    data = rng.bytes(3 * (1 << 20) + 12345)  # deliberately chunk-unaligned
+    path = tmp_path / "blob.bin"
+    path.write_bytes(data)
+    return path, data
+
+
+def test_abi_version(native):
+    assert native.oim_staging_abi_version() == 1
+
+
+def test_read_pinned_matches_file(native, datafile):
+    path, data = datafile
+    arr = staging.read_pinned(path)
+    assert arr.dtype == np.uint8
+    assert arr.tobytes() == data
+
+
+def test_read_pinned_missing_file(native, tmp_path):
+    with pytest.raises(staging.StagingError):
+        staging.read_pinned(tmp_path / "nope.bin")
+
+
+def test_stream_chunks_reassemble(native, datafile):
+    path, data = datafile
+    chunks = [bytes(c) for c in staging.stream(path, chunk_bytes=1 << 20)]
+    assert len(chunks) == 4  # 3 full + 1 tail
+    assert b"".join(chunks) == data
+
+
+def test_stream_large_chunk_single(native, datafile):
+    path, data = datafile
+    chunks = [bytes(c) for c in staging.stream(path, chunk_bytes=1 << 30)]
+    assert len(chunks) == 1
+    assert chunks[0] == data
+
+
+def test_stream_missing_file(native, tmp_path):
+    with pytest.raises(staging.StagingError):
+        list(staging.stream(tmp_path / "nope.bin"))
+
+
+def test_stream_gbps_recorded(native, datafile):
+    from oim_tpu.common import metrics as M
+
+    path, _ = datafile
+    for _ in staging.stream(path, chunk_bytes=1 << 20):
+        pass
+    assert M.STAGE_GBPS.value > 0
+
+
+def test_fallback_without_native(datafile, monkeypatch):
+    path, data = datafile
+    monkeypatch.setattr(staging, "_lib", False)
+    assert staging.native_lib() is None
+    arr = staging.read_pinned(path)
+    assert arr.tobytes() == data
+    chunks = [bytes(c) for c in staging.stream(path, chunk_bytes=1 << 20)]
+    assert b"".join(chunks) == data
+
+
+def test_stage_file_to_device(native, datafile):
+    path, data = datafile
+    out = staging.stage_file_to_device(path, chunk_bytes=1 << 20)
+    assert out.shape == (len(data),)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.frombuffer(data, dtype=np.uint8)
+    )
+
+
+def test_stage_file_to_device_dtype_shape(native, tmp_path):
+    vals = np.arange(1024, dtype=np.float32)
+    path = tmp_path / "f32.bin"
+    path.write_bytes(vals.tobytes())
+    out = staging.stage_file_to_device(
+        path, dtype="float32", shape=(32, 32), chunk_bytes=1 << 10
+    )
+    assert out.shape == (32, 32)
+    np.testing.assert_array_equal(np.asarray(out).reshape(-1), vals)
+
+
+def test_file_source_uses_staging(native, datafile):
+    """The controller's raw-file source path rides read_pinned."""
+    from oim_tpu.controller.source import load_source
+    from oim_tpu.spec import pb
+
+    path, data = datafile
+    arr = load_source("file", pb.FileParams(path=str(path), format="raw"))
+    assert arr.tobytes() == data
