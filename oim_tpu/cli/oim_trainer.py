@@ -83,7 +83,8 @@ def feeder_batches(args, cfg: TrainConfig, tls):
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser("oim-trainer")
     parser.add_argument("--model", default="llama-tiny",
-                        choices=("llama-tiny", "llama3-8b", "resnet50"))
+                        choices=("llama-tiny", "llama-tiny-moe", "llama3-8b",
+                                 "resnet50"))
     parser.add_argument("--rules", default="dp", choices=("dp", "fsdp", "tp_sp"))
     parser.add_argument("--seq-parallel", default="ring",
                         choices=("ring", "ulysses"))
@@ -109,6 +110,11 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--volume-file", default="",
                         help="stage this file as the training volume")
     parser.add_argument("--publish-timeout", type=float, default=60.0)
+    parser.add_argument(
+        "--expected-hosts", type=int, default=1,
+        help="multi-host: wait for this many controllers in the registry, "
+             "derive ranks from the topology, jax.distributed.initialize",
+    )
     parser.add_argument(
         "--platform", default="",
         help="force a jax platform (e.g. 'cpu' for a virtual multi-device "
@@ -160,6 +166,13 @@ def main(argv: list[str] | None = None) -> int:
     data = None
     if args.registry:
         tls = load_tls_flags(args)
+        if args.expected_hosts > 1:
+            from oim_tpu.parallel.bootstrap import initialize_from_registry
+
+            pid, n = initialize_from_registry(
+                args.registry, args.controller_id, args.expected_hosts, tls
+            )
+            log.info("distributed", process_id=pid, num_processes=n)
         data = feeder_batches(args, cfg, tls)
     elif not args.synthetic:
         args.synthetic = True

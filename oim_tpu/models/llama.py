@@ -44,6 +44,24 @@ class Config:
     max_seq: int = 8192
     rope_theta: float = 500000.0
     dtype: Any = jnp.bfloat16
+    # Mixture-of-Experts: n_experts > 0 replaces the dense FFN with a
+    # top-k-routed expert FFN (models/moe.py), sharded over the "expert"
+    # mesh axis.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+
+    @property
+    def moe(self):
+        from oim_tpu.models.moe import MoEConfig
+
+        return MoEConfig(
+            n_experts=self.n_experts,
+            top_k=self.moe_top_k,
+            capacity_factor=self.moe_capacity_factor,
+            aux_weight=self.moe_aux_weight,
+        )
 
     @property
     def q_dim(self) -> int:
@@ -57,11 +75,13 @@ class Config:
 LLAMA3_8B = Config()
 
 
-def tiny(vocab: int = 256, dim: int = 64, n_layers: int = 2) -> Config:
+def tiny(vocab: int = 256, dim: int = 64, n_layers: int = 2,
+         n_experts: int = 0) -> Config:
     """A test-scale config with the full architecture."""
     return Config(
         vocab=vocab, dim=dim, n_layers=n_layers, n_heads=4, n_kv_heads=2,
         head_dim=dim // 4, mlp_dim=dim * 3, max_seq=512, dtype=jnp.float32,
+        n_experts=n_experts,
     )
 
 
@@ -75,40 +95,57 @@ def init(rng, cfg: Config = LLAMA3_8B):
     L, D = cfg.n_layers, cfg.dim
     ks = jax.random.split(rng, 10)
     fan = D**-0.5
-    params = {
+    layers = {
+        "attn_norm": jnp.ones((L, D), jnp.float32),
+        "wq": _dense(ks[1], (L, D, cfg.q_dim), cfg.dtype, fan),
+        "wk": _dense(ks[2], (L, D, cfg.kv_dim), cfg.dtype, fan),
+        "wv": _dense(ks[3], (L, D, cfg.kv_dim), cfg.dtype, fan),
+        "wo": _dense(ks[4], (L, cfg.q_dim, D), cfg.dtype, cfg.q_dim**-0.5),
+        "mlp_norm": jnp.ones((L, D), jnp.float32),
+    }
+    if cfg.n_experts:
+        from oim_tpu.models import moe
+
+        layers["moe"] = moe.init(
+            ks[5], D, cfg.mlp_dim, cfg.moe, cfg.dtype, n_layers=L
+        )
+    else:
+        layers.update(
+            w_gate=_dense(ks[5], (L, D, cfg.mlp_dim), cfg.dtype, fan),
+            w_up=_dense(ks[6], (L, D, cfg.mlp_dim), cfg.dtype, fan),
+            w_down=_dense(ks[7], (L, cfg.mlp_dim, D), cfg.dtype,
+                          cfg.mlp_dim**-0.5),
+        )
+    return {
         "embed": _dense(ks[0], (cfg.vocab, D), cfg.dtype, scale=0.02),
-        "layers": {
-            "attn_norm": jnp.ones((L, D), jnp.float32),
-            "wq": _dense(ks[1], (L, D, cfg.q_dim), cfg.dtype, fan),
-            "wk": _dense(ks[2], (L, D, cfg.kv_dim), cfg.dtype, fan),
-            "wv": _dense(ks[3], (L, D, cfg.kv_dim), cfg.dtype, fan),
-            "wo": _dense(ks[4], (L, cfg.q_dim, D), cfg.dtype, cfg.q_dim**-0.5),
-            "mlp_norm": jnp.ones((L, D), jnp.float32),
-            "w_gate": _dense(ks[5], (L, D, cfg.mlp_dim), cfg.dtype, fan),
-            "w_up": _dense(ks[6], (L, D, cfg.mlp_dim), cfg.dtype, fan),
-            "w_down": _dense(ks[7], (L, cfg.mlp_dim, D), cfg.dtype,
-                             cfg.mlp_dim**-0.5),
-        },
+        "layers": layers,
         "final_norm": jnp.ones((D,), jnp.float32),
         "lm_head": _dense(ks[8], (D, cfg.vocab), cfg.dtype, fan),
     }
-    return params
 
 
 def param_logical_axes(cfg: Config = LLAMA3_8B):
+    layers = {
+        "attn_norm": (None, None),
+        "wq": (None, EMBED, HEAD),
+        "wk": (None, EMBED, KV_HEAD),
+        "wv": (None, EMBED, KV_HEAD),
+        "wo": (None, HEAD, EMBED),
+        "mlp_norm": (None, None),
+    }
+    if cfg.n_experts:
+        from oim_tpu.models import moe
+
+        layers["moe"] = moe.param_logical_axes(stacked=True)
+    else:
+        layers.update(
+            w_gate=(None, EMBED, MLP),
+            w_up=(None, EMBED, MLP),
+            w_down=(None, MLP, EMBED),
+        )
     return {
         "embed": (VOCAB, EMBED),
-        "layers": {
-            "attn_norm": (None, None),
-            "wq": (None, EMBED, HEAD),
-            "wk": (None, EMBED, KV_HEAD),
-            "wv": (None, EMBED, KV_HEAD),
-            "wo": (None, HEAD, EMBED),
-            "mlp_norm": (None, None),
-            "w_gate": (None, EMBED, MLP),
-            "w_up": (None, EMBED, MLP),
-            "w_down": (None, MLP, EMBED),
-        },
+        "layers": layers,
         "final_norm": (None,),
         "lm_head": (EMBED, VOCAB),
     }
@@ -118,6 +155,7 @@ AttentionFn = Callable[..., Any]  # (q, k, v, causal=...) -> out
 
 
 def _layer(x, layer, cfg: Config, cos, sin, attn_fn: AttentionFn):
+    """Returns (x, aux_loss); aux is 0 for dense FFN layers."""
     B, T, D = x.shape
     h = rmsnorm(x, layer["attn_norm"])
     q = (h @ layer["wq"]).reshape(B, T, cfg.n_heads, cfg.head_dim)
@@ -128,13 +166,19 @@ def _layer(x, layer, cfg: Config, cos, sin, attn_fn: AttentionFn):
     attn = attn_fn(q, k, v, causal=True)
     x = x + attn.reshape(B, T, cfg.q_dim) @ layer["wo"]
     h = rmsnorm(x, layer["mlp_norm"])
+    if cfg.n_experts:
+        from oim_tpu.models import moe
+
+        ffn, aux = moe.apply(layer["moe"], h, cfg.moe)
+        return x + ffn, aux
     gated = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
-    return x + gated @ layer["w_down"]
+    return x + gated @ layer["w_down"], jnp.zeros((), jnp.float32)
 
 
 def apply(params, tokens, cfg: Config = LLAMA3_8B,
-          attn_fn: AttentionFn | None = None):
-    """tokens: [B, T] int32. Returns logits [B, T, vocab] float32."""
+          attn_fn: AttentionFn | None = None, return_aux: bool = False):
+    """tokens: [B, T] int32. Returns logits [B, T, vocab] float32 (and the
+    summed MoE load-balance aux loss when return_aux)."""
     if attn_fn is None:
         attn_fn = default_attention
     T = tokens.shape[1]
@@ -142,28 +186,38 @@ def apply(params, tokens, cfg: Config = LLAMA3_8B,
     x = params["embed"][tokens].astype(cfg.dtype)
 
     def body(x, layer):
-        return _layer(x, layer, cfg, cos, sin, attn_fn), None
+        x, aux = _layer(x, layer, cfg, cos, sin, attn_fn)
+        return x, aux
 
-    x, _ = lax.scan(body, x, params["layers"])
+    x, aux = lax.scan(body, x, params["layers"])
     x = rmsnorm(x, params["final_norm"])
-    return (x @ params["lm_head"]).astype(jnp.float32)
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    if return_aux:
+        return logits, jnp.sum(aux)
+    return logits
 
 
 def loss_fn(params, tokens, cfg: Config = LLAMA3_8B,
             attn_fn: AttentionFn | None = None,
             ignore_index: int = -1):
-    """Next-token cross entropy; tokens [B, T+1] (or [B, T] with the last
-    position unsupervised)."""
-    logits = apply(params, tokens[:, :-1], cfg, attn_fn)
-    return softmax_cross_entropy(logits, tokens[:, 1:], ignore_index)
+    """Next-token cross entropy (+ weighted MoE aux loss); tokens [B, T+1]."""
+    logits, aux = apply(params, tokens[:, :-1], cfg, attn_fn, return_aux=True)
+    loss = softmax_cross_entropy(logits, tokens[:, 1:], ignore_index)
+    if cfg.n_experts:
+        loss = loss + cfg.moe_aux_weight * aux
+    return loss
 
 
 def num_params(cfg: Config = LLAMA3_8B) -> int:
     L, D = cfg.n_layers, cfg.dim
+    if cfg.n_experts:
+        ffn = D * cfg.n_experts + 3 * cfg.n_experts * D * cfg.mlp_dim
+    else:
+        ffn = 3 * D * cfg.mlp_dim
     per_layer = (
         2 * D  # norms
         + D * cfg.q_dim + 2 * D * cfg.kv_dim + cfg.q_dim * D
-        + 2 * D * cfg.mlp_dim + cfg.mlp_dim * D
+        + ffn
     )
     return cfg.vocab * D + L * per_layer + D + D * cfg.vocab
 

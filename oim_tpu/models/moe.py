@@ -1,0 +1,129 @@
+"""Mixture-of-Experts FFN with expert parallelism.
+
+GShard/Switch-style dense dispatch: top-k routing with a capacity limit,
+dispatch/combine expressed as einsums so the whole layer is MXU work and
+XLA inserts the expert all-to-alls from the shardings (expert-major
+tensors carry the "expert" mesh axis via the logical-axis tables; no
+hand-written collectives).
+
+Router math in float32 (softmax over experts is precision-sensitive);
+expert FFNs in the model dtype.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from oim_tpu.parallel.sharding import EMBED, EXPERT, MLP
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+
+
+def init(rng, dim: int, mlp_dim: int, cfg: MoEConfig, dtype, n_layers: int | None = None):
+    """Expert FFN params; with n_layers, stacked [L, ...] for scan."""
+    lead = () if n_layers is None else (n_layers,)
+    ks = jax.random.split(rng, 4)
+    e = cfg.n_experts
+    fan = dim**-0.5
+    return {
+        "router": (jax.random.normal(ks[0], lead + (dim, e)) * fan
+                   ).astype(jnp.float32),
+        "w_gate": (jax.random.normal(ks[1], lead + (e, dim, mlp_dim)) * fan
+                   ).astype(dtype),
+        "w_up": (jax.random.normal(ks[2], lead + (e, dim, mlp_dim)) * fan
+                 ).astype(dtype),
+        "w_down": (jax.random.normal(ks[3], lead + (e, mlp_dim, dim))
+                   * mlp_dim**-0.5).astype(dtype),
+    }
+
+
+def param_logical_axes(stacked: bool = False):
+    lead = (None,) if stacked else ()
+    return {
+        "router": lead + (EMBED, EXPERT),
+        "w_gate": lead + (EXPERT, EMBED, MLP),
+        "w_up": lead + (EXPERT, EMBED, MLP),
+        "w_down": lead + (EXPERT, MLP, EMBED),
+    }
+
+
+def capacity(n_tokens: int, cfg: MoEConfig) -> int:
+    return max(1, int(cfg.top_k * n_tokens * cfg.capacity_factor / cfg.n_experts))
+
+
+def apply(params, x, cfg: MoEConfig):
+    """x: [B, T, D] -> (out [B, T, D], aux_loss scalar f32).
+
+    Tokens over capacity for their chosen expert are dropped (contribute
+    zero; the residual stream carries them), the standard capacity
+    trade-off that keeps every shape static for XLA.
+    """
+    b, t, d = x.shape
+    n = b * t
+    e, k = cfg.n_experts, cfg.top_k
+    cap = capacity(n, cfg)
+    tokens = x.reshape(n, d)
+
+    logits = tokens.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)  # [N, E]
+
+    # Top-k assignment, capacity-limited per expert.
+    combine = jnp.zeros((n, e, cap), jnp.float32)
+    dispatch = jnp.zeros((n, e, cap), bool)
+    remaining = probs
+    # Track how many tokens each expert has accepted across the k rounds.
+    fill = jnp.zeros((e,), jnp.int32)
+    for _ in range(k):
+        gate = jnp.max(remaining, axis=-1)  # [N]
+        expert = jnp.argmax(remaining, axis=-1)  # [N]
+        onehot = jax.nn.one_hot(expert, e, dtype=jnp.int32)  # [N, E]
+        # Position of each token in its expert's buffer this round.
+        pos_in_expert = (jnp.cumsum(onehot, axis=0) - 1) + fill[None, :]
+        pos = jnp.sum(pos_in_expert * onehot, axis=-1)  # [N]
+        keep = pos < cap
+        fill = fill + jnp.sum(onehot * keep[:, None].astype(jnp.int32), axis=0)
+        pos = jnp.clip(pos, 0, cap - 1)
+        slot = jax.nn.one_hot(pos, cap, dtype=jnp.float32)  # [N, C]
+        contrib = (
+            onehot.astype(jnp.float32)[:, :, None]
+            * slot[:, None, :]
+            * keep[:, None, None]
+        )
+        combine = combine + gate[:, None, None] * contrib
+        dispatch = jnp.logical_or(dispatch, contrib > 0)
+        remaining = remaining * (1.0 - onehot.astype(jnp.float32))
+
+    if k > 1:
+        # Renormalize gates over the experts actually used (GShard). For
+        # k == 1 keep the RAW router prob (Switch): normalizing would make
+        # combine identically 1 and kill the router's task-loss gradient.
+        denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+        combine = combine / jnp.maximum(denom, 1e-9)
+
+    # Dispatch -> expert FFN -> combine (all einsums; "expert" axis rides E).
+    expert_in = jnp.einsum(
+        "nec,nd->ecd", dispatch.astype(x.dtype), tokens
+    )  # [E, C, D]
+    h = jax.nn.silu(
+        jnp.einsum("ecd,edf->ecf", expert_in, params["w_gate"])
+    ) * jnp.einsum("ecd,edf->ecf", expert_in, params["w_up"])
+    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_down"])  # [E, C, D]
+    out = jnp.einsum(
+        "nec,ecd->nd", combine.astype(x.dtype), expert_out
+    ).reshape(b, t, d)
+
+    # Load-balance auxiliary loss (Switch Transformer eq. 4): E * sum_e
+    # (fraction of tokens routed to e) * (mean router prob for e).
+    top1 = jnp.argmax(probs, axis=-1)
+    frac_tokens = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+    return out, aux

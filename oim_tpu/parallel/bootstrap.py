@@ -1,0 +1,126 @@
+"""Multi-host bootstrap: the registry KV elects the JAX coordinator.
+
+The reference's controllers self-register ``<id>/address`` so the control
+plane always knows the membership (controller.go:448-468, soft-state DB
+rebuilt every registry_delay). Multi-host JAX needs exactly that membership
+to call ``jax.distributed.initialize(coordinator, n, process_id)`` — so the
+registry is the single source of truth here too:
+
+1. every host's controller registers ``<id>/address`` + ``<id>/mesh``;
+2. each trainer polls the registry until ``expected_hosts`` appear;
+3. hosts sort by ICI coordinate (ties by id) — a deterministic total order
+   every host derives independently;
+4. rank 0's host becomes the coordinator; everyone calls initialize.
+
+No leader election protocol needed: the order is a pure function of the
+registry contents, and re-registration heals the DB after a registry
+restart (SURVEY.md section 5.3).
+"""
+
+from __future__ import annotations
+
+import time
+
+from oim_tpu.common.meshcoord import MeshCoord
+from oim_tpu.common.pathutil import REGISTRY_ADDRESS
+from oim_tpu.parallel.mesh import topology_from_registry
+
+
+class BootstrapError(RuntimeError):
+    pass
+
+
+def derive_process_layout(
+    entries: dict[str, str], controller_id: str, coordinator_port: int = 8476
+) -> tuple[str, int, int]:
+    """(coordinator_address, num_processes, process_id) from registry
+    entries — deterministic on every host.
+
+    The coordinator address is rank 0's registered DCN address with its
+    port replaced by ``coordinator_port`` (the gRPC control port belongs to
+    the controller; the JAX coordinator needs its own).
+    """
+    topo = topology_from_registry(entries)
+    addresses = {}
+    for path, value in entries.items():
+        parts = path.split("/")
+        if len(parts) == 2 and parts[1] == REGISTRY_ADDRESS:
+            addresses[parts[0]] = value
+    hosts = sorted(
+        addresses,
+        key=lambda h: (
+            tuple(
+                c if c >= 0 else 1 << 30
+                for c in _coord_key(topo.get(h, MeshCoord()))
+            ),
+            h,
+        ),
+    )
+    if controller_id not in hosts:
+        raise BootstrapError(
+            f"controller {controller_id!r} not registered "
+            f"(have: {sorted(hosts)})"
+        )
+    coord_host = addresses[hosts[0]]
+    host_part = coord_host.rsplit(":", 1)[0]
+    return f"{host_part}:{coordinator_port}", len(hosts), hosts.index(controller_id)
+
+
+def _coord_key(c: MeshCoord):
+    return (c.x, c.y, c.z, c.core)
+
+
+def wait_for_hosts(
+    registry_stub, expected_hosts: int, timeout: float = 300.0,
+    poll: float = 1.0,
+) -> dict[str, str]:
+    """Poll GetValues("") until ``expected_hosts`` controllers registered."""
+    from oim_tpu.spec import pb
+
+    deadline = time.monotonic() + timeout
+    while True:
+        reply = registry_stub.GetValues(pb.GetValuesRequest(path=""), timeout=10.0)
+        entries = {v.path: v.value for v in reply.values}
+        n = sum(1 for p in entries if p.endswith(f"/{REGISTRY_ADDRESS}"))
+        if n >= expected_hosts:
+            return entries
+        if time.monotonic() > deadline:
+            raise BootstrapError(
+                f"only {n}/{expected_hosts} hosts registered before timeout"
+            )
+        time.sleep(poll)
+
+
+def initialize_from_registry(
+    registry_address: str,
+    controller_id: str,
+    expected_hosts: int,
+    tls=None,
+    coordinator_port: int = 8476,
+    timeout: float = 300.0,
+) -> tuple[int, int]:
+    """Wait for the slice to assemble, then jax.distributed.initialize.
+
+    Returns (process_id, num_processes). Single-host (expected_hosts == 1)
+    skips initialize entirely.
+    """
+    from oim_tpu.common.tlsutil import dial
+    from oim_tpu.spec import RegistryStub
+
+    channel = dial(registry_address, tls, "component.registry")
+    try:
+        entries = wait_for_hosts(RegistryStub(channel), expected_hosts, timeout)
+    finally:
+        channel.close()
+    coordinator, n, pid = derive_process_layout(
+        entries, controller_id, coordinator_port
+    )
+    if n > 1:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=n,
+            process_id=pid,
+        )
+    return pid, n

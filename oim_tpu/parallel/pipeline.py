@@ -1,0 +1,141 @@
+"""Pipeline parallelism: GPipe-style microbatched execution over a "pipe"
+mesh axis.
+
+Layers are already STACKED along a leading axis (models/llama.py scans over
+them); pipelining shards that axis across stages — each device holds
+n_layers/P contiguous layers — and streams M microbatches through, handing
+activations to the next stage with ``ppermute`` each tick. SPMD-friendly:
+every stage executes the same code; stage identity only selects which data
+is real (``jnp.where`` on ``axis_index``), so the whole schedule jits as one
+program with no data-dependent control flow.
+
+Schedule: plain GPipe — M + P - 1 ticks, bubble fraction (P-1)/(M+P-1).
+Choose M >= 4*P to keep the bubble under ~20%.
+
+The backward pass needs no special handling: jax differentiates through
+ppermute (transpose = reverse permute), so one ``jax.grad`` over the whole
+pipelined apply produces the 1F1B-equivalent communication pattern.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from oim_tpu.parallel.collectives import ppermute_ring
+
+
+def pipeline_apply(
+    layer_fn: Callable[[Any, Any], Any],
+    stage_params: Any,
+    x: Any,
+    n_microbatches: int,
+    axis: str = "pipe",
+):
+    """Run microbatched pipeline over the ``axis`` mesh axis.
+
+    Must be called inside shard_map with ``axis`` bound.
+
+    layer_fn(carry, layer_params) -> carry: one layer (the same body the
+        sequential model scans with).
+    stage_params: THIS stage's layer stack [L/P, ...] pytree (the "pipe"
+        axis of the global [L, ...] stack, sharded by shard_map).
+    x: [M, mb, ...] microbatched input (real data on every stage; only
+        stage 0's is consumed).
+    Returns [M, mb, ...] outputs (valid on every stage — the last stage's
+    results are rotated forward so stage 0 holds them too; see below).
+    """
+    p = lax.psum(1, axis)  # concrete under shard_map
+    idx = lax.axis_index(axis)
+    m = n_microbatches
+    if x.shape[0] != m:
+        raise ValueError(f"x leading dim {x.shape[0]} != n_microbatches {m}")
+    mb_shape = x.shape[1:]
+
+    def run_stage(h):
+        def body(carry, layer):
+            return layer_fn(carry, layer), None
+
+        out, _ = lax.scan(body, h, stage_params)
+        return out
+
+    outputs = jnp.zeros((m,) + mb_shape, x.dtype)
+    h = jnp.zeros(mb_shape, x.dtype)  # activation arriving from the left
+
+    for t in range(m + p - 1):
+        # Stage 0 injects microbatch t; other stages consume what arrived.
+        mb_idx = jnp.clip(t, 0, m - 1)
+        inject = lax.dynamic_index_in_dim(x, mb_idx, keepdims=False)
+        h_in = jnp.where(idx == 0, inject, h)
+        out = run_stage(h_in)
+        # The last stage banks its result for microbatch t - (p - 1).
+        out_idx = jnp.clip(t - (p - 1), 0, m - 1)
+        bank = jnp.logical_and(idx == p - 1, t >= p - 1)
+        outputs = jnp.where(
+            bank,
+            lax.dynamic_update_index_in_dim(outputs, out, out_idx, axis=0),
+            outputs,
+        )
+        # Hand activations to the next stage (last stage's hand-off wraps to
+        # stage 0 and is ignored there — stage 0 always injects).
+        h = ppermute_ring(out, axis)
+
+    # Only the last stage holds real outputs; broadcast so every stage
+    # returns the same (replicated) value — and the backward pass correctly
+    # funnels cotangents to the last stage (psum transpose).
+    outputs = lax.psum(
+        jnp.where(idx == p - 1, outputs, jnp.zeros_like(outputs)), axis
+    )
+    return outputs
+
+
+def pipeline_stage_slice(n_layers: int, axis_size: int, stage: int) -> slice:
+    """Which layers stage ``stage`` owns (contiguous blocks)."""
+    if n_layers % axis_size:
+        raise ValueError(f"{n_layers} layers not divisible by {axis_size} stages")
+    per = n_layers // axis_size
+    return slice(stage * per, (stage + 1) * per)
+
+
+def make_pipelined_apply(
+    mesh,
+    layer_fn: Callable[[Any, Any], Any],
+    n_microbatches: int,
+    axis: str = "pipe",
+    batch_axes: tuple[str, ...] | None = None,
+):
+    """shard_map-wrapped pipelined layer stack over ``mesh``.
+
+    Returns fn(stacked_params, x) where stacked_params is the global
+    [L, ...] stack (sharded over ``axis`` on dim 0) and x is [M, mb, ...]
+    (microbatch dim replicated across stages, batch dim sharded over
+    ``batch_axes``).
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    if batch_axes is None:
+        batch_axes = tuple(
+            n for n in mesh.axis_names
+            if n not in (axis, "model", "expert", "seq")
+        )
+    x_spec = P(None, batch_axes or None)
+
+    def fn(stacked_params, x):
+        """Not jitted here — wrap in jax.jit (or call inside a jitted train
+        step); jit caches by pytree structure so repeated calls are cheap."""
+        p_spec = jax.tree.map(lambda _: P(axis), stacked_params)
+        return shard_map(
+            lambda sp, xx: pipeline_apply(
+                layer_fn, sp, xx, n_microbatches, axis
+            ),
+            mesh=mesh,
+            in_specs=(p_spec, x_spec),
+            out_specs=x_spec,
+            check_vma=False,
+        )(stacked_params, x)
+
+    return fn

@@ -84,6 +84,8 @@ class TrainConfig:
     def model_config(self):
         if self.model == "llama-tiny":
             return llama.tiny()
+        if self.model == "llama-tiny-moe":
+            return llama.tiny(n_experts=4)
         if self.model == "llama3-8b":
             return llama.LLAMA3_8B
         if self.model == "resnet50":

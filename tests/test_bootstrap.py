@@ -1,0 +1,89 @@
+"""Tests for registry-driven multi-host bootstrap (parallel/bootstrap.py)."""
+
+import threading
+
+import pytest
+
+from oim_tpu.parallel.bootstrap import (
+    BootstrapError,
+    derive_process_layout,
+    wait_for_hosts,
+)
+
+
+def entries_for(hosts):
+    out = {}
+    for hid, addr, mesh in hosts:
+        out[f"{hid}/address"] = addr
+        if mesh:
+            out[f"{hid}/mesh"] = mesh
+    return out
+
+
+def test_layout_orders_by_coordinate():
+    entries = entries_for([
+        ("host-b", "10.0.0.2:8998", "1,0,0"),
+        ("host-a", "10.0.0.1:8998", "0,0,0"),
+        ("host-c", "10.0.0.3:8998", "0,1,0"),
+    ])
+    coord, n, pid = derive_process_layout(entries, "host-b")
+    assert n == 3
+    # Order: (0,0,0) host-a, (0,1,0) host-c, (1,0,0) host-b.
+    assert pid == 2
+    assert coord == "10.0.0.1:8476"
+    # Every host derives the identical layout.
+    assert derive_process_layout(entries, "host-a")[2] == 0
+    assert derive_process_layout(entries, "host-c")[2] == 1
+
+
+def test_layout_unknown_coords_sort_last_ties_by_id():
+    entries = entries_for([
+        ("host-2", "h2:1", ""),
+        ("host-1", "h1:1", ""),
+        ("host-0", "h0:1", "0,0,0"),
+    ])
+    coord, n, pid = derive_process_layout(entries, "host-2")
+    assert (n, pid) == (3, 2)
+    assert coord.startswith("h0:")
+
+
+def test_layout_unregistered_controller_raises():
+    entries = entries_for([("host-0", "h0:1", "0,0,0")])
+    with pytest.raises(BootstrapError, match="not registered"):
+        derive_process_layout(entries, "ghost")
+
+
+def test_wait_for_hosts_converges():
+    """wait_for_hosts returns once enough controllers register (the analog
+    of the reference's soft-state convergence, controller_test.go:107-127)."""
+    from oim_tpu.registry.db import MemRegistryDB
+    from oim_tpu.registry.registry import RegistryService, registry_server
+    from oim_tpu.spec import RegistryStub, pb
+
+    import grpc
+
+    db = MemRegistryDB()
+    server = registry_server("tcp://localhost:0", RegistryService(db=db))
+    try:
+        db.set("host-0/address", "h0:1")
+
+        def late_join():
+            db.set("host-1/address", "h1:1")
+
+        t = threading.Timer(0.3, late_join)
+        t.start()
+        channel = grpc.insecure_channel(server.addr)
+        try:
+            entries = wait_for_hosts(
+                RegistryStub(channel), expected_hosts=2, timeout=10, poll=0.05
+            )
+        finally:
+            channel.close()
+        assert "host-1/address" in entries
+        with grpc.insecure_channel(server.addr) as ch:
+            with pytest.raises(BootstrapError, match="0/5|1/5|2/5"):
+                wait_for_hosts(
+                    RegistryStub(ch), expected_hosts=5, timeout=0.2, poll=0.05
+                )
+    finally:
+        server.force_stop()
