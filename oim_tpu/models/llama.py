@@ -60,7 +60,6 @@ class Config:
             n_experts=self.n_experts,
             top_k=self.moe_top_k,
             capacity_factor=self.moe_capacity_factor,
-            aux_weight=self.moe_aux_weight,
         )
 
     @property
