@@ -137,6 +137,7 @@ struct Stream {
           if (errno == EINTR) continue;
           std::lock_guard<std::mutex> lk(mu);
           error = std::string("pread failed: ") + strerror(errno);
+          free_q.push_back(b);  // don't strand the in-flight buffer
           ready_q.push_back(nullptr);
           cv_ready.notify_all();
           return;
@@ -234,6 +235,10 @@ int64_t oim_read_into(const char* path, void* dst, int64_t offset,
   if (n_threads < 1) n_threads = 1;
   std::atomic<int64_t> total{0};
   std::atomic<bool> failed{false};
+  // g_error is thread_local: workers must record failures in shared state
+  // and the calling thread copies it into its own g_error before return.
+  std::mutex err_mu;
+  std::string err;
   int64_t per = (len + n_threads - 1) / n_threads;
   // Align spans to 4 MiB so each thread issues large sequential preads.
   constexpr int64_t kSpanAlign = 4 << 20;
@@ -251,7 +256,10 @@ int64_t oim_read_into(const char* path, void* dst, int64_t offset,
                           offset + begin + got);
         if (n < 0) {
           if (errno == EINTR) continue;
-          g_error = std::string("pread failed: ") + strerror(errno);
+          {
+            std::lock_guard<std::mutex> lk(err_mu);
+            err = std::string("pread failed: ") + strerror(errno);
+          }
           failed.store(true);
           return;
         }
@@ -263,7 +271,12 @@ int64_t oim_read_into(const char* path, void* dst, int64_t offset,
   }
   for (auto& w : workers) w.join();
   ::close(fd);
-  return failed.load() ? -1 : total.load();
+  if (failed.load()) {
+    std::lock_guard<std::mutex> lk(err_mu);
+    g_error = err;
+    return -1;
+  }
+  return total.load();
 }
 
 int64_t oim_file_size(const char* path) {

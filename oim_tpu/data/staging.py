@@ -218,7 +218,15 @@ def stage_file_to_device(
             # The DMA must finish before the chunk buffer is released to
             # the filler; the C++ read-ahead still overlaps: while this
             # blocks, the filler preads the NEXT chunk into another buffer.
-            parts.append(jax.device_put(chunk, device).block_until_ready())
+            part = jax.device_put(chunk, device)
+            part.block_until_ready()
+            # On remote-execution backends block_until_ready can return
+            # before the copy has actually consumed the host buffer
+            # (BASELINE.md caveat); fetching bytes is the only portable
+            # completion fence, and one tiny fetch per 64MiB chunk is
+            # noise next to the disk read.
+            np.asarray(part[:1])
+            parts.append(part)
     if not parts:
         out = jax.device_put(np.zeros((0,), np.uint8), device)
     elif len(parts) == 1:

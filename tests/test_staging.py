@@ -2,6 +2,9 @@
 oim_tpu/data/staging.py). The library is built in-fixture via make (skip when
 no toolchain); the fallback path is tested by forcing the lib away."""
 
+import os
+from pathlib import Path
+
 import numpy as np
 import pytest
 
@@ -99,6 +102,68 @@ def test_stage_file_to_device_dtype_shape(native, tmp_path):
     )
     assert out.shape == (32, 32)
     np.testing.assert_array_equal(np.asarray(out).reshape(-1), vals)
+
+
+def test_stream_under_thread_sanitizer(datafile, tmp_path):
+    """Race-checks the filler/consumer buffer hand-off: builds the TSAN
+    variant of the engine (`make -C native tsan`) and drives a full stream
+    through it in a subprocess with libtsan preloaded (required for TSAN
+    in a shared library loaded via dlopen). The reference configures no
+    sanitizers at all (SURVEY.md §5.2); this is our -race equivalent."""
+    import shutil
+    import subprocess
+    import sys
+
+    libtsan = None
+    for cand in ("/usr/lib/x86_64-linux-gnu/libtsan.so.2",
+                 "/usr/lib/x86_64-linux-gnu/libtsan.so.0"):
+        if os.path.exists(cand):
+            libtsan = cand
+            break
+    if libtsan is None or shutil.which("make") is None:
+        pytest.skip("libtsan / make unavailable")
+    native_dir = Path(staging.__file__).resolve().parent.parent.parent / "native"
+    r = subprocess.run(["make", "-C", str(native_dir), "tsan"],
+                       capture_output=True, timeout=120)
+    if r.returncode != 0:
+        pytest.skip(f"tsan build failed: {r.stderr.decode()[-200:]}")
+
+    path, data = datafile
+    driver = """
+import ctypes, sys
+lib = ctypes.CDLL(sys.argv[1])
+lib.oim_stream_open.restype = ctypes.c_void_p
+lib.oim_stream_open.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int, ctypes.c_int]
+lib.oim_stream_next.restype = ctypes.c_int64
+lib.oim_stream_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64)]
+lib.oim_stream_release.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+lib.oim_stream_close.argtypes = [ctypes.c_void_p]
+h = lib.oim_stream_open(sys.argv[2].encode(), 1 << 18, 3, 1)
+assert h, "open failed"
+total = 0
+while True:
+    p = ctypes.c_void_p(); off = ctypes.c_int64()
+    n = lib.oim_stream_next(h, ctypes.byref(p), ctypes.byref(off))
+    if n <= 0:
+        break
+    bytes((ctypes.c_uint8 * n).from_address(p.value))  # touch every byte
+    total += n
+    lib.oim_stream_release(h, p)
+lib.oim_stream_close(h)
+print("TOTAL", total)
+"""
+    env = dict(os.environ, LD_PRELOAD=libtsan, TSAN_OPTIONS="exitcode=66")
+    # JAX/conftest env must not leak TSAN into unrelated subprocess inits.
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, "-c", driver,
+         str(native_dir / "libstaging_tsan.so"), str(path)],
+        capture_output=True, timeout=120, env=env,
+    )
+    out = r.stdout.decode() + r.stderr.decode()
+    assert r.returncode == 0, f"TSAN reported races or crash:\n{out[-2000:]}"
+    assert f"TOTAL {len(data)}" in out
+    assert "ThreadSanitizer" not in out
 
 
 def test_file_source_uses_staging(native, datafile):
