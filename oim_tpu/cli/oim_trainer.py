@@ -37,8 +37,15 @@ def parse_mesh(spec: str):
 
 
 def feeder_batches(args, cfg: TrainConfig, tls):
-    """Batches sliced from a feeder-published volume (config-3 style: the
-    whole shard lands in the training process, batches are views)."""
+    """Batches from a feeder-published volume.
+
+    Default (--feed-window-bytes > 0): a WINDOWED stream — only one window
+    of the volume is host-resident at a time (ranged ReadVolume through the
+    proxy in remote mode), so a volume larger than host RAM trains fine;
+    the hot-path rule of SURVEY §3.5 applied to the feed. With
+    --feed-window-bytes 0 the whole volume is materialized once and batches
+    are views (config-3 style, fine for small volumes).
+    """
     from oim_tpu.feeder import Feeder
     from oim_tpu.spec import pb
 
@@ -54,30 +61,89 @@ def feeder_batches(args, cfg: TrainConfig, tls):
     else:
         req.malloc.SetInParent()
     pub = feeder.publish(req, timeout=args.publish_timeout)
-    # Local mode hands back the live array; remote mode streams the data
-    # window through the proxy (ReadVolume).
-    data = np.asarray(pub.array) if pub.array is not None else feeder.fetch(
-        args.volume, timeout=args.publish_timeout)
-    from_context().info(
-        "volume published", volume=args.volume, shape=str(data.shape)
+    window = getattr(args, "feed_window_bytes", 0)
+
+    if window <= 0:
+        # Whole-volume mode: local hands back the live array; remote streams
+        # the full data window through the proxy (ReadVolume).
+        data = np.asarray(pub.array) if pub.array is not None else feeder.fetch(
+            args.volume, timeout=args.publish_timeout)
+        from_context().info(
+            "volume published", volume=args.volume, shape=str(data.shape)
+        )
+        i = 0
+        if cfg.model.startswith("llama"):
+            tokens = data.reshape(-1)
+            span = cfg.seq_len + 1
+            n = (tokens.size // span) * span
+            tokens = tokens[:n].reshape(-1, span).astype(np.int32)
+            while True:
+                idx = np.arange(i, i + cfg.batch_size) % tokens.shape[0]
+                yield {"tokens": tokens[idx]}
+                i += cfg.batch_size
+        else:
+            images = data.astype(np.float32)
+            labels = np.zeros((images.shape[0],), np.int32)
+            while True:
+                idx = np.arange(i, i + cfg.batch_size) % images.shape[0]
+                yield {"images": images[idx], "labels": labels[idx]}
+                i += cfg.batch_size
+        return
+
+    from oim_tpu.controller.backend import spec_dtype
+
+    # The first window also carries the volume's ArraySpec (dtype/shape).
+    w, total, spec = feeder.fetch_window(
+        args.volume, 0, window, timeout=args.publish_timeout
     )
-    i = 0
+    dt = (np.dtype(spec_dtype(spec))
+          if spec is not None and spec.dtype else np.dtype(np.uint8))
     if cfg.model.startswith("llama"):
-        tokens = data.reshape(-1)
-        span = cfg.seq_len + 1
-        n = (tokens.size // span) * span
-        tokens = tokens[:n].reshape(-1, span).astype(np.int32)
-        while True:
-            idx = np.arange(i, i + cfg.batch_size) % tokens.shape[0]
-            yield {"tokens": tokens[idx]}
-            i += cfg.batch_size
+        rec_bytes = (cfg.seq_len + 1) * dt.itemsize
+
+        def to_batch(raw):
+            recs = raw.view(dt).reshape(cfg.batch_size, -1)
+            return {"tokens": recs.astype(np.int32)}
     else:
-        images = data.astype(np.float32)
-        labels = np.zeros((images.shape[0],), np.int32)
-        while True:
-            idx = np.arange(i, i + cfg.batch_size) % images.shape[0]
-            yield {"images": images[idx], "labels": labels[idx]}
-            i += cfg.batch_size
+        if spec is not None and len(spec.shape) > 1:
+            sample = tuple(int(d) for d in spec.shape[1:])
+        else:
+            sample = (cfg.image_size, cfg.image_size, 3)
+        rec_bytes = int(np.prod(sample)) * dt.itemsize
+        labels = np.zeros((cfg.batch_size,), np.int32)
+
+        def to_batch(raw):
+            imgs = raw.view(dt).reshape((cfg.batch_size,) + sample)
+            return {"images": imgs.astype(np.float32), "labels": labels}
+
+    need = cfg.batch_size * rec_bytes
+    if total < need:
+        raise SystemExit(
+            f"volume {args.volume!r} holds {total} bytes but one batch needs "
+            f"{need} ({cfg.batch_size} records x {rec_bytes}B); shrink the "
+            f"batch/seq or use --feed-window-bytes 0 (whole-volume mode)"
+        )
+    from_context().info(
+        "volume published (windowed feed)", volume=args.volume,
+        total_bytes=total, window_bytes=window, record_bytes=rec_bytes,
+    )
+    carry = np.zeros((0,), np.uint8)
+    offset = w.size
+    while True:
+        carry = np.concatenate([carry, w]) if carry.size else np.asarray(w)
+        while carry.size >= need:
+            yield to_batch(carry[:need])
+            carry = carry[need:]
+        if offset >= total:
+            # Wrap to the volume start. Whole RECORDS in the carry survive
+            # the wrap (only a partial-record byte tail is dropped, since
+            # the next epoch restarts record-aligned at offset 0).
+            offset = 0
+            carry = carry[:(carry.size // rec_bytes) * rec_bytes]
+        w, total, _ = feeder.fetch_window(
+            args.volume, offset, window, timeout=args.publish_timeout
+        )
+        offset += w.size
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -109,7 +175,13 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--volume", default="train-data")
     parser.add_argument("--volume-file", default="",
                         help="stage this file as the training volume")
+    parser.add_argument("--feed-window-bytes", type=int, default=64 << 20,
+                        help="host-resident feed window; 0 = materialize "
+                             "the whole volume (small volumes only)")
     parser.add_argument("--publish-timeout", type=float, default=60.0)
+    parser.add_argument("--profile", default="",
+                        help="capture a jax.profiler trace of the train "
+                             "loop into this directory")
     parser.add_argument(
         "--expected-hosts", type=int, default=1,
         help="multi-host: wait for this many controllers in the registry, "
@@ -177,8 +249,11 @@ def main(argv: list[str] | None = None) -> int:
     elif not args.synthetic:
         args.synthetic = True
 
+    from oim_tpu.common.profiling import profile_trace
+
     trainer = Trainer(cfg, axes=parse_mesh(args.mesh))
-    loss = trainer.run(steps=args.steps, data=data)
+    with profile_trace(args.profile):
+        loss = trainer.run(steps=args.steps, data=data)
     log.info("done", final_loss=round(loss, 4))
     if server is not None:
         server.stop()

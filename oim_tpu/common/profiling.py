@@ -1,0 +1,48 @@
+"""Profiling: jax.profiler traces as the step-timing observability layer.
+
+The reference scaffolds OpenTracing/Jaeger for its control plane but ships
+it disabled (pkg/oim-common/tracing.go:232-246); its active layer is gRPC
+call logging. This framework keeps the call-logging interceptors
+(oim_tpu/common/interceptors.py) for the control plane and uses JAX's
+native profiler for the data plane, per SURVEY.md §5.1: a TensorBoard-
+loadable trace of device compute, XLA ops, and host<->device transfers is
+the TPU analog of a Jaeger span tree.
+
+Usage: ``with profile_trace(dir):`` around the hot region, or the
+``--profile DIR`` flag on oim-trainer / bench.py. Empty dir = no-op.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from oim_tpu.common.logging import from_context
+
+
+@contextlib.contextmanager
+def profile_trace(trace_dir: str | None):
+    """jax.profiler.trace wrapper; no-op when trace_dir is falsy, and
+    degrades to a warning (not a crash) on backends that can't profile —
+    remote-execution tunnels may not support the profiler service."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    log = from_context()
+    try:
+        ctx = jax.profiler.trace(trace_dir)
+        ctx.__enter__()
+    except Exception as err:  # pragma: no cover - backend-dependent
+        log.error("profiler unavailable; continuing without trace",
+                  error=str(err))
+        yield
+        return
+    log.info("profiling", dir=trace_dir)
+    try:
+        yield
+    finally:
+        try:
+            ctx.__exit__(None, None, None)
+        except Exception as err:  # pragma: no cover - backend-dependent
+            log.error("profiler trace finalize failed", error=str(err))

@@ -162,28 +162,35 @@ class ControllerService(ControllerServicer):
                           + (f" ({volume.error})" if volume.error else ""))
         import numpy as np
 
-        # np.asarray pulls device arrays back host-side (device->host DMA);
-        # host-RAM volumes are zero-copy.
-        data = np.ascontiguousarray(np.asarray(volume.array))
-        raw = data.view(np.uint8).reshape(-1)
+        arr = volume.array
+        itemsize = arr.dtype.itemsize
+        total = arr.size * itemsize
         start = int(request.offset)
-        if start < 0 or start > raw.size:
+        if start < 0 or start > total:
             context.abort(grpc.StatusCode.OUT_OF_RANGE, f"offset {start}")
-        end = raw.size if request.length == 0 else min(start + int(request.length), raw.size)
+        end = total if request.length == 0 else min(start + int(request.length), total)
+        # Materialize only the requested range: slicing in element space
+        # before np.asarray keeps the device->host DMA (and host RAM) at
+        # window size, not volume size — ranged reads are the windowed
+        # feed's hot path.
+        e0, e1 = start // itemsize, -(-end // itemsize) if end else 0
+        host = np.ascontiguousarray(np.asarray(arr.reshape(-1)[e0:e1]))
+        raw_win = host.view(np.uint8).reshape(-1)[
+            start - e0 * itemsize:end - e0 * itemsize]
         chunk = int(request.chunk_bytes) or self.DEFAULT_READ_CHUNK
         chunk = max(1, min(chunk, self.DEFAULT_READ_CHUNK))
         first = True
         for off in range(start, end, chunk) if start < end else [start]:
             stop = min(off + chunk, end)
             msg = pb.ReadVolumeChunk(
-                data=raw[off:stop].tobytes(), offset=off
+                data=raw_win[off - start:stop - start].tobytes(), offset=off
             )
             if first:
                 msg.spec.CopyFrom(volume.spec)
-                msg.spec.dtype = msg.spec.dtype or str(data.dtype)
+                msg.spec.dtype = msg.spec.dtype or str(arr.dtype)
                 if not msg.spec.shape:
-                    msg.spec.shape.extend(data.shape)
-                msg.total_bytes = raw.size
+                    msg.spec.shape.extend(arr.shape)
+                msg.total_bytes = total
                 first = False
             yield msg
 

@@ -275,6 +275,59 @@ class Feeder:
         finally:
             channel.close()
 
+    def fetch_window(self, volume_id: str, offset: int = 0, length: int = 0,
+                     timeout: float = 120.0):
+        """A byte range of the staged volume: (uint8 array, total_bytes,
+        ArraySpec). length == 0 means "to the end".
+
+        The windowed form of fetch(): a consumer whose working set is
+        smaller than the volume streams windows instead of materializing
+        the whole thing host-side (the data window stays bounded the way
+        the reference bounds SCSI targets, controller.go:127-148).
+        """
+        import numpy as np
+
+        if self.controller is not None:
+            volume = self.controller.get_volume(volume_id)
+            if volume is None:
+                raise PublishError(f"no volume {volume_id!r}")
+            arr = volume.array
+            itemsize = arr.dtype.itemsize
+            total = arr.size * itemsize
+            end = total if length == 0 else min(offset + length, total)
+            # Slice in ELEMENT space before materializing: only the window
+            # crosses device->host (np.asarray of the whole array would DMA
+            # the full volume back per window — the exact cost windowing
+            # exists to avoid).
+            e0, e1 = offset // itemsize, -(-end // itemsize)
+            host = np.asarray(arr.reshape(-1)[e0:e1])
+            raw = host.view(np.uint8)[offset - e0 * itemsize:end - e0 * itemsize]
+            return raw, total, volume.spec
+        channel = self._registry_channel()
+        try:
+            stub = ControllerStub(channel)
+            parts: list[bytes] = []
+            spec = None
+            total = 0
+            try:
+                for chunk in stub.ReadVolume(
+                    pb.ReadVolumeRequest(
+                        volume_id=volume_id, offset=offset, length=length
+                    ),
+                    metadata=[(CONTROLLER_ID_META, self.controller_id)],
+                    timeout=timeout,
+                ):
+                    if spec is None and chunk.HasField("spec"):
+                        spec = chunk.spec
+                        total = chunk.total_bytes
+                    parts.append(chunk.data)
+            except grpc.RpcError as err:
+                raise PublishError(f"{err.code().name}: {err.details()}") from err
+            raw = np.frombuffer(b"".join(parts), dtype=np.uint8)
+            return raw, total, spec
+        finally:
+            channel.close()
+
     # -- unpublish ---------------------------------------------------------
 
     def unpublish(self, volume_id: str) -> None:

@@ -99,11 +99,22 @@ def init(rng, cfg: Config = Config()):
 
 
 def _batchnorm(x, p, s, training, momentum, eps):
-    """Float32 statistics over (N, H, W); bf16 in/out."""
-    xf = x.astype(jnp.float32)
+    """Float32 statistics over (N, H, W); bf16 in/out.
+
+    Bandwidth-tuned for TPU (ResNet at bf16 on v5e is HBM-bound, not
+    MXU-bound): the two statistics are one fused pass over x (sum and
+    sum-of-squares reduce together; jnp.var would re-read x), and the
+    normalization is folded to a per-channel affine applied in the input
+    dtype — a [C]-vector multiply-add XLA fuses into the neighboring
+    conv instead of a full-tensor f32 round-trip.
+    """
     if training:
-        mean = jnp.mean(xf, axis=(0, 1, 2))
-        var = jnp.var(xf, axis=(0, 1, 2))
+        xf = x.astype(jnp.float32)
+        n = xf.size // xf.shape[-1]
+        m1 = jnp.sum(xf, axis=(0, 1, 2)) / n
+        m2 = jnp.sum(xf * xf, axis=(0, 1, 2)) / n
+        mean = m1
+        var = jnp.maximum(m2 - m1 * m1, 0.0)
         new_s = {
             "mean": momentum * s["mean"] + (1 - momentum) * mean,
             "var": momentum * s["var"] + (1 - momentum) * var,
@@ -111,8 +122,10 @@ def _batchnorm(x, p, s, training, momentum, eps):
     else:
         mean, var = s["mean"], s["var"]
         new_s = s
-    out = (xf - mean) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
-    return out.astype(x.dtype), new_s
+    inv = jax.lax.rsqrt(var + eps) * p["scale"]  # [C] f32
+    a = inv.astype(x.dtype)
+    b = (p["bias"] - mean * inv).astype(x.dtype)
+    return x * a + b, new_s
 
 
 def _conv(x, kernel, stride=1, padding="SAME"):

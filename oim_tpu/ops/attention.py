@@ -1,14 +1,18 @@
-"""Attention: pallas flash kernel (TPU) + jnp reference (everywhere).
+"""Attention: pallas flash kernels (TPU) + jnp reference (everywhere).
 
-The flash kernel streams K/V blocks through VMEM with an online softmax so
+The flash forward streams K/V blocks through VMEM with an online softmax so
 the [T, T] score matrix never materializes in HBM — the standard TPU
 blockwise pattern: sequential innermost grid dimension carries the
-accumulator in VMEM scratch across K blocks.
+accumulator in VMEM scratch across K blocks. It additionally emits the
+per-row logsumexp, which the backward consumes.
 
-Backward pass: recompute-based (jax.custom_vjp over the reference math under
-jax.checkpoint semantics). O(T^2) transient in the bwd only; long-context
-training routes through ring attention (oim_tpu/parallel/ring.py) where the
-per-chip T is small. A pallas bwd kernel is a planned upgrade.
+The backward is also blockwise pallas (no [T, T] materialization): scores
+are recomputed per block from Q/K and the saved logsumexp, then two kernels
+accumulate the three gradients — dKV walks q-blocks sequentially per
+k-block, dQ walks k-blocks sequentially per q-block — each carrying its
+f32 accumulator in VMEM scratch. Long-context training still routes through
+ring attention (oim_tpu/parallel/ring.py), which calls these kernels on the
+per-chip sequence slice.
 
 Shapes: [batch, seq, heads, head_dim] ("BTHD"). GQA: kv heads may divide q
 heads.
@@ -61,7 +65,7 @@ def mha_reference(q, k, v, causal: bool = True, scale: float | None = None):
 # ---------------------------------------------------------------- pallas ----
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k, q_offset):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k, q_offset):
     """One (q-block, k-block) cell; innermost grid dim walks k blocks
     sequentially so the VMEM scratch (acc/m/l) carries across them."""
     import jax.numpy as jnp
@@ -118,9 +122,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, scale, c
     def _finish():
         l = jnp.maximum(l_ref[:, 0], 1e-30)
         o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+        # lse rides a [bh, tq, 1] array: a (block_q, 1) tile keeps the TPU
+        # (8, 128)-divisibility rule happy where (1, block_q) would not.
+        lse_ref[0] = (m_ref[:, 0] + jnp.log(l))[:, None]
 
 
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    """Returns (out [B,T,H,D], lse [B*H, Tq] f32)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -142,7 +150,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
         _flash_kernel, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, q_offset=tk - tq,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -150,8 +158,14 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh, qi, kj: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, tq, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -159,7 +173,201 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qt, kt, vt)
-    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    return out.reshape(b, h, tq, d).transpose(0, 2, 1, 3), lse
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *,
+                          scale, causal, block_q, block_k, q_offset):
+    """One (k-block, q-block) cell; innermost grid dim walks q blocks
+    sequentially so dk/dv accumulate in VMEM across them."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * block_q + q_offset
+    k_start = kj * block_k
+
+    def _compute():
+        q = q_ref[0]    # [block_q, d]
+        k = k_ref[0]    # [block_k, d]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)  # [block_q, d]
+        lse = lse_ref[0][:, 0]      # [block_q]
+        delta = delta_ref[0][:, 0]  # [block_q]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [block_q, block_k]
+        # exp(s - lse) is the already-normalized softmax row.
+        p = jnp.exp(s - lse[:, None])
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        # dV += P^T dO
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        # dP = dO V^T;  dS = P * (dP - delta) * scale
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        # dK += dS^T Q
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(k_start <= q_start + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc, *,
+                         scale, causal, block_q, block_k, q_offset):
+    """One (q-block, k-block) cell; innermost grid dim walks k blocks
+    sequentially so dq accumulates in VMEM across them."""
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = qi * block_q + q_offset
+    k_start = kj * block_k
+
+    def _compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]
+        delta = delta_ref[0][:, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        p = jnp.exp(s - lse[:, None])
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        # dQ += dS K
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(k_start <= q_start + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(kj == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
+                    interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    dot = g.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    ot = out.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
+    # delta_i = rowsum(dO_i * O_i): the softmax-normalization term of dS.
+    delta = jnp.sum(
+        dot.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1, keepdims=True
+    )
+
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    q_offset = tk - tq
+
+    in_specs_kmajor = [
+        pl.BlockSpec((1, block_q, d), lambda bh, kj, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0)),
+        pl.BlockSpec((1, block_q, d), lambda bh, kj, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda bh, kj, qi: (bh, qi, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda bh, kj, qi: (bh, qi, 0)),
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, q_offset=q_offset,
+        ),
+        grid=(b * h, tk // block_k, tq // block_q),
+        in_specs=in_specs_kmajor,
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, tk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, tk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    in_specs_qmajor = [
+        pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+        pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda bh, qi, kj: (bh, qi, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda bh, qi, kj: (bh, qi, 0)),
+    ]
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, q_offset=q_offset,
+        ),
+        grid=(b * h, tq // block_q, tk // block_k),
+        in_specs=in_specs_qmajor,
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, tq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    unflat = lambda x, t: x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return unflat(dq, tq), unflat(dk, tk), unflat(dv, tk)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -175,18 +383,24 @@ def flash_attention(
     first) and seq lengths divisible by the block sizes."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: mha_reference(q, k, v, causal, scale), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _flash_backward(
+        q, k, v, out, lse, g, causal, scale, block_q, block_k, interpret
+    )
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -332,9 +332,17 @@ class Trainer:
         last_loss = float("nan")
         t_prev = time.monotonic()
         last_logged = start_step
+        # Double-buffered feed: the batch for step i+1 is placed on device
+        # while step i's (asynchronously dispatched) compute runs — the
+        # per-step host work overlaps device time instead of serializing
+        # with it (the hot-path-off-the-control-plane rule of SURVEY §3.5
+        # applied to the batch loop).
+        pending = self.place_batch(next(data)) if start_step < steps else None
         for i in range(start_step, steps):
-            batch = self.place_batch(next(data))
+            batch = pending
             self.state, stats = self.step_fn(self.state, batch)
+            if i + 1 < steps:
+                pending = self.place_batch(next(data))
             if (i + 1) % cfg.log_every == 0 or i + 1 == steps:
                 last_loss = float(stats["loss"])  # sync point
                 now = time.monotonic()

@@ -178,6 +178,62 @@ class TestMockOIM:
         with pytest.raises(PublishError, match="NOT_FOUND"):
             feeder.fetch("nope")
 
+    def test_fetch_window_ranges(self, cluster, tmp_path):
+        """Ranged ReadVolume (the windowed data window): windows reassemble
+        to the volume, short reads at the end, spec on every response."""
+        registry, _ = cluster
+        data = np.random.RandomState(2).bytes(100_000)
+        path = tmp_path / "win.bin"
+        path.write_bytes(data)
+        feeder = self.feeder_for(registry)
+        feeder.publish(
+            pb.MapVolumeRequest(
+                volume_id="vol-w",
+                file=pb.FileParams(path=str(path), format="raw"),
+            )
+        )
+        got = bytearray()
+        offset = 0
+        window = 33_000  # deliberately unaligned
+        while offset < len(data):
+            w, total, spec = feeder.fetch_window("vol-w", offset, window)
+            assert total == len(data)
+            assert spec is not None
+            got += w.tobytes()
+            offset += w.size
+        assert bytes(got) == data
+
+    def test_windowed_feeder_batches_match_whole_volume(self, cluster, tmp_path):
+        """cli.oim_trainer.feeder_batches: the windowed stream must yield
+        exactly the batches the whole-volume mode yields (first epoch)."""
+        import argparse
+
+        from oim_tpu.cli.oim_trainer import feeder_batches
+        from oim_tpu.train import TrainConfig
+
+        registry, _ = cluster
+        tokens = np.random.RandomState(3).randint(
+            0, 250, 64 * 65 * 4, dtype=np.int32
+        )
+        path = tmp_path / "tokens.bin"
+        tokens.tofile(path)
+
+        def make_args(window):
+            return argparse.Namespace(
+                registry=registry.addr, controller_id="host-0",
+                volume="vol-t", volume_file=str(path),
+                feed_window_bytes=window, publish_timeout=30.0,
+            )
+
+        cfg = TrainConfig(model="llama-tiny", batch_size=4, seq_len=64)
+        whole = feeder_batches(make_args(0), cfg, None)
+        windowed = feeder_batches(make_args(10_000), cfg, None)
+        for _ in range(16):
+            a = next(whole)["tokens"]
+            b = next(windowed)["tokens"]
+            assert a.dtype == b.dtype == np.int32
+            np.testing.assert_array_equal(a, b)
+
     def test_remote_publish_failure(self, cluster):
         registry, _ = cluster
         feeder = self.feeder_for(registry)
