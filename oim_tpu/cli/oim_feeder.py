@@ -1,0 +1,83 @@
+"""oim-feeder daemon: the standalone node service (reference
+cmd/oim-csi-driver/main.go:19-69).
+
+Two mutually exclusive modes, like the reference's -spdk-socket XOR
+-oim-registry-address (main.go:30-38): **local** (--backend malloc|tpu —
+the daemon owns an in-process controller and the JAX runtime; volumes
+live here) and **remote** (--registry + --controller-id — the daemon is a
+thin node-side proxy to a controller elsewhere; data crosses the wire
+through the registry's transparent proxy).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from oim_tpu.cli.common import add_common_flags, load_tls_flags, setup_logging
+from oim_tpu.common.logging import from_context
+from oim_tpu.feeder import Feeder, FeederDaemon, feeder_server
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser("oim-feeder")
+    parser.add_argument(
+        "--endpoint", default="tcp://0.0.0.0:9001",
+        help="listen endpoint (tcp:// or unix://)",
+    )
+    parser.add_argument(
+        "--backend", default="",
+        choices=("", "malloc", "tpu"),
+        help="local mode: serve an in-process controller with this backend",
+    )
+    parser.add_argument("--registry", default="",
+                        help="remote mode: registry address")
+    parser.add_argument("--controller-id", default="",
+                        help="remote mode: target controller")
+    parser.add_argument("--publish-timeout", type=float, default=60.0)
+    add_common_flags(parser)
+    args = parser.parse_args(argv)
+    setup_logging(args)
+    log = from_context()
+
+    local = bool(args.backend)
+    remote = bool(args.registry or args.controller_id)
+    if local == remote:
+        raise SystemExit(
+            "exactly one of --backend (local) or "
+            "--registry + --controller-id (remote) required"
+        )
+
+    if local:
+        from oim_tpu.controller.controller import ControllerService
+
+        if args.backend == "tpu":
+            from oim_tpu.controller.tpu_backend import TPUBackend
+
+            backend = TPUBackend()
+        else:
+            from oim_tpu.controller import MallocBackend
+
+            backend = MallocBackend()
+        feeder = Feeder(controller=ControllerService(backend))
+    else:
+        feeder = Feeder(
+            registry_address=args.registry,
+            controller_id=args.controller_id,
+            tls=load_tls_flags(args),
+        )
+
+    daemon = FeederDaemon(feeder, default_timeout=args.publish_timeout)
+    server = feeder_server(args.endpoint, daemon, tls=load_tls_flags(args))
+    log.info(
+        "oim-feeder serving", endpoint=args.endpoint, addr=server.addr,
+        mode="local" if local else "remote",
+    )
+    try:
+        server.wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

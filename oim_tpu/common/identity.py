@@ -1,0 +1,40 @@
+"""Identity/Probe service: name, version, capability discovery.
+
+The analog of the reference's CSI identity server
+(pkg/oim-csi-driver/identityserver.go:15-38): every long-running component
+(controller, feeder daemon) serves this next to its main service on the
+same endpoint (oim-driver.go:199-207), so consumers can negotiate what a
+component supports — staging backends, data sources, emulation
+personalities, mesh axes — before using it.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable
+
+import oim_tpu
+from oim_tpu.spec import IdentityServicer, pb
+
+
+class IdentityService(IdentityServicer):
+    def __init__(
+        self,
+        name: str,
+        capabilities: Iterable[str] = (),
+        ready_fn: Callable[[], bool] | None = None,
+        version: str | None = None,
+    ):
+        self.name = name
+        self.capabilities = sorted(capabilities)
+        self.ready_fn = ready_fn or (lambda: True)
+        self.version = version or oim_tpu.__version__
+
+    def GetInfo(self, request, context):
+        return pb.GetInfoReply(
+            name=self.name,
+            version=self.version,
+            capabilities=self.capabilities,
+        )
+
+    def Probe(self, request, context):
+        return pb.ProbeReply(ready=bool(self.ready_fn()))

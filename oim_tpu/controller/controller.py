@@ -278,13 +278,38 @@ class Controller:
             self._thread = None
 
 
+def controller_capabilities(service: ControllerService) -> list[str]:
+    """Capability strings for the Identity service: the staging backend
+    (MallocBackend -> "backend:malloc", TPUBackend -> "backend:tpu") plus
+    every source kind load_source accepts."""
+    from oim_tpu.controller.source import SOURCES
+
+    backend = getattr(service, "backend", None)
+    if backend is None:  # mock controllers in tests
+        return []
+    name = type(backend).__name__.removesuffix("Backend").lower()
+    return [f"backend:{name}"] + [f"source:{s}" for s in SOURCES]
+
+
 def controller_server(
     endpoint: str, service: ControllerService, tls: TLSConfig | None = None
 ) -> NonBlockingGRPCServer:
-    """Serve a controller (controller.go:479-495); also used by tests to serve
-    mocks."""
+    """Serve a controller + its Identity service on one endpoint
+    (controller.go:479-495; identity co-serving per oim-driver.go:199-207);
+    also used by tests to serve mocks."""
+    from oim_tpu.common.identity import IdentityService
+    from oim_tpu.spec import add_identity_to_server
+
+    identity = IdentityService(
+        "oim-controller", capabilities=controller_capabilities(service)
+    )
     server = NonBlockingGRPCServer(
         endpoint, tls=tls, interceptors=(LogServerInterceptor(),)
     )
-    server.start(lambda s: add_controller_to_server(service, s))
+
+    def register(s):
+        add_controller_to_server(service, s)
+        add_identity_to_server(identity, s)
+
+    server.start(register)
     return server

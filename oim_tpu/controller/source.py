@@ -10,6 +10,11 @@ import numpy as np
 
 from oim_tpu.data import readers
 
+# Source kinds load_source accepts, advertised as "source:<kind>"
+# capabilities by the Identity service ("malloc" is backend-level, not a
+# source). "ceph" is accepted at the protocol level but requires a cluster.
+SOURCES = ("file", "tfrecord", "webdataset", "ceph")
+
 
 def load_source(params_kind: str, params: Any) -> np.ndarray:
     if params_kind == "file":

@@ -13,3 +13,4 @@ from oim_tpu.feeder.emulation import (  # noqa: F401
     map_volume_params,
     register_emulation,
 )
+from oim_tpu.feeder.service import FeederDaemon, feeder_server  # noqa: F401

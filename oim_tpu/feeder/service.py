@@ -1,0 +1,154 @@
+"""The standalone feeder daemon: the feeder library served as a long-running
+node service.
+
+The reference's node plugin is a daemon, not a library — kubelet talks to
+oim-csi-driver over a socket (cmd/oim-csi-driver/main.go:19-69,
+pkg/oim-csi-driver/oim-driver.go:199-207). This is that shape for oim-tpu:
+consumer processes that don't link the feeder (or aren't Python) publish,
+read, and unpublish volumes through ``oim.v1.Feeder``, and discover the
+daemon's wiring through ``oim.v1.Identity`` served on the same endpoint.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from oim_tpu.common.identity import IdentityService
+from oim_tpu.common.interceptors import LogServerInterceptor
+from oim_tpu.common.server import NonBlockingGRPCServer
+from oim_tpu.common.tlsutil import TLSConfig
+from oim_tpu.feeder.driver import Feeder, PublishError, PublishedVolume
+from oim_tpu.feeder.emulation import emulations
+from oim_tpu.spec import (
+    FeederServicer,
+    add_feeder_to_server,
+    add_identity_to_server,
+    pb,
+)
+
+# Same headroom rule as ControllerService.DEFAULT_READ_CHUNK: chunks must
+# clear gRPC's 4 MiB default message cap with framing to spare.
+READ_CHUNK = 3 << 20
+
+
+def _reply_for(pub: PublishedVolume, spec: pb.ArraySpec | None = None) -> pb.PublishVolumeReply:
+    reply = pb.PublishVolumeReply(
+        placement=pb.HBMPlacement(
+            coordinate=pub.coordinate.to_proto(),
+            device_id=pub.device_id,
+            bytes=pub.bytes,
+        ),
+        buffer_handle=pub.handle,
+    )
+    if spec is not None:
+        reply.spec.CopyFrom(spec)
+    return reply
+
+
+class FeederDaemon(FeederServicer):
+    """oim.v1.Feeder over a Feeder instance (local or remote mode)."""
+
+    def __init__(self, feeder: Feeder, default_timeout: float = 60.0):
+        self.feeder = feeder
+        self.default_timeout = default_timeout
+
+    def PublishVolume(self, request, context):
+        timeout = request.timeout_seconds or self.default_timeout
+        try:
+            if request.emulate:
+                pub = self.feeder.publish_emulated(
+                    request.emulate,
+                    request.volume_id,
+                    dict(request.attributes),
+                    dict(request.secrets),
+                    timeout=timeout,
+                )
+            elif request.HasField("map"):
+                pub = self.feeder.publish(request.map, timeout=timeout)
+            else:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    "need map or emulate+volume_id",
+                )
+        except ValueError as err:  # unknown emulation / bad attributes
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(err))
+        except PublishError as err:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(err))
+        return _reply_for(pub)
+
+    def UnpublishVolume(self, request, context):
+        try:
+            self.feeder.unpublish(request.volume_id)
+        except PublishError as err:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(err))
+        return pb.UnpublishVolumeReply()
+
+    def ListPublished(self, request, context):
+        with self.feeder._lock:
+            published = list(self.feeder._published.values())
+        return pb.ListPublishedReply(
+            published=[_reply_for(p) for p in published]
+        )
+
+    def ReadPublished(self, request, context):
+        """Ranged data window for daemon clients: windows pulled through
+        the feeder (which proxies to the controller in remote mode) and
+        re-chunked under the message cap."""
+        volume_id = request.volume_id
+        offset = int(request.offset)
+        length = int(request.length)
+        chunk = int(request.chunk_bytes) or READ_CHUNK
+        chunk = max(1, min(chunk, READ_CHUNK))
+        try:
+            window, total, spec = self.feeder.fetch_window(
+                volume_id, offset, length, timeout=self.default_timeout
+            )
+        except PublishError as err:
+            code = (
+                grpc.StatusCode.NOT_FOUND
+                if "NOT_FOUND" in str(err) or "no volume" in str(err)
+                else grpc.StatusCode.FAILED_PRECONDITION
+            )
+            context.abort(code, str(err))
+        first = True
+        end = offset + window.size
+        for off in range(offset, end, chunk) if window.size else [offset]:
+            stop = min(off + chunk, end)
+            msg = pb.ReadVolumeChunk(
+                data=window[off - offset:stop - offset].tobytes(), offset=off
+            )
+            if first:
+                if spec is not None:
+                    msg.spec.CopyFrom(spec)
+                msg.total_bytes = total
+                first = False
+            yield msg
+
+
+def feeder_capabilities(feeder: Feeder) -> list[str]:
+    caps = [f"emulation:{e}" for e in emulations()]
+    caps.append("mode:local" if feeder.controller is not None else "mode:remote")
+    if feeder.controller is not None:
+        from oim_tpu.controller.controller import controller_capabilities
+
+        caps += controller_capabilities(feeder.controller)
+    return caps
+
+
+def feeder_server(
+    endpoint: str, daemon: FeederDaemon, tls: TLSConfig | None = None
+) -> NonBlockingGRPCServer:
+    """Serve Feeder + Identity on one endpoint (oim-driver.go:199-207)."""
+    identity = IdentityService(
+        "oim-feeder", capabilities=feeder_capabilities(daemon.feeder)
+    )
+    server = NonBlockingGRPCServer(
+        endpoint, tls=tls, interceptors=(LogServerInterceptor(),)
+    )
+
+    def register(s):
+        add_feeder_to_server(daemon, s)
+        add_identity_to_server(identity, s)
+
+    server.start(register)
+    return server

@@ -11,10 +11,18 @@ from oim_tpu.spec import oim_pb2 as pb  # noqa: F401
 from oim_tpu.spec.services import (  # noqa: F401
     ControllerStub,
     ControllerServicer,
+    FeederStub,
+    FeederServicer,
+    IdentityStub,
+    IdentityServicer,
     RegistryStub,
     RegistryServicer,
     add_controller_to_server,
+    add_feeder_to_server,
+    add_identity_to_server,
     add_registry_to_server,
     CONTROLLER_SERVICE,
+    FEEDER_SERVICE,
+    IDENTITY_SERVICE,
     REGISTRY_SERVICE,
 )

@@ -14,6 +14,8 @@ from oim_tpu.spec import oim_pb2 as pb
 
 REGISTRY_SERVICE = "oim.v1.Registry"
 CONTROLLER_SERVICE = "oim.v1.Controller"
+IDENTITY_SERVICE = "oim.v1.Identity"
+FEEDER_SERVICE = "oim.v1.Feeder"
 
 # method name -> (request class, reply class)
 REGISTRY_METHODS = {
@@ -32,6 +34,21 @@ CONTROLLER_METHODS = {
 # unary-stream methods (server streams the reply type).
 CONTROLLER_STREAM_METHODS = {
     "ReadVolume": (pb.ReadVolumeRequest, pb.ReadVolumeChunk),
+}
+
+IDENTITY_METHODS = {
+    "GetInfo": (pb.GetInfoRequest, pb.GetInfoReply),
+    "Probe": (pb.ProbeRequest, pb.ProbeReply),
+}
+
+FEEDER_METHODS = {
+    "PublishVolume": (pb.PublishVolumeRequest, pb.PublishVolumeReply),
+    "UnpublishVolume": (pb.UnpublishVolumeRequest, pb.UnpublishVolumeReply),
+    "ListPublished": (pb.ListPublishedRequest, pb.ListPublishedReply),
+}
+
+FEEDER_STREAM_METHODS = {
+    "ReadPublished": (pb.ReadVolumeRequest, pb.ReadVolumeChunk),
 }
 
 
@@ -74,6 +91,17 @@ class ControllerStub(_Stub):
     _service = CONTROLLER_SERVICE
     _methods = CONTROLLER_METHODS
     _stream_methods = CONTROLLER_STREAM_METHODS
+
+
+class IdentityStub(_Stub):
+    _service = IDENTITY_SERVICE
+    _methods = IDENTITY_METHODS
+
+
+class FeederStub(_Stub):
+    _service = FEEDER_SERVICE
+    _methods = FEEDER_METHODS
+    _stream_methods = FEEDER_STREAM_METHODS
 
 
 class RegistryServicer:
@@ -129,6 +157,28 @@ def _add_service(
     )
 
 
+class IdentityServicer:
+    def GetInfo(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "GetInfo not implemented")
+
+    def Probe(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "Probe not implemented")
+
+
+class FeederServicer:
+    def PublishVolume(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "PublishVolume not implemented")
+
+    def UnpublishVolume(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "UnpublishVolume not implemented")
+
+    def ListPublished(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "ListPublished not implemented")
+
+    def ReadPublished(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "ReadPublished not implemented")
+
+
 def add_registry_to_server(servicer: RegistryServicer, server: grpc.Server) -> None:
     _add_service(server, servicer, REGISTRY_SERVICE, REGISTRY_METHODS)
 
@@ -137,4 +187,14 @@ def add_controller_to_server(servicer: ControllerServicer, server: grpc.Server) 
     _add_service(
         server, servicer, CONTROLLER_SERVICE, CONTROLLER_METHODS,
         CONTROLLER_STREAM_METHODS,
+    )
+
+
+def add_identity_to_server(servicer: IdentityServicer, server: grpc.Server) -> None:
+    _add_service(server, servicer, IDENTITY_SERVICE, IDENTITY_METHODS)
+
+
+def add_feeder_to_server(servicer: FeederServicer, server: grpc.Server) -> None:
+    _add_service(
+        server, servicer, FEEDER_SERVICE, FEEDER_METHODS, FEEDER_STREAM_METHODS
     )

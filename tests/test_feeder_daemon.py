@@ -1,0 +1,176 @@
+"""Ring-1 tests for the standalone feeder daemon + Identity service
+(oim_tpu/feeder/service.py, oim_tpu/common/identity.py; reference
+cmd/oim-csi-driver + identityserver.go)."""
+
+import grpc
+import numpy as np
+import pytest
+
+import oim_tpu
+from oim_tpu.controller import MallocBackend
+from oim_tpu.controller import ControllerService, controller_server
+from oim_tpu.feeder import Feeder, FeederDaemon, feeder_server
+from oim_tpu.registry import MemRegistryDB, RegistryService
+from oim_tpu.registry.registry import registry_server
+from oim_tpu.spec import FeederStub, IdentityStub, pb
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """registry + controller + remote-mode feeder daemon, real sockets."""
+    db = MemRegistryDB()
+    registry = registry_server("tcp://localhost:0", RegistryService(db=db))
+    controller_service = ControllerService(MallocBackend())
+    controller = controller_server("tcp://localhost:0", controller_service)
+    db.set("host-0/address", controller.addr)
+    db.set("host-0/mesh", "1,2,3")
+    feeder = Feeder(registry_address=registry.addr, controller_id="host-0")
+    daemon = feeder_server("tcp://localhost:0", FeederDaemon(feeder))
+    yield registry, controller, daemon
+    daemon.force_stop()
+    registry.force_stop()
+    controller.force_stop()
+
+
+def _channel(server):
+    return grpc.insecure_channel(server.addr)
+
+
+class TestIdentity:
+    def test_controller_identity(self, cluster):
+        _, controller, _ = cluster
+        with _channel(controller) as ch:
+            info = IdentityStub(ch).GetInfo(pb.GetInfoRequest(), timeout=5)
+        assert info.name == "oim-controller"
+        assert info.version == oim_tpu.__version__
+        assert "backend:malloc" in info.capabilities
+        assert "source:file" in info.capabilities
+
+    def test_feeder_identity_and_probe(self, cluster):
+        _, _, daemon = cluster
+        with _channel(daemon) as ch:
+            stub = IdentityStub(ch)
+            info = stub.GetInfo(pb.GetInfoRequest(), timeout=5)
+            probe = stub.Probe(pb.ProbeRequest(), timeout=5)
+        assert info.name == "oim-feeder"
+        assert "mode:remote" in info.capabilities
+        assert any(c.startswith("emulation:") for c in info.capabilities)
+        assert probe.ready
+
+
+class TestFeederDaemon:
+    def test_publish_list_read_unpublish(self, cluster, tmp_path):
+        _, _, daemon = cluster
+        vals = np.arange(5000, dtype=np.int32)
+        path = tmp_path / "vol.npy"
+        np.save(path, vals)
+        with _channel(daemon) as ch:
+            stub = FeederStub(ch)
+            reply = stub.PublishVolume(
+                pb.PublishVolumeRequest(
+                    map=pb.MapVolumeRequest(
+                        volume_id="vol-d",
+                        file=pb.FileParams(path=str(path), format="npy"),
+                    )
+                ),
+                timeout=30,
+            )
+            assert reply.placement.bytes == vals.nbytes
+            # Coordinate merged from the registry default.
+            assert (reply.placement.coordinate.x,
+                    reply.placement.coordinate.y,
+                    reply.placement.coordinate.z) == (1, 2, 3)
+
+            listed = stub.ListPublished(pb.ListPublishedRequest(), timeout=5)
+            assert len(listed.published) == 1
+
+            # Full read reassembles the volume; spec on the first chunk.
+            chunks = list(stub.ReadPublished(
+                pb.ReadVolumeRequest(volume_id="vol-d"), timeout=30))
+            raw = b"".join(c.data for c in chunks)
+            assert np.frombuffer(raw, np.int32).tolist() == vals.tolist()
+            assert chunks[0].total_bytes == vals.nbytes
+            assert chunks[0].spec.dtype == "int32"
+
+            # Ranged read.
+            chunks = list(stub.ReadPublished(
+                pb.ReadVolumeRequest(volume_id="vol-d", offset=40, length=80),
+                timeout=30))
+            got = b"".join(c.data for c in chunks)
+            assert got == vals.tobytes()[40:120]
+            assert chunks[0].offset == 40
+
+            stub.UnpublishVolume(
+                pb.UnpublishVolumeRequest(volume_id="vol-d"), timeout=30)
+            listed = stub.ListPublished(pb.ListPublishedRequest(), timeout=5)
+            assert len(listed.published) == 0
+            # Idempotent: unknown unpublish succeeds.
+            stub.UnpublishVolume(
+                pb.UnpublishVolumeRequest(volume_id="vol-d"), timeout=30)
+
+    def test_publish_needs_map_or_emulate(self, cluster):
+        _, _, daemon = cluster
+        with _channel(daemon) as ch:
+            stub = FeederStub(ch)
+            with pytest.raises(grpc.RpcError) as err:
+                stub.PublishVolume(pb.PublishVolumeRequest(), timeout=5)
+            assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    def test_publish_unknown_emulation(self, cluster):
+        _, _, daemon = cluster
+        with _channel(daemon) as ch:
+            stub = FeederStub(ch)
+            with pytest.raises(grpc.RpcError) as err:
+                stub.PublishVolume(
+                    pb.PublishVolumeRequest(
+                        emulate="no-such", volume_id="v",
+                    ),
+                    timeout=5,
+                )
+            assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    def test_read_unknown_volume(self, cluster):
+        _, _, daemon = cluster
+        with _channel(daemon) as ch:
+            with pytest.raises(grpc.RpcError) as err:
+                list(FeederStub(ch).ReadPublished(
+                    pb.ReadVolumeRequest(volume_id="nope"), timeout=5))
+            assert err.value.code() == grpc.StatusCode.NOT_FOUND
+
+    def test_local_mode_daemon(self, tmp_path):
+        """Local mode: the daemon owns the controller; no registry."""
+        feeder = Feeder(controller=ControllerService(MallocBackend()))
+        daemon = feeder_server("tcp://localhost:0", FeederDaemon(feeder))
+        try:
+            data = np.random.RandomState(0).bytes(10_000)
+            path = tmp_path / "b.bin"
+            path.write_bytes(data)
+            with _channel(daemon) as ch:
+                info = IdentityStub(ch).GetInfo(pb.GetInfoRequest(), timeout=5)
+                assert "mode:local" in info.capabilities
+                assert "backend:malloc" in info.capabilities
+                stub = FeederStub(ch)
+                stub.PublishVolume(
+                    pb.PublishVolumeRequest(
+                        map=pb.MapVolumeRequest(
+                            volume_id="v",
+                            file=pb.FileParams(path=str(path), format="raw"),
+                        )
+                    ),
+                    timeout=30,
+                )
+                chunks = list(stub.ReadPublished(
+                    pb.ReadVolumeRequest(volume_id="v"), timeout=30))
+                assert b"".join(c.data for c in chunks) == data
+        finally:
+            daemon.force_stop()
+
+    def test_cli_entrypoint_parses(self):
+        """Mode validation in the CLI (local XOR remote)."""
+        from oim_tpu.cli.oim_feeder import main
+
+        with pytest.raises(SystemExit, match="exactly one"):
+            main(["--endpoint", "tcp://localhost:0"])
+        with pytest.raises(SystemExit, match="exactly one"):
+            main(["--backend", "malloc", "--registry", "x",
+                  "--controller-id", "y"])
