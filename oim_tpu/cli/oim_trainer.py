@@ -151,9 +151,12 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--model", default="llama-tiny",
                         choices=("llama-tiny", "llama-tiny-moe", "llama3-8b",
                                  "resnet50"))
-    parser.add_argument("--rules", default="dp", choices=("dp", "fsdp", "tp_sp"))
+    parser.add_argument("--rules", default="dp",
+                        choices=("dp", "fsdp", "tp_sp", "pipe"))
     parser.add_argument("--seq-parallel", default="ring",
                         choices=("ring", "ulysses"))
+    parser.add_argument("--microbatches", type=int, default=4,
+                        help="GPipe microbatch count (--rules pipe)")
     parser.add_argument("--mesh", default="", help="e.g. data=4,model=2")
     parser.add_argument("--steps", type=int, default=100)
     parser.add_argument("--batch-size", type=int, default=8)
@@ -217,6 +220,7 @@ def main(argv: list[str] | None = None) -> int:
         model=args.model,
         rules=args.rules,
         seq_parallel=args.seq_parallel,
+        microbatches=args.microbatches,
         batch_size=args.batch_size,
         seq_len=args.seq_len,
         image_size=args.image_size,

@@ -12,7 +12,8 @@ from oim_tpu.data import readers
 
 # Source kinds load_source accepts, advertised as "source:<kind>"
 # capabilities by the Identity service ("malloc" is backend-level, not a
-# source). "ceph" is accepted at the protocol level but requires a cluster.
+# source). "ceph" reads through the cluster's HTTP object gateway (RGW);
+# "webdataset" shard URLs may be local paths or http(s) objects.
 SOURCES = ("file", "tfrecord", "webdataset", "ceph")
 
 
@@ -32,14 +33,27 @@ def load_source(params_kind: str, params: Any) -> np.ndarray:
     if params_kind == "tfrecord":
         return readers.read_tfrecord_batch(list(params.paths))
     if params_kind == "webdataset":
-        # WebDataset shards are tar files; for local paths we treat each shard
-        # as opaque bytes concatenated in order (decode happens in the input
-        # pipeline, not the staging path).
-        chunks = [readers.read_raw(u) for u in params.shard_urls]
-        return np.frombuffer(b"".join(chunks), dtype=np.uint8)
+        # WebDataset shards are tar files; staged as flat bytes (decode
+        # happens in the input pipeline via data/webdataset.py's tar index,
+        # not the staging path). Shard URLs may be local paths or http(s)
+        # objects — remote shards ride parallel range reads into pinned
+        # buffers (data/objectstore.py).
+        from oim_tpu.data import webdataset
+
+        return webdataset.read_shards(list(params.shard_urls))
     if params_kind == "ceph":
-        # Reference parity (ceph-csi.go): requires a cluster; surfaced as a
-        # staging error rather than a protocol error so callers see it in
-        # StageStatus.
-        raise ValueError("ceph source requires a reachable cluster (not configured)")
+        # The reference maps Ceph network volumes as RBD block devices
+        # (pkg/spdk/spdk.go:66-104 ConstructRBDBDev). A TPU framework ingests
+        # objects, not block devices, so the analog is the cluster's object
+        # gateway (Ceph RGW speaks HTTP): monitors names the gateway
+        # endpoint, pool/image the object, user/secret the credentials.
+        from oim_tpu.data import objectstore
+
+        if not params.monitors:
+            raise ValueError(
+                "ceph source requires monitors=<object-gateway endpoint>"
+            )
+        url = objectstore.object_url(params.monitors, params.pool, params.image)
+        headers = objectstore.basic_auth_headers(params.user, params.secret)
+        return objectstore.read_object(url, headers)
     raise ValueError(f"unknown params kind {params_kind!r}")

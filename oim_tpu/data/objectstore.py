@@ -1,0 +1,143 @@
+"""Remote-object reader: HTTP(S) range reads into (pinned) host memory.
+
+This is the network-volume leg of the source layer — the role the reference
+fills with Ceph RBD block devices (pkg/spdk/spdk.go:66-104 ConstructRBDBDev;
+param translation pkg/oim-csi-driver/ceph-csi.go:110-158). The TPU framework
+ingests *objects*, not block devices, so the natural analog is the cluster's
+object gateway (Ceph RGW speaks plain HTTP): GET with Range headers, many
+parts in flight, landing in a pinned buffer the device DMA can pull from.
+
+Only the stdlib HTTP client is used — no SDK dependency; any server that
+honors Range (S3-compatible gateways, nginx, a test http.server with a Range
+handler) works. Auth is HTTP Basic from (user, secret); request signing
+schemes (SigV4) are gateway-specific and out of scope.
+"""
+
+from __future__ import annotations
+
+import base64
+import concurrent.futures as cf
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from oim_tpu.common import metrics as M
+from oim_tpu.data import staging
+
+
+class ObjectStoreError(IOError):
+    pass
+
+
+def basic_auth_headers(user: str = "", secret: str = "") -> dict[str, str]:
+    if not user and not secret:
+        return {}
+    token = base64.b64encode(f"{user}:{secret}".encode()).decode()
+    return {"Authorization": f"Basic {token}"}
+
+
+def _open(url: str, headers: dict[str, str] | None, method: str = "GET",
+          timeout: float = 60.0):
+    req = urllib.request.Request(url, headers=headers or {}, method=method)
+    try:
+        return urllib.request.urlopen(req, timeout=timeout)
+    except urllib.error.HTTPError as e:
+        raise ObjectStoreError(f"{method} {url}: HTTP {e.code} {e.reason}") from e
+    except urllib.error.URLError as e:
+        raise ObjectStoreError(f"{method} {url}: {e.reason}") from e
+
+
+def content_length(url: str, headers: dict[str, str] | None = None) -> int:
+    """Object size via HEAD (falls back to a 1-byte range GET for servers
+    that reject HEAD)."""
+    try:
+        with _open(url, headers, method="HEAD") as resp:
+            size = resp.headers.get("Content-Length")
+            if size is not None:
+                return int(size)
+    except ObjectStoreError:
+        pass
+    h = dict(headers or {})
+    h["Range"] = "bytes=0-0"
+    with _open(url, h) as resp:
+        rng = resp.headers.get("Content-Range", "")
+        if "/" in rng:
+            return int(rng.rsplit("/", 1)[1])
+    raise ObjectStoreError(f"cannot determine size of {url}")
+
+
+def fetch(url: str, offset: int | None = None, length: int | None = None,
+          headers: dict[str, str] | None = None) -> bytes:
+    """GET the object (or a byte range of it)."""
+    h = dict(headers or {})
+    if offset is not None:
+        end = "" if length is None else str(offset + length - 1)
+        h["Range"] = f"bytes={offset}-{end}"
+    with _open(url, h) as resp:
+        data = resp.read()
+    if length is not None and len(data) != length:
+        raise ObjectStoreError(
+            f"{url}: range [{offset}, +{length}) returned {len(data)} bytes "
+            "(server may not honor Range requests)"
+        )
+    return data
+
+
+def read_object(
+    url: str,
+    headers: dict[str, str] | None = None,
+    part_bytes: int = 8 << 20,
+    n_threads: int = 8,
+    out: np.ndarray | None = None,
+) -> np.ndarray:
+    """Whole object -> uint8 array via parallel range GETs.
+
+    The destination is a pinned allocation from the C++ staging engine when
+    built (native/staging.cc oim_pinned_alloc — the same buffers local-file
+    staging DMAs from), plain numpy otherwise — or the caller's ``out``
+    array (e.g. a slice of one large pinned buffer holding many objects).
+    Parts download concurrently, each writing its slice; the hot path never
+    concatenates.
+    """
+    size = content_length(url, headers)
+    if out is not None:
+        if out.size != size:
+            raise ObjectStoreError(
+                f"{url}: destination holds {out.size} bytes, object is {size}"
+            )
+    else:
+        out = staging.alloc_pinned(size)
+    if size == 0:
+        return out
+
+    parts = [
+        (off, min(part_bytes, size - off))
+        for off in range(0, size, part_bytes)
+    ]
+
+    def pull(part):
+        off, n = part
+        data = fetch(url, off, n, headers)
+        out[off:off + n] = np.frombuffer(data, dtype=np.uint8)
+        return n
+
+    if len(parts) == 1:
+        pull(parts[0])
+    else:
+        with cf.ThreadPoolExecutor(max_workers=n_threads) as pool:
+            for n in pool.map(pull, parts):
+                pass
+    M.STAGED_BYTES.inc(size)
+    return out
+
+
+def is_url(path: str) -> bool:
+    return path.startswith(("http://", "https://"))
+
+
+def object_url(endpoint: str, *segments: str) -> str:
+    """Join a gateway endpoint and object path segments (pool/image,
+    bucket/key) into a fetchable URL."""
+    base = endpoint if is_url(endpoint) else f"http://{endpoint}"
+    return "/".join([base.rstrip("/")] + [s.strip("/") for s in segments if s])

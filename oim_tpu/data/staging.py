@@ -118,6 +118,41 @@ def _raise_last(lib, context: str) -> None:
     raise StagingError(f"{context}: {err}")
 
 
+def alloc_pinned(size: int) -> np.ndarray:
+    """A pinned uint8 array of ``size`` bytes (plain numpy when the C++
+    engine isn't built). The pinned allocation is freed when the array (and
+    every view chaining to it through .base) is gone."""
+    lib = native_lib()
+    if lib is None or size <= 0:
+        return np.empty(max(size, 0), dtype=np.uint8)
+    ptr = lib.oim_pinned_alloc(size)
+    if not ptr:
+        raise MemoryError(f"pinned_alloc({size}) failed")
+    buf = (ctypes.c_uint8 * size).from_address(ptr)
+    arr = np.frombuffer(buf, dtype=np.uint8, count=size)
+    weakref.finalize(arr, lib.oim_pinned_free, ptr, size)
+    return arr
+
+
+def read_into(path: str | os.PathLike, dst: np.ndarray, n_threads: int = 8) -> None:
+    """Fill ``dst`` (uint8, len == file size) from ``path``: parallel preads
+    in C++ when built, a single readinto otherwise."""
+    path = str(path)
+    lib = native_lib()
+    if lib is None:
+        with open(path, "rb") as f:
+            got = f.readinto(memoryview(dst))
+    else:
+        got = lib.oim_read_into(
+            path.encode(), dst.ctypes.data, 0, dst.size, n_threads
+        )
+        if got < 0:
+            _raise_last(lib, f"read {path}")
+    if got != dst.size:
+        raise StagingError(f"read {path}: got {got} of {dst.size} bytes")
+    M.STAGED_BYTES.inc(dst.size)
+
+
 def read_pinned(path: str | os.PathLike, n_threads: int = 8) -> np.ndarray:
     """Whole file into a (pinned, when native) uint8 array."""
     path = str(path)
@@ -127,19 +162,9 @@ def read_pinned(path: str | os.PathLike, n_threads: int = 8) -> np.ndarray:
     size = lib.oim_file_size(path.encode())
     if size < 0:
         _raise_last(lib, f"stat {path}")
-    ptr = lib.oim_pinned_alloc(max(size, 1))
-    if not ptr:
-        raise MemoryError(f"pinned_alloc({size}) failed")
-    buf = (ctypes.c_uint8 * max(size, 1)).from_address(ptr)
-    got = lib.oim_read_into(path.encode(), ptr, 0, size, n_threads)
-    if got != size:
-        lib.oim_pinned_free(ptr, max(size, 1))
-        _raise_last(lib, f"read {path}")
-    arr = np.frombuffer(buf, dtype=np.uint8, count=size)
-    # Free the pinned allocation when the array (and every view chaining to
-    # it through .base) is gone.
-    weakref.finalize(arr, lib.oim_pinned_free, ptr, max(size, 1))
-    M.STAGED_BYTES.inc(size)
+    arr = alloc_pinned(size)
+    if size:
+        read_into(path, arr, n_threads)
     return arr
 
 

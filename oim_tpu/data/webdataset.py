@@ -1,0 +1,176 @@
+"""WebDataset shard handling: tar indexing + sample iteration + staging.
+
+WebDataset stores a dataset as a sequence of plain tar files ("shards");
+files that share a basename form one training sample, keyed by extension
+(``00001.jpg`` + ``00001.cls`` -> {"jpg": ..., "cls": ...}). This module
+provides the real pipeline the round-1 stub lacked:
+
+- ``index_shard``: offsets/sizes of every member without extracting (the
+  staged bytes stay a flat uint8 array in HBM; the index makes samples
+  addressable inside it — the same stance as TFRecord framing in
+  readers.py).
+- ``iter_samples``: decode-free sample grouping, streaming shard order.
+- ``read_shards``: staging entry point used by the controller's MapVolume
+  source layer (controller/source.py); shard URLs may be local paths or
+  http(s) objects (data/objectstore.py range reads into pinned buffers).
+
+Fills the role of the reference's third-party dataset personalities
+(pkg/oim-csi-driver/ceph-csi.go translating foreign volume descriptors into
+MapVolume params): a foreign on-disk format made stageable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import tarfile
+from typing import Iterable, Iterator
+
+import numpy as np
+
+from oim_tpu.data import objectstore, staging
+
+
+@dataclasses.dataclass(frozen=True)
+class TarEntry:
+    name: str
+    offset: int  # byte offset of the member DATA inside the shard
+    size: int
+
+    @property
+    def key(self) -> str:
+        """Sample key: path up to the FIRST dot of the basename (the
+        WebDataset convention — '0001.seg.png' belongs to sample '0001'
+        under extension 'seg.png')."""
+        dirname, _, base = self.name.rpartition("/")
+        stem = base.split(".", 1)[0]
+        return f"{dirname}/{stem}" if dirname else stem
+
+    @property
+    def ext(self) -> str:
+        base = self.name.rsplit("/", 1)[-1]
+        parts = base.split(".", 1)
+        return parts[1] if len(parts) > 1 else ""
+
+
+class _MemFile(io.RawIOBase):
+    """Zero-copy read/seek file view over a buffer (tarfile only needs
+    read/seek/tell; only the 512-byte headers it reads are materialized)."""
+
+    def __init__(self, view: memoryview):
+        self._view = view
+        self._pos = 0
+
+    def readable(self) -> bool:
+        return True
+
+    def seekable(self) -> bool:
+        return True
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            n = len(self._view) - self._pos
+        out = bytes(self._view[self._pos:self._pos + n])
+        self._pos += len(out)
+        return out
+
+    def seek(self, offset: int, whence: int = 0) -> int:
+        if whence == io.SEEK_SET:
+            self._pos = offset
+        elif whence == io.SEEK_CUR:
+            self._pos += offset
+        else:
+            self._pos = len(self._view) + offset
+        return self._pos
+
+    def tell(self) -> int:
+        return self._pos
+
+
+def _as_view(data: bytes | np.ndarray) -> memoryview:
+    if isinstance(data, np.ndarray):
+        return memoryview(np.ascontiguousarray(data, dtype=np.uint8)).cast("B")
+    return memoryview(data)
+
+
+def index_shard(data: bytes | np.ndarray) -> list[TarEntry]:
+    """Index every regular file in one tar shard without extracting or
+    copying it (offsets address into ``data`` directly)."""
+    entries = []
+    with tarfile.open(fileobj=_MemFile(_as_view(data)), mode="r:") as tf:
+        for member in tf:
+            if member.isfile():
+                entries.append(
+                    TarEntry(member.name, member.offset_data, member.size)
+                )
+    return entries
+
+
+def iter_samples(
+    shards: Iterable[bytes | np.ndarray],
+) -> Iterator[dict[str, bytes]]:
+    """Group tar members into samples by shared basename, in shard order.
+
+    Yields {"__key__": key, "<ext>": payload, ...}. Members of one sample
+    must be adjacent in the tar (the WebDataset convention — sorted names).
+    Only the yielded payloads are copied out of the shard buffer.
+    """
+    for shard in shards:
+        view = _as_view(shard)
+        current_key = None
+        sample: dict[str, bytes] = {}
+        for entry in index_shard(shard):
+            if entry.key != current_key:
+                if sample:
+                    yield sample
+                current_key = entry.key
+                sample = {"__key__": entry.key.encode()}
+            sample[entry.ext] = bytes(view[entry.offset:entry.offset + entry.size])
+        if sample:
+            yield sample
+
+
+def read_shard(url: str, headers: dict[str, str] | None = None) -> np.ndarray:
+    """One shard -> uint8 array (pinned when the C++ engine is built):
+    http(s) URLs ride parallel range reads, local paths parallel preads."""
+    if objectstore.is_url(url):
+        return objectstore.read_object(url, headers)
+    return staging.read_pinned(url)
+
+
+def read_shards(
+    urls: list[str], headers: dict[str, str] | None = None
+) -> np.ndarray:
+    """Staging entry point: all shards laid out back to back in ONE flat
+    uint8 array (each shard remains a valid tar at its offset; per-shard
+    index via index_shard on the slice). The destination is a single pinned
+    allocation sized up front from shard_sizes() — every shard downloads /
+    preads directly into its slice, so nothing is ever concatenated or
+    copied out of pinned memory. Shard boundaries are recoverable from
+    shard_sizes()."""
+    if not urls:
+        return np.zeros((0,), dtype=np.uint8)
+    if len(urls) == 1:
+        return read_shard(urls[0], headers)
+    sizes = shard_sizes(urls, headers)
+    out = staging.alloc_pinned(int(sum(sizes)))
+    offset = 0
+    for url, size in zip(urls, sizes):
+        dst = out[offset:offset + size]
+        if objectstore.is_url(url):
+            objectstore.read_object(url, headers, out=dst)
+        else:
+            staging.read_into(url, dst)
+        offset += size
+    return out
+
+
+def shard_sizes(urls: list[str], headers: dict[str, str] | None = None) -> list[int]:
+    """Byte size of each shard without downloading (HEAD / stat)."""
+    import os
+
+    return [
+        objectstore.content_length(u, headers) if objectstore.is_url(u)
+        else os.path.getsize(u)
+        for u in urls
+    ]

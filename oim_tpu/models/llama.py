@@ -29,7 +29,7 @@ from oim_tpu.ops.attention import attention as default_attention
 from oim_tpu.ops.losses import softmax_cross_entropy
 from oim_tpu.ops.norms import rmsnorm
 from oim_tpu.ops.rope import apply_rope, rope_frequencies
-from oim_tpu.parallel.sharding import EMBED, HEAD, KV_HEAD, MLP, VOCAB
+from oim_tpu.parallel.sharding import EMBED, HEAD, KV_HEAD, LAYER, MLP, VOCAB
 
 
 @dataclasses.dataclass(frozen=True)
@@ -125,12 +125,12 @@ def init(rng, cfg: Config = LLAMA3_8B):
 
 def param_logical_axes(cfg: Config = LLAMA3_8B):
     layers = {
-        "attn_norm": (None, None),
-        "wq": (None, EMBED, HEAD),
-        "wk": (None, EMBED, KV_HEAD),
-        "wv": (None, EMBED, KV_HEAD),
-        "wo": (None, HEAD, EMBED),
-        "mlp_norm": (None, None),
+        "attn_norm": (LAYER, None),
+        "wq": (LAYER, EMBED, HEAD),
+        "wk": (LAYER, EMBED, KV_HEAD),
+        "wv": (LAYER, EMBED, KV_HEAD),
+        "wo": (LAYER, HEAD, EMBED),
+        "mlp_norm": (LAYER, None),
     }
     if cfg.n_experts:
         from oim_tpu.models import moe
@@ -138,9 +138,9 @@ def param_logical_axes(cfg: Config = LLAMA3_8B):
         layers["moe"] = moe.param_logical_axes(stacked=True)
     else:
         layers.update(
-            w_gate=(None, EMBED, MLP),
-            w_up=(None, EMBED, MLP),
-            w_down=(None, MLP, EMBED),
+            w_gate=(LAYER, EMBED, MLP),
+            w_up=(LAYER, EMBED, MLP),
+            w_down=(LAYER, MLP, EMBED),
         )
     return {
         "embed": (VOCAB, EMBED),
@@ -205,6 +205,57 @@ def loss_fn(params, tokens, cfg: Config = LLAMA3_8B,
     if cfg.n_experts:
         loss = loss + cfg.moe_aux_weight * aux
     return loss
+
+
+def make_pipelined_loss(mesh, cfg: Config, n_microbatches: int,
+                        attn_fn: AttentionFn | None = None,
+                        axis: str = "pipe", ignore_index: int = -1):
+    """Next-token CE with the stacked layer axis pipelined over ``axis``.
+
+    The decoder body runs as a GPipe schedule (parallel/pipeline.py): each
+    pipe stage holds L/P contiguous layers (the LAYER logical axis sharded
+    by PIPE_RULES) and the batch is streamed through as ``n_microbatches``
+    microbatches. Embedding, final norm and the LM head run outside the
+    pipelined stack (replicated — they are a small fraction of the FLOPs).
+
+    Returns ``loss_fn(params, tokens[B, T+1]) -> scalar`` to be called
+    inside a jitted train step over ``mesh``.
+    """
+    if cfg.n_experts:
+        raise NotImplementedError(
+            "pipe rules currently support the dense FFN only (the GPipe "
+            "carry is a single activation tensor; the MoE aux loss would "
+            "need a second carried accumulator)"
+        )
+    if attn_fn is None:
+        attn_fn = default_attention
+    from oim_tpu.parallel.pipeline import make_pipelined_apply
+
+    def layer_fn(h, layer):
+        # RoPE tables are recomputed per layer call from static shapes only;
+        # XLA constant-folds them, so nothing traced crosses the shard_map
+        # boundary by closure.
+        cos, sin = rope_frequencies(cfg.head_dim, h.shape[1], cfg.rope_theta)
+        out, _ = _layer(h, layer, cfg, cos, sin, attn_fn)
+        return out
+
+    pipe_fn = make_pipelined_apply(mesh, layer_fn, n_microbatches, axis=axis)
+
+    def loss_fn(params, tokens):
+        inputs = tokens[:, :-1]
+        B, T = inputs.shape
+        if B % n_microbatches:
+            raise ValueError(
+                f"batch {B} not divisible by {n_microbatches} microbatches"
+            )
+        x = params["embed"][inputs].astype(cfg.dtype)
+        x = x.reshape(n_microbatches, B // n_microbatches, T, cfg.dim)
+        y = pipe_fn(params["layers"], x).reshape(B, T, cfg.dim)
+        y = rmsnorm(y, params["final_norm"])
+        logits = (y @ params["lm_head"]).astype(jnp.float32)
+        return softmax_cross_entropy(logits, tokens[:, 1:], ignore_index)
+
+    return loss_fn
 
 
 def num_params(cfg: Config = LLAMA3_8B) -> int:

@@ -17,7 +17,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 
-from oim_tpu.parallel.sharding import EMBED, EXPERT, MLP
+from oim_tpu.parallel.sharding import EMBED, EXPERT, LAYER, MLP
 
 
 @dataclasses.dataclass(frozen=True)
@@ -46,7 +46,7 @@ def init(rng, dim: int, mlp_dim: int, cfg: MoEConfig, dtype, n_layers: int | Non
 
 
 def param_logical_axes(stacked: bool = False):
-    lead = (None,) if stacked else ()
+    lead = (LAYER,) if stacked else ()
     return {
         "router": lead + (EMBED, EXPERT),
         "w_gate": lead + (EXPERT, EMBED, MLP),

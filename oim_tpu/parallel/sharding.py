@@ -25,6 +25,10 @@ VOCAB = "vocab"
 EXPERT = "expert"
 CONV_IN = "conv_in"
 CONV_OUT = "conv_out"
+# The leading dim of a STACKED layer pytree (models/llama.py scans over it).
+# Unmapped under dp/fsdp/tp_sp (every device holds all layers); mapped to the
+# "pipe" mesh axis under PIPE_RULES so each stage holds L/P contiguous layers.
+LAYER = "layer"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,6 +80,18 @@ TP_SP_RULES = ShardingRules.of(
         MLP: "model",
         VOCAB: "model",
         EXPERT: "expert",
+    }
+)
+
+
+# GPipe pipeline parallelism: the stacked layer axis is split over "pipe"
+# (parallel/pipeline.py streams microbatches through the stages); the batch
+# still splits over "data" for DP x PP. Embeddings/head replicate — they run
+# outside the pipelined stack.
+PIPE_RULES = ShardingRules.of(
+    **{
+        BATCH: "data",
+        LAYER: "pipe",
     }
 )
 

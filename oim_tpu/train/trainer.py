@@ -35,13 +35,19 @@ from oim_tpu.parallel.sharding import (
     BATCH,
     DP_RULES,
     FSDP_RULES,
+    PIPE_RULES,
     TP_SP_RULES,
     logical_sharding,
     param_shardings,
 )
 from oim_tpu.train.state import TrainState, make_optimizer
 
-RULES = {"dp": DP_RULES, "fsdp": FSDP_RULES, "tp_sp": TP_SP_RULES}
+RULES = {
+    "dp": DP_RULES,
+    "fsdp": FSDP_RULES,
+    "tp_sp": TP_SP_RULES,
+    "pipe": PIPE_RULES,
+}
 
 # Peak bf16 FLOP/s per chip for MFU accounting.
 PEAK_FLOPS = {
@@ -66,8 +72,9 @@ def peak_flops_per_device() -> float:
 @dataclasses.dataclass
 class TrainConfig:
     model: str = "llama-tiny"  # llama-tiny | llama3-8b | resnet50
-    rules: str = "dp"  # dp | fsdp | tp_sp
+    rules: str = "dp"  # dp | fsdp | tp_sp | pipe
     seq_parallel: str = "ring"  # ring | ulysses (used when mesh seq axis > 1)
+    microbatches: int = 4  # GPipe microbatch count (rules == "pipe")
     batch_size: int = 8
     seq_len: int = 128
     image_size: int = 224
@@ -149,9 +156,24 @@ def make_train_step(
         def init_params(rng):
             return llama.init(rng, mcfg), {}
 
-        def loss_fn(params, extra, batch):
-            loss = llama.loss_fn(params, batch["tokens"], mcfg, attn_fn)
-            return loss, extra
+        if cfg.rules == "pipe":
+            if mesh.shape.get("seq", 1) > 1:
+                raise ValueError(
+                    "pipe rules do not compose with a seq axis yet: the "
+                    "ring/Ulysses attention is itself a shard_map, which "
+                    "cannot nest inside the pipeline's shard_map"
+                )
+            pipe_loss = llama.make_pipelined_loss(
+                mesh, mcfg, cfg.microbatches, attn_fn
+            )
+
+            def loss_fn(params, extra, batch):
+                return pipe_loss(params, batch["tokens"]), extra
+        else:
+
+            def loss_fn(params, extra, batch):
+                loss = llama.loss_fn(params, batch["tokens"], mcfg, attn_fn)
+                return loss, extra
 
         # Tokens arrive [B, T+1] — the +1 label shift makes the length
         # indivisible by a seq axis, so tokens stay batch-sharded only;
@@ -159,6 +181,8 @@ def make_train_step(
         # (shard_map in the attention fn).
         batch_logical = {"tokens": (BATCH, None)}
     elif cfg.model == "resnet50":
+        if cfg.rules == "pipe":
+            raise ValueError("pipe rules support llama-family models only")
         logical = resnet.param_logical_axes(mcfg)
 
         def init_params(rng):

@@ -73,6 +73,78 @@ class TestPipeline:
             pipeline_stage_slice(6, 4, 0)
 
 
+class TestLlamaPipeline:
+    """Pipeline parallelism on the real model (VERDICT round-1 item 5): the
+    llama decoder body sharded over a "pipe" axis must reproduce the
+    sequential (scan-over-layers) loss and gradients exactly."""
+
+    def test_pipelined_loss_matches_sequential(self):
+        cfg = llama.tiny(n_layers=4)
+        mesh = build_mesh([("data", 2), ("pipe", 4)])
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0, cfg.vocab)
+
+        pipe_loss = jax.jit(llama.make_pipelined_loss(mesh, cfg, n_microbatches=2))
+        expected = float(llama.loss_fn(params, tokens, cfg))
+        got = float(pipe_loss(params, tokens))
+        np.testing.assert_allclose(got, expected, rtol=1e-5)
+
+    def test_pipelined_grads_match_sequential(self):
+        cfg = llama.tiny(n_layers=4)
+        mesh = build_mesh([("data", 1), ("pipe", 4)])
+        params = llama.init(jax.random.PRNGKey(2), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 17), 0, cfg.vocab)
+
+        pipe_loss = llama.make_pipelined_loss(mesh, cfg, n_microbatches=2)
+        g_pipe = jax.jit(jax.grad(pipe_loss))(params, tokens)
+        g_seq = jax.grad(lambda p: llama.loss_fn(p, tokens, cfg))(params)
+        for name in ("embed", "lm_head"):
+            np.testing.assert_allclose(
+                np.asarray(g_pipe[name]), np.asarray(g_seq[name]), atol=2e-5
+            )
+        np.testing.assert_allclose(
+            np.asarray(g_pipe["layers"]["wq"]),
+            np.asarray(g_seq["layers"]["wq"]),
+            atol=2e-5,
+        )
+
+    def test_trainer_pipe_rules_full_step(self):
+        # DP x PP: 2-way data, 2-way pipe; llama-tiny's 2 layers → 1/stage.
+        cfg = TrainConfig(
+            model="llama-tiny", rules="pipe", batch_size=4, seq_len=16,
+            microbatches=2, log_every=1, warmup_steps=1, total_steps=2,
+        )
+        mesh = build_mesh([("data", 2), ("pipe", 2)])
+        trainer = Trainer(cfg, mesh=mesh)
+        loss = trainer.run(steps=2)
+        assert np.isfinite(loss)
+
+    def test_pipe_rules_shard_layer_stack(self):
+        from oim_tpu.parallel.sharding import PIPE_RULES
+
+        mesh = build_mesh([("data", 2), ("pipe", 4)])
+        cfg = llama.tiny(n_layers=4)
+        shardings = param_shardings(
+            mesh, PIPE_RULES, llama.param_logical_axes(cfg)
+        )
+        assert shardings["layers"]["wq"].spec[0] == "pipe"
+        assert all(a is None for a in shardings["embed"].spec)  # replicated
+
+    def test_pipe_rules_reject_moe(self):
+        mesh = build_mesh([("data", 2), ("pipe", 4)])
+        with pytest.raises(NotImplementedError):
+            llama.make_pipelined_loss(mesh, llama.tiny(n_experts=4), 2)
+
+    def test_pipe_rules_reject_seq_axis(self):
+        # Ring/Ulysses attention is itself a shard_map and cannot nest
+        # inside the pipeline's shard_map.
+        mesh = build_mesh([("data", 1), ("seq", 2), ("pipe", 2)])
+        cfg = TrainConfig(model="llama-tiny", rules="pipe", batch_size=4,
+                          seq_len=16, microbatches=2)
+        with pytest.raises(ValueError, match="seq"):
+            Trainer(cfg, mesh=mesh)
+
+
 class TestMoE:
     def test_moe_forward_shapes_and_aux(self):
         cfg = moe.MoEConfig(n_experts=4, top_k=2)
