@@ -100,13 +100,20 @@ def read_object(
     Parts download concurrently, each writing its slice; the hot path never
     concatenates.
     """
-    size = content_length(url, headers)
+    if url.startswith("http://") and (headers or {}).get("Authorization"):
+        # Credentials over plaintext: everything else in this framework is
+        # mTLS; an http gateway is acceptable only inside a trusted fabric.
+        from oim_tpu.common.logging import from_context
+
+        from_context().warning(
+            "sending credentials over plaintext http", url=url.split("?")[0]
+        )
     if out is not None:
-        if out.size != size:
-            raise ObjectStoreError(
-                f"{url}: destination holds {out.size} bytes, object is {size}"
-            )
+        # Caller-provided destination is authoritative for the size: no
+        # extra HEAD round-trip (a multi-shard stage already sized it).
+        size = out.size
     else:
+        size = content_length(url, headers)
         out = staging.alloc_pinned(size)
     if size == 0:
         return out

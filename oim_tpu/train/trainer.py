@@ -157,6 +157,11 @@ def make_train_step(
             return llama.init(rng, mcfg), {}
 
         if cfg.rules == "pipe":
+            if "pipe" not in mesh.shape:
+                raise ValueError(
+                    "pipe rules need a mesh with a 'pipe' axis "
+                    f"(got axes {tuple(mesh.shape)}); e.g. --mesh data=2,pipe=2"
+                )
             if mesh.shape.get("seq", 1) > 1:
                 raise ValueError(
                     "pipe rules do not compose with a seq axis yet: the "

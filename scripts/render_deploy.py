@@ -1,0 +1,80 @@
+#!/usr/bin/env python3
+"""Render deployment templates: substitute @PLACEHOLDER@ tokens.
+
+The reference templates its registry address the same way
+(@OIM_REGISTRY_ADDRESS@ in deploy/kubernetes/malloc/malloc-daemonset.yaml,
+substituted by test/start-stop.make). Usage:
+
+    python scripts/render_deploy.py deploy/kubernetes \
+        --registry-address oim-registry.default.svc:9421 \
+        --image my-registry/oim-tpu:latest -o rendered/
+
+Unsubstituted placeholders in an output file are an error — a rendered
+manifest must be applyable as-is.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from pathlib import Path
+
+PLACEHOLDER = re.compile(r"@([A-Z0-9_]+)@")
+
+
+def render(text: str, values: dict[str, str], name: str) -> str:
+    def sub(match: re.Match) -> str:
+        key = match.group(1)
+        if key not in values:
+            raise SystemExit(
+                f"{name}: placeholder @{key}@ has no value "
+                f"(known: {', '.join(sorted(values))})"
+            )
+        return values[key]
+
+    return PLACEHOLDER.sub(sub, text)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser("render_deploy")
+    parser.add_argument("source", help="template file or directory")
+    parser.add_argument("-o", "--out", required=True, help="output directory")
+    parser.add_argument("--registry-address", default="",
+                        help="value for @OIM_REGISTRY_ADDRESS@")
+    parser.add_argument("--image", default="", help="value for @OIM_IMAGE@")
+    parser.add_argument("--repo", default="", help="value for @OIM_REPO@")
+    parser.add_argument("--ca-dir", default="", help="value for @OIM_CA_DIR@")
+    parser.add_argument("--set", action="append", default=[],
+                        metavar="KEY=VALUE", help="extra placeholder values")
+    args = parser.parse_args(argv)
+
+    values = {}
+    if args.registry_address:
+        values["OIM_REGISTRY_ADDRESS"] = args.registry_address
+    if args.image:
+        values["OIM_IMAGE"] = args.image
+    if args.repo:
+        values["OIM_REPO"] = args.repo
+    if args.ca_dir:
+        values["OIM_CA_DIR"] = args.ca_dir
+    for item in args.set:
+        key, _, value = item.partition("=")
+        values[key] = value
+
+    source = Path(args.source)
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    files = sorted(source.glob("*")) if source.is_dir() else [source]
+    rendered = 0
+    for f in files:
+        if not f.is_file():
+            continue
+        (out / f.name).write_text(render(f.read_text(), values, f.name))
+        rendered += 1
+    print(f"rendered {rendered} file(s) into {out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,137 @@
+#!/usr/bin/env bash
+# Bring up one OIM-TPU host from blank to registered (the role SPDK's
+# scripts/setup.sh plays for the reference: environment prep + daemon
+# start, SURVEY.md section 2.8). Idempotent; re-run to reconfigure.
+#
+#   registry host:  setup_tpu_host.sh --role registry --repo /opt/oim-tpu \
+#                       --ca-dir /etc/oim/ca --registry 0.0.0.0:9421
+#   TPU host:       setup_tpu_host.sh --role controller --repo /opt/oim-tpu \
+#                       --ca-dir /etc/oim/ca --registry reg-host:9421 \
+#                       --controller-id $(hostname) --mesh-coord auto
+#
+# --mesh-coord auto reads the ICI coordinate of this host's first chip from
+# the TPU runtime (jax.devices()[0].coords). Without systemd (containers,
+# dev boxes) pass --no-systemd to just print the daemon command lines.
+set -euo pipefail
+
+ROLE="controller"
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+CA_DIR="/etc/oim/ca"
+REGISTRY=""
+CONTROLLER_ID="$(hostname -s 2>/dev/null || echo host-0)"
+CONTROLLER_PORT=9422
+MESH_COORD=""
+BACKEND="tpu"
+REGISTRY_DELAY=60
+USE_SYSTEMD=1
+
+while [[ $# -gt 0 ]]; do
+  case "$1" in
+    --role) ROLE="$2"; shift 2 ;;
+    --repo) REPO="$2"; shift 2 ;;
+    --ca-dir) CA_DIR="$2"; shift 2 ;;
+    --registry) REGISTRY="$2"; shift 2 ;;
+    --controller-id) CONTROLLER_ID="$2"; shift 2 ;;
+    --controller-port) CONTROLLER_PORT="$2"; shift 2 ;;
+    --mesh-coord) MESH_COORD="$2"; shift 2 ;;
+    --backend) BACKEND="$2"; shift 2 ;;
+    --registry-delay) REGISTRY_DELAY="$2"; shift 2 ;;
+    --no-systemd) USE_SYSTEMD=0; shift ;;
+    *) echo "unknown flag: $1" >&2; exit 2 ;;
+  esac
+done
+
+[[ -n "$REGISTRY" ]] || { echo "--registry is required" >&2; exit 2; }
+[[ -d "$REPO/oim_tpu" ]] || { echo "--repo $REPO has no oim_tpu/" >&2; exit 2; }
+
+echo "== oim-tpu host setup: role=$ROLE repo=$REPO registry=$REGISTRY"
+
+# 1. Native staging engine (optional but the fast path; Python falls back).
+if command -v make >/dev/null && command -v g++ >/dev/null; then
+  make -C "$REPO/native" >/dev/null && echo "   native staging engine built"
+else
+  echo "   no toolchain; staging runs on the Python fallback"
+fi
+
+# 2. Certificates must exist (generated centrally, see deploy/README.md).
+# The .key/.crt basename convention follows the reference (grpc.go:131-137):
+# CLIs take the basename, files are <basename>.key + <basename>.crt.
+if [[ "$ROLE" == "registry" ]]; then
+  NEED="$CA_DIR/component.registry"
+else
+  NEED="$CA_DIR/controller.$CONTROLLER_ID"
+fi
+[[ -f "$CA_DIR/ca.crt" && -f "$NEED.key" ]] || {
+  echo "   missing $CA_DIR/ca.crt or $NEED.key — generate per deploy/README.md" >&2
+  exit 3
+}
+
+# 3. Mesh coordinate from the TPU runtime when asked.
+if [[ "$MESH_COORD" == "auto" ]]; then
+  MESH_COORD="$(cd "$REPO" && python3 - <<'EOF'
+import jax
+c = getattr(jax.devices()[0], "coords", None)
+print(",".join(str(x) for x in c) if c else "")
+EOF
+)"
+  echo "   mesh coordinate from TPU runtime: ${MESH_COORD:-<none>}"
+fi
+
+HOST_ADDRESS="$(hostname -I 2>/dev/null | awk '{print $1}')"
+HOST_ADDRESS="${HOST_ADDRESS:-127.0.0.1}"
+
+# 4. Render /etc/oim/oim.env + units and start.
+if [[ "$USE_SYSTEMD" == 1 && -d /etc/systemd/system ]]; then
+  mkdir -p /etc/oim
+  RENDER_DIR="$(mktemp -d)"
+  python3 "$REPO/scripts/render_deploy.py" "$REPO/deploy/systemd" \
+    -o "$RENDER_DIR" --repo "$REPO" --ca-dir "$CA_DIR" \
+    --registry-address "$REGISTRY"
+  cp "$RENDER_DIR"/*.service /etc/systemd/system/  # units only, not the env example
+  rm -rf "$RENDER_DIR"
+  # The registry binds exactly the address it was asked to serve on.
+  sed -e "s|@OIM_REPO@|$REPO|" -e "s|@OIM_CA_DIR@|$CA_DIR|" \
+      -e "s|@OIM_REGISTRY_ADDRESS@|$REGISTRY|" \
+      -e "s|^OIM_REGISTRY_BIND=.*|OIM_REGISTRY_BIND=$REGISTRY|" \
+      -e "s|^OIM_CONTROLLER_ID=.*|OIM_CONTROLLER_ID=$CONTROLLER_ID|" \
+      -e "s|^OIM_CONTROLLER_PORT=.*|OIM_CONTROLLER_PORT=$CONTROLLER_PORT|" \
+      -e "s|^OIM_HOST_ADDRESS=.*|OIM_HOST_ADDRESS=$HOST_ADDRESS|" \
+      -e "s|^OIM_BACKEND=.*|OIM_BACKEND=$BACKEND|" \
+      -e "s|^OIM_REGISTRY_DELAY=.*|OIM_REGISTRY_DELAY=$REGISTRY_DELAY|" \
+      -e "s|^OIM_MESH_COORD=.*|OIM_MESH_COORD=$MESH_COORD|" \
+      "$REPO/deploy/systemd/oim.env.example" > /etc/oim/oim.env
+  systemctl daemon-reload
+  if [[ "$ROLE" == "registry" ]]; then
+    systemctl enable --now oim-registry
+  else
+    systemctl enable --now oim-controller oim-feeder
+  fi
+else
+  echo "   (no systemd) start manually from $REPO:"
+  if [[ "$ROLE" == "registry" ]]; then
+    echo "   python3 -m oim_tpu.cli.oim_registry --endpoint tcp://$REGISTRY \\"
+    echo "     --ca $CA_DIR/ca.crt --key $CA_DIR/component.registry"
+  else
+    echo "   python3 -m oim_tpu.cli.oim_controller --endpoint tcp://0.0.0.0:$CONTROLLER_PORT \\"
+    echo "     --controller-id $CONTROLLER_ID --controller-address $HOST_ADDRESS:$CONTROLLER_PORT \\"
+    echo "     --registry $REGISTRY --backend $BACKEND --mesh-coord '$MESH_COORD' \\"
+    echo "     --ca $CA_DIR/ca.crt --key $CA_DIR/controller.$CONTROLLER_ID"
+  fi
+  exit 0
+fi
+
+# 5. Verify: the controller's registration must appear in the registry.
+if [[ "$ROLE" == "controller" && -f "$CA_DIR/user.admin" ]]; then
+  for _ in $(seq 1 30); do
+    if (cd "$REPO" && python3 -m oim_tpu.cli.oimctl --registry "$REGISTRY" \
+        --ca "$CA_DIR/ca.crt" --key "$CA_DIR/user.admin" \
+        --get "$CONTROLLER_ID" 2>/dev/null | grep -q "$CONTROLLER_ID/address"); then
+      echo "== registered: $CONTROLLER_ID visible in registry $REGISTRY"
+      exit 0
+    fi
+    sleep 1
+  done
+  echo "== WARNING: $CONTROLLER_ID not visible in registry after 30s" >&2
+  exit 4
+fi
+echo "== done"

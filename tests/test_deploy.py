@@ -1,0 +1,104 @@
+"""Deployment-layer tests: template rendering produces applyable manifests
+and the host bring-up script completes its non-systemd path (the analog of
+the reference's deployable-file checks, test/e2e/filesource_test.go)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+import render_deploy  # noqa: E402
+
+VALUES = {
+    "OIM_REGISTRY_ADDRESS": "oim-registry.default.svc:9421",
+    "OIM_IMAGE": "registry.example/oim-tpu:latest",
+    "OIM_REPO": "/opt/oim-tpu",
+    "OIM_CA_DIR": "/etc/oim/ca",
+}
+
+
+class TestRenderDeploy:
+    def test_kubernetes_manifests_render_and_parse(self, tmp_path):
+        render_deploy.main([
+            os.path.join(REPO, "deploy", "kubernetes"), "-o", str(tmp_path),
+            "--registry-address", VALUES["OIM_REGISTRY_ADDRESS"],
+            "--image", VALUES["OIM_IMAGE"],
+        ])
+        rendered = sorted(p.name for p in tmp_path.iterdir())
+        assert rendered == [
+            "controller-daemonset.yaml", "feeder-daemonset.yaml",
+            "registry.yaml",
+        ]
+        for p in tmp_path.iterdir():
+            text = p.read_text()
+            assert "@OIM_" not in text, f"{p.name} kept a placeholder"
+            docs = [d for d in yaml.safe_load_all(text) if d]
+            assert docs, f"{p.name} parsed to nothing"
+            for doc in docs:
+                assert "kind" in doc and "metadata" in doc
+
+    def test_controller_daemonset_shape(self, tmp_path):
+        render_deploy.main([
+            os.path.join(REPO, "deploy", "kubernetes"), "-o", str(tmp_path),
+            "--registry-address", "reg:9421", "--image", "img",
+        ])
+        ds = yaml.safe_load((tmp_path / "controller-daemonset.yaml").read_text())
+        spec = ds["spec"]["template"]["spec"]
+        assert spec["nodeSelector"] == {"oim.dev/tpu": "1"}
+        args = spec["containers"][0]["args"]
+        assert "--registry=reg:9421" in args
+        assert any(a.startswith("--controller-id=") for a in args)
+
+    def test_unknown_placeholder_is_an_error(self, tmp_path):
+        src = tmp_path / "t.yaml"
+        src.write_text("value: @NO_SUCH_KEY@\n")
+        with pytest.raises(SystemExit, match="NO_SUCH_KEY"):
+            render_deploy.main([str(src), "-o", str(tmp_path / "out")])
+
+    def test_systemd_units_render(self, tmp_path):
+        render_deploy.main([
+            os.path.join(REPO, "deploy", "systemd"), "-o", str(tmp_path),
+            "--repo", VALUES["OIM_REPO"], "--ca-dir", VALUES["OIM_CA_DIR"],
+            "--registry-address", VALUES["OIM_REGISTRY_ADDRESS"],
+        ])
+        unit = (tmp_path / "oim-controller.service").read_text()
+        assert "WorkingDirectory=/opt/oim-tpu" in unit
+        assert "@OIM_" not in unit
+
+
+class TestSetupScript:
+    def test_no_systemd_path_prints_commands(self, tmp_path):
+        from oim_tpu.common.ca import CertAuthority
+
+        ca = CertAuthority("deploy-test-ca")
+        for cn in ("controller.host-x",):
+            ca.write_files(str(tmp_path), cn)
+        out = subprocess.run(
+            ["bash", os.path.join(REPO, "scripts", "setup_tpu_host.sh"),
+             "--role", "controller", "--repo", REPO,
+             "--ca-dir", str(tmp_path), "--registry", "reg:9421",
+             "--controller-id", "host-x", "--mesh-coord", "1,2,3",
+             "--backend", "malloc", "--no-systemd"],
+            capture_output=True, text=True, timeout=180,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "oim_tpu.cli.oim_controller" in out.stdout
+        assert "--mesh-coord '1,2,3'" in out.stdout
+
+    def test_missing_certs_fail_clearly(self, tmp_path):
+        out = subprocess.run(
+            ["bash", os.path.join(REPO, "scripts", "setup_tpu_host.sh"),
+             "--role", "controller", "--repo", REPO,
+             "--ca-dir", str(tmp_path), "--registry", "reg:9421",
+             "--no-systemd"],
+            capture_output=True, text=True, timeout=180,
+        )
+        assert out.returncode == 3
+        assert "generate per deploy/README.md" in out.stderr

@@ -135,6 +135,12 @@ class TestLlamaPipeline:
         with pytest.raises(NotImplementedError):
             llama.make_pipelined_loss(mesh, llama.tiny(n_experts=4), 2)
 
+    def test_pipe_rules_need_pipe_axis(self):
+        cfg = TrainConfig(model="llama-tiny", rules="pipe", batch_size=4,
+                          seq_len=16, microbatches=2)
+        with pytest.raises(ValueError, match="pipe' axis"):
+            Trainer(cfg)  # default mesh is data-only
+
     def test_pipe_rules_reject_seq_axis(self):
         # Ring/Ulysses attention is itself a shard_map and cannot nest
         # inside the pipeline's shard_map.

@@ -1,0 +1,79 @@
+"""Ring-2 TPU-gated tests: set OIM_TEST_TPU=1 to run against the real chip.
+
+Mirrors the reference's env-gated hardware tier (TEST_SPDK_VHOST_* gating,
+test/test.make:1-20): absent the gate these skip silently so the suite
+always passes on a bare machine. Because tests/conftest.py pins THIS
+process to the CPU platform before jax loads, the TPU work runs in a clean
+subprocess with the pin stripped — which also makes this a process-level
+e2e, the shape ring 2 wants.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+requires_tpu = pytest.mark.skipif(
+    not os.environ.get("OIM_TEST_TPU"),
+    reason="set OIM_TEST_TPU=1 to run real-TPU ring-2 tests",
+)
+
+
+def run_on_tpu(script: str, timeout: float = 600.0):
+    """Run a python script in a subprocess WITHOUT the CPU platform pin."""
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-c", script],
+        env=env, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+@requires_tpu
+def test_stage_file_to_hbm(tmp_path):
+    """Config-3 shape of BASELINE.json: bytes staged into real HBM via the
+    chunked pinned-buffer path, verified by readback."""
+    data = np.arange(1 << 18, dtype=np.int32)
+    path = tmp_path / "vol.bin"
+    data.tofile(path)
+    out = run_on_tpu(f"""
+import numpy as np
+import jax
+dev = jax.devices()[0]
+assert dev.platform != "cpu", f"gate ran on {{dev}}"
+from oim_tpu.data import staging
+arr = staging.stage_file_to_device({str(path)!r}, dtype="int32")
+back = np.asarray(arr)
+ref = np.fromfile({str(path)!r}, dtype=np.int32)
+np.testing.assert_array_equal(back, ref)
+print("RING2_STAGE_OK", dev.device_kind)
+""")
+    assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-3000:]
+    assert "RING2_STAGE_OK" in out.stdout
+
+
+@requires_tpu
+def test_train_step_on_tpu():
+    """Two real train steps on the chip (bf16 llama-tiny) finish finite."""
+    out = run_on_tpu("""
+import numpy as np
+import jax
+assert jax.devices()[0].platform != "cpu"
+from oim_tpu.train import TrainConfig, Trainer
+cfg = TrainConfig(model="llama-tiny", batch_size=2, seq_len=32,
+                  log_every=1, warmup_steps=1, total_steps=2)
+loss = Trainer(cfg).run(steps=2)
+assert np.isfinite(loss), loss
+print("RING2_TRAIN_OK", loss)
+""")
+    assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-3000:]
+    assert "RING2_TRAIN_OK" in out.stdout
