@@ -43,6 +43,8 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser("bench")
     parser.add_argument("--profile", default="",
                         help="jax.profiler trace directory for the timed chain")
+    parser.add_argument("--no-flagship", action="store_true",
+                        help="skip the llama flagship MFU measurement")
     args = parser.parse_args(argv)
 
     import jax
@@ -71,7 +73,10 @@ def main(argv=None) -> int:
     from oim_tpu.ops.losses import softmax_cross_entropy
     from oim_tpu.spec import pb
     from oim_tpu.train.state import make_optimizer
-    from oim_tpu.train.trainer import peak_flops_per_device
+    from oim_tpu.train.trainer import (
+        peak_flops_per_device,
+        peak_hbm_bw_per_device,
+    )
 
     # Build the C++ staging engine up front (controllers never build from
     # inside an RPC; the bench is its own process startup).
@@ -134,19 +139,21 @@ def main(argv=None) -> int:
         return params, new_bn, new_opt, loss
 
     # n_steps is a traced operand: ONE compilation serves every chain
-    # length (fori_loop lowers to a while loop).
+    # length (fori_loop lowers to a while loop). Explicit lower/compile so
+    # the SAME executable is timed and cost-analyzed.
     def chain(params, bn_state, opt_state, n_steps):
         return lax.fori_loop(
             0, n_steps, one_step,
             (params, bn_state, opt_state, jnp.zeros((), jnp.float32)),
         )
 
-    jchain = jax.jit(chain, donate_argnums=(0, 1, 2))
+    jchain = jax.jit(chain, donate_argnums=(0, 1, 2)).lower(
+        params, bn_state, opt_state, jnp.int32(0)).compile()
 
     def run_chain(params, bn_state, opt_state, n):
         t0 = time.monotonic()
         params, bn_state, opt_state, loss = jchain(
-            params, bn_state, opt_state, n)
+            params, bn_state, opt_state, jnp.int32(n))
         # Fetch the VALUE to force completion: on remote-execution backends
         # block_until_ready returns before the computation has run.
         loss = float(loss)
@@ -171,6 +178,32 @@ def main(argv=None) -> int:
     # North star: >=70% MFU through the OIM feed path (BASELINE.md).
     vs_baseline = mfu / 0.70 if peak else 1.0
 
+    # ---- Roofline attribution (XLA cost model of the timed chain) ------
+    # ResNet bf16 on v5e is HBM-bandwidth-bound, not MXU-bound (the bwd
+    # conv fusions run near peak bandwidth per the profiler trace noted in
+    # BASELINE.md). The cost model counts a dynamic-trip-count while body
+    # ONCE, so "bytes accessed" of the timed chain IS one step's bytes (an
+    # upper bound: fusion may eliminate some counted traffic). Over the
+    # measured step time it says how close to the roofline we run — the
+    # honest utilization number for a bandwidth-bound model; >1.0 means
+    # XLA fused away part of the counted bytes while HBM stayed saturated.
+    hbm_gbps = roofline = None
+    peak_bw = peak_hbm_bw_per_device()
+    try:
+        ca = jchain.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
+        step_bytes = float(ca.get("bytes accessed", 0.0))
+        if step_bytes and peak_bw:
+            hbm_gbps = step_bytes / dt / 1e9
+            roofline = hbm_gbps * 1e9 / peak_bw
+    except Exception:  # cost model availability varies by backend
+        pass
+
+    # ---- Flagship llama MFU (matmul-bound, where the MXU can shine) ----
+    llama_extras = {}
+    if on_tpu and not args.no_flagship:
+        llama_extras = bench_llama(chain_short=2, chain_long=6)
+
     print(json.dumps({
         "metric": "resnet50_images_per_sec_per_chip",
         "value": round(images_per_sec, 2),
@@ -188,9 +221,74 @@ def main(argv=None) -> int:
             "backend": jax.default_backend(),
             "device": jax.devices()[0].device_kind,
             "final_loss": round(float(loss), 4),
+            "hbm_gbps": round(hbm_gbps, 1) if hbm_gbps else None,
+            "hbm_roofline_util": round(roofline, 4) if roofline else None,
+            **llama_extras,
         },
     }))
     return 0
+
+
+def bench_llama(chain_short: int, chain_long: int) -> dict:
+    """Chip-local MFU on a ~0.6B-param llama (dim 2048, 8 layers, seq 2048):
+    the matmul-bound flagship workload, measured with the same chained
+    fori_loop differencing as the ResNet path. Returns extras for the bench
+    JSON (prefixed llama_)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import lax
+
+    from oim_tpu.models import llama
+    from oim_tpu.train.state import make_optimizer
+    from oim_tpu.train.trainer import peak_flops_per_device
+
+    cfg = llama.Config(
+        vocab=32768, dim=2048, n_layers=8, n_heads=16, n_kv_heads=8,
+        head_dim=128, mlp_dim=8192, max_seq=2048,
+    )
+    batch, seq = 4, 2048
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    tx = make_optimizer(lr=3e-4, warmup_steps=10, total_steps=100)
+    opt_state = tx.init(params)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab, jnp.int32
+    )
+
+    def one_step(_, carry):
+        params, opt_state, _ = carry
+        loss, grads = jax.value_and_grad(
+            lambda p: llama.loss_fn(p, tokens, cfg))(params)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt, loss
+
+    def chain(params, opt_state, n):
+        return lax.fori_loop(
+            0, n, one_step, (params, opt_state, jnp.zeros((), jnp.float32)))
+
+    jchain = jax.jit(chain, donate_argnums=(0, 1))
+
+    def run(params, opt_state, n):
+        t0 = time.monotonic()
+        params, opt_state, loss = jchain(params, opt_state, n)
+        loss = float(loss)  # completion fence (BASELINE.md caveat)
+        return params, opt_state, loss, time.monotonic() - t0
+
+    params, opt_state, loss, _ = run(params, opt_state, chain_short)  # warmup
+    params, opt_state, loss, t_short = run(params, opt_state, chain_short)
+    params, opt_state, loss, t_long = run(params, opt_state, chain_long)
+    dt = max((t_long - t_short) / (chain_long - chain_short), 1e-9)
+
+    tok_per_step = batch * seq
+    flops = llama.num_flops_per_token(cfg, seq) * tok_per_step
+    peak = peak_flops_per_device()
+    return {
+        "llama_mfu": round(flops / dt / peak, 4) if peak else None,
+        "llama_tokens_per_sec": round(tok_per_step / dt, 1),
+        "llama_step_seconds": round(dt, 5),
+        "llama_params_m": round(llama.num_params(cfg) / 1e6),
+        "llama_final_loss": round(loss, 4),
+    }
 
 
 if __name__ == "__main__":

@@ -55,13 +55,27 @@ def feeder_batches(args, cfg: TrainConfig, tls):
         tls=tls,
     )
     req = pb.MapVolumeRequest(volume_id=args.volume)
-    if args.volume_file:
+    if getattr(args, "volume_webdataset", ""):
+        # Checked before publish: staging a full shard set only to discover
+        # the model can't consume it would waste minutes and HBM.
+        if not cfg.model.startswith("llama"):
+            raise SystemExit("--volume-webdataset feeds llama-family models")
+        req.webdataset.shard_urls.extend(
+            u for u in args.volume_webdataset.split(",") if u
+        )
+    elif args.volume_file:
         req.file.path = args.volume_file
         req.file.format = "npy" if args.volume_file.endswith(".npy") else "raw"
     else:
         req.malloc.SetInParent()
     pub = feeder.publish(req, timeout=args.publish_timeout)
     window = getattr(args, "feed_window_bytes", 0)
+    if req.WhichOneof("params") == "webdataset":
+        # Shards are tars: a byte window could split a header, so the
+        # sample index is built over the whole staged volume (config-5
+        # shape: llama fed from webdataset shards through MapVolume).
+        yield from _webdataset_token_batches(args, cfg, feeder, pub)
+        return
 
     if window <= 0:
         # Whole-volume mode: local hands back the live array; remote streams
@@ -146,6 +160,45 @@ def feeder_batches(args, cfg: TrainConfig, tls):
         offset += w.size
 
 
+def _webdataset_token_batches(args, cfg: TrainConfig, feeder, pub):
+    """Samples from a staged webdataset volume -> token batches.
+
+    The staged flat bytes are a (concatenated) tar stream; the tar index
+    (data/webdataset.py) groups members into samples, and each sample's
+    --wds-ext payload holds raw int32 tokens. Sample order is shard order.
+    """
+    from oim_tpu.data import webdataset as wds
+
+    data = np.asarray(pub.array) if pub.array is not None else feeder.fetch(
+        args.volume, timeout=args.publish_timeout)
+    ext = getattr(args, "wds_ext", "bin")
+    payloads = [
+        s[ext] for s in wds.iter_samples([np.asarray(data)]) if ext in s
+    ]
+    if not payloads:
+        raise SystemExit(
+            f"webdataset volume {args.volume!r} has no samples with "
+            f"extension {ext!r}"
+        )
+    tokens = np.frombuffer(b"".join(payloads), dtype=np.int32)
+    span = cfg.seq_len + 1
+    n = (tokens.size // span) * span
+    if n == 0:
+        raise SystemExit(
+            f"webdataset volume holds {tokens.size} tokens < seq_len+1={span}"
+        )
+    tokens = tokens[:n].reshape(-1, span)
+    from_context().info(
+        "webdataset volume published", volume=args.volume,
+        samples=len(payloads), sequences=tokens.shape[0],
+    )
+    i = 0
+    while True:
+        idx = np.arange(i, i + cfg.batch_size) % tokens.shape[0]
+        yield {"tokens": tokens[idx]}
+        i += cfg.batch_size
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser("oim-trainer")
     parser.add_argument("--model", default="llama-tiny",
@@ -178,6 +231,11 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--volume", default="train-data")
     parser.add_argument("--volume-file", default="",
                         help="stage this file as the training volume")
+    parser.add_argument("--volume-webdataset", default="",
+                        help="comma-separated webdataset shard URLs "
+                             "(local paths or http(s)) to stage and train on")
+    parser.add_argument("--wds-ext", default="bin",
+                        help="sample extension holding int32 tokens")
     parser.add_argument("--feed-window-bytes", type=int, default=64 << 20,
                         help="host-resident feed window; 0 = materialize "
                              "the whole volume (small volumes only)")

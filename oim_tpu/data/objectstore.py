@@ -70,18 +70,31 @@ def content_length(url: str, headers: dict[str, str] | None = None) -> int:
 def fetch(url: str, offset: int | None = None, length: int | None = None,
           headers: dict[str, str] | None = None) -> bytes:
     """GET the object (or a byte range of it)."""
+    return _fetch_range(url, offset, length, headers)[0]
+
+
+def _fetch_range(url: str, offset: int | None, length: int | None,
+                 headers: dict[str, str] | None) -> tuple[bytes, int | None]:
+    """GET bytes plus the object's TOTAL size from Content-Range (None for
+    un-ranged responses) — the free consistency signal ranged reads get."""
     h = dict(headers or {})
     if offset is not None:
         end = "" if length is None else str(offset + length - 1)
         h["Range"] = f"bytes={offset}-{end}"
     with _open(url, h) as resp:
         data = resp.read()
+        rng = resp.headers.get("Content-Range", "")
+    total = None
+    if "/" in rng:
+        tail = rng.rsplit("/", 1)[1]
+        if tail.isdigit():
+            total = int(tail)
     if length is not None and len(data) != length:
         raise ObjectStoreError(
             f"{url}: range [{offset}, +{length}) returned {len(data)} bytes "
             "(server may not honor Range requests)"
         )
-    return data
+    return data, total
 
 
 def read_object(
@@ -125,7 +138,15 @@ def read_object(
 
     def pull(part):
         off, n = part
-        data = fetch(url, off, n, headers)
+        data, total = _fetch_range(url, off, n, headers)
+        if total is not None and total != size:
+            # The object changed between sizing (HEAD / caller's shard
+            # index) and this read: fail loudly instead of silently
+            # truncating or mixing versions.
+            raise ObjectStoreError(
+                f"{url}: object is {total} bytes but destination expects "
+                f"{size} (changed mid-stage?)"
+            )
         out[off:off + n] = np.frombuffer(data, dtype=np.uint8)
         return n
 

@@ -22,6 +22,7 @@ import ctypes
 import os
 import subprocess
 import threading
+import time
 import weakref
 from pathlib import Path
 from typing import Iterator
@@ -138,6 +139,7 @@ def read_into(path: str | os.PathLike, dst: np.ndarray, n_threads: int = 8) -> N
     """Fill ``dst`` (uint8, len == file size) from ``path``: parallel preads
     in C++ when built, a single readinto otherwise."""
     path = str(path)
+    t0 = time.monotonic()
     lib = native_lib()
     if lib is None:
         with open(path, "rb") as f:
@@ -151,6 +153,11 @@ def read_into(path: str | os.PathLike, dst: np.ndarray, n_threads: int = 8) -> N
     if got != dst.size:
         raise StagingError(f"read {path}: got {got} of {dst.size} bytes")
     M.STAGED_BYTES.inc(dst.size)
+    elapsed = time.monotonic() - t0
+    if lib is not None and elapsed > 0:
+        # Disk half of the staging pipeline, attributable separately from
+        # the host->HBM half (bench.py reports both).
+        M.STAGE_GBPS.set(dst.size / elapsed / 1e9)
 
 
 def read_pinned(path: str | os.PathLike, n_threads: int = 8) -> np.ndarray:

@@ -95,9 +95,13 @@ def _as_view(data: bytes | np.ndarray) -> memoryview:
 
 def index_shard(data: bytes | np.ndarray) -> list[TarEntry]:
     """Index every regular file in one tar shard without extracting or
-    copying it (offsets address into ``data`` directly)."""
+    copying it (offsets address into ``data`` directly). ignore_zeros lets
+    this walk a CONCATENATED shard sequence too — exactly what a staged
+    multi-shard volume (read_shards) holds."""
     entries = []
-    with tarfile.open(fileobj=_MemFile(_as_view(data)), mode="r:") as tf:
+    with tarfile.open(
+        fileobj=_MemFile(_as_view(data)), mode="r:", ignore_zeros=True
+    ) as tf:
         for member in tf:
             if member.isfile():
                 entries.append(

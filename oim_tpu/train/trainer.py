@@ -60,13 +60,34 @@ PEAK_FLOPS = {
     "v6e": 918e12,
 }
 
+# Peak HBM bandwidth per chip (bytes/s) for roofline accounting: a
+# bandwidth-bound model (ResNet bf16) is honestly judged by fraction of
+# this, not by MFU.
+PEAK_HBM_BW = {
+    "v4": 1.23e12,
+    "v5 lite": 819e9,  # v5e
+    "v5e": 819e9,
+    "v5p": 2.765e12,
+    "v5": 2.765e12,
+    "v6 lite": 1.64e12,
+    "v6e": 1.64e12,
+}
 
-def peak_flops_per_device() -> float:
+
+def _lookup_peak(table: dict[str, float]) -> float:
     kind = jax.devices()[0].device_kind.lower()
-    for key, val in PEAK_FLOPS.items():
+    for key, val in table.items():
         if key in kind:
             return val
     return 0.0
+
+
+def peak_flops_per_device() -> float:
+    return _lookup_peak(PEAK_FLOPS)
+
+
+def peak_hbm_bw_per_device() -> float:
+    return _lookup_peak(PEAK_HBM_BW)
 
 
 @dataclasses.dataclass

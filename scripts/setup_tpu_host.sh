@@ -48,7 +48,11 @@ echo "== oim-tpu host setup: role=$ROLE repo=$REPO registry=$REGISTRY"
 
 # 1. Native staging engine (optional but the fast path; Python falls back).
 if command -v make >/dev/null && command -v g++ >/dev/null; then
-  make -C "$REPO/native" >/dev/null && echo "   native staging engine built"
+  if make -C "$REPO/native" >/dev/null 2>&1; then
+    echo "   native staging engine built"
+  else
+    echo "   native build failed; staging runs on the Python fallback" >&2
+  fi
 else
   echo "   no toolchain; staging runs on the Python fallback"
 fi
@@ -121,7 +125,7 @@ else
 fi
 
 # 5. Verify: the controller's registration must appear in the registry.
-if [[ "$ROLE" == "controller" && -f "$CA_DIR/user.admin" ]]; then
+if [[ "$ROLE" == "controller" && -f "$CA_DIR/user.admin.key" ]]; then
   for _ in $(seq 1 30); do
     if (cd "$REPO" && python3 -m oim_tpu.cli.oimctl --registry "$REGISTRY" \
         --ca "$CA_DIR/ca.crt" --key "$CA_DIR/user.admin" \

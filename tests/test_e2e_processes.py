@@ -172,6 +172,41 @@ class TestReadmeQuickstart:
         assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-3000:]
         assert "done" in out.stdout + out.stderr
 
+    def test_trainer_fed_from_webdataset_shards(self, cluster, tmp_path):
+        """Config-5 shape (BASELINE.json): llama trained from webdataset
+        shards staged through MapVolume — here two local tar shards whose
+        samples carry raw int32 token payloads."""
+        import io
+        import tarfile
+
+        rng = np.random.RandomState(1)
+        for shard in range(2):
+            buf = io.BytesIO()
+            with tarfile.open(fileobj=buf, mode="w") as tf:
+                for i in range(4):
+                    payload = rng.randint(0, 256, 512).astype(np.int32).tobytes()
+                    info = tarfile.TarInfo(name=f"{shard:03d}/{i:06d}.bin")
+                    info.size = len(payload)
+                    tf.addfile(info, io.BytesIO(payload))
+            (tmp_path / f"shard-{shard}.tar").write_bytes(buf.getvalue())
+        urls = ",".join(str(tmp_path / f"shard-{s}.tar") for s in range(2))
+        out = run_cli(
+            cluster, "oim_tpu.cli.oim_trainer",
+            "--platform", "cpu", "--model", "llama-tiny",
+            "--steps", "3", "--batch-size", "2", "--seq-len", "32",
+            "--log-every", "1", "--warmup-steps", "1", "--mesh", "data=1",
+            "--registry", f"127.0.0.1:{cluster.registry_port}",
+            "--controller-id", "host-0",
+            "--volume", "wds-tokens", "--volume-webdataset", urls,
+            "--ca", f"{cluster.certs}/ca.crt",
+            "--key", f"{cluster.certs}/host.host-0",
+            timeout=300,
+        )
+        assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-3000:]
+        combined = out.stdout + out.stderr
+        assert "webdataset volume published" in combined
+        assert "done" in combined
+
     def test_soft_state_reregistration_across_processes(self, cluster):
         """Delete the controller's registration; the 1s re-registration loop
         must restore it (reference controller_test.go:107-127, here across

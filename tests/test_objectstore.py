@@ -128,6 +128,16 @@ class TestObjectStore:
                 url, headers=objectstore.basic_auth_headers("admin", "wrong")
             )
 
+    def test_size_change_mid_stage_fails_loudly(self, gateway):
+        # The caller's destination fixes the expected size (e.g. a shard
+        # index built moments earlier); if the object's real size differs,
+        # the Content-Range total must fail the read, not truncate it.
+        gateway.objects["/obj"] = b"y" * 64
+        with pytest.raises(objectstore.ObjectStoreError, match="64 bytes"):
+            objectstore.read_object(
+                _endpoint(gateway) + "/obj", out=np.empty(50, np.uint8)
+            )
+
     def test_missing_object(self, gateway):
         with pytest.raises(objectstore.ObjectStoreError, match="404"):
             objectstore.fetch(_endpoint(gateway) + "/nope")
@@ -167,6 +177,13 @@ class TestWebDataset:
         assert samples[0]["__key__"] == b"000/a"
         assert samples[1]["jpg"] == self.SAMPLES["000/b"]["jpg"]
         assert samples[2]["cls"] == b"1"
+
+    def test_concatenated_shards_index_as_one_stream(self):
+        # A staged multi-shard volume is shards laid back to back; the tar
+        # walk must cross the end-of-archive zero blocks (ignore_zeros).
+        flat = make_tar({"a": {"bin": b"AA"}}) + make_tar({"b": {"bin": b"BB"}})
+        keys = [s["__key__"] for s in webdataset.iter_samples([flat])]
+        assert keys == [b"a", b"b"]
 
     def test_multi_extension_groups_on_first_dot(self):
         # WebDataset convention: '0001.seg.png' belongs to sample '0001'
