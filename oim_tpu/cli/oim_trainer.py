@@ -87,14 +87,7 @@ def feeder_batches(args, cfg: TrainConfig, tls):
         )
         i = 0
         if cfg.model.startswith("llama"):
-            tokens = data.reshape(-1)
-            span = cfg.seq_len + 1
-            n = (tokens.size // span) * span
-            tokens = tokens[:n].reshape(-1, span).astype(np.int32)
-            while True:
-                idx = np.arange(i, i + cfg.batch_size) % tokens.shape[0]
-                yield {"tokens": tokens[idx]}
-                i += cfg.batch_size
+            yield from _cycle_token_batches(data.reshape(-1), cfg, args.volume)
         else:
             images = data.astype(np.float32)
             labels = np.zeros((images.shape[0],), np.int32)
@@ -160,6 +153,24 @@ def feeder_batches(args, cfg: TrainConfig, tls):
         offset += w.size
 
 
+def _cycle_token_batches(tokens_flat, cfg: TrainConfig, volume: str):
+    """Flat token stream -> cyclic [batch, seq_len+1] batches (the record
+    framing + epoch-wrap loop shared by the file and webdataset feeds)."""
+    span = cfg.seq_len + 1
+    n = (tokens_flat.size // span) * span
+    if n == 0:
+        raise SystemExit(
+            f"volume {volume!r} holds {tokens_flat.size} tokens "
+            f"< seq_len+1={span}"
+        )
+    tokens = np.asarray(tokens_flat[:n]).reshape(-1, span).astype(np.int32)
+    i = 0
+    while True:
+        idx = np.arange(i, i + cfg.batch_size) % tokens.shape[0]
+        yield {"tokens": tokens[idx]}
+        i += cfg.batch_size
+
+
 def _webdataset_token_batches(args, cfg: TrainConfig, feeder, pub):
     """Samples from a staged webdataset volume -> token batches.
 
@@ -180,23 +191,19 @@ def _webdataset_token_batches(args, cfg: TrainConfig, feeder, pub):
             f"webdataset volume {args.volume!r} has no samples with "
             f"extension {ext!r}"
         )
-    tokens = np.frombuffer(b"".join(payloads), dtype=np.int32)
-    span = cfg.seq_len + 1
-    n = (tokens.size // span) * span
-    if n == 0:
+    blob = b"".join(payloads)
+    if len(blob) % 4:
         raise SystemExit(
-            f"webdataset volume holds {tokens.size} tokens < seq_len+1={span}"
+            f"webdataset volume {args.volume!r}: payloads under extension "
+            f"{ext!r} total {len(blob)} bytes — not int32-aligned; is "
+            f"--wds-ext pointing at the token member?"
         )
-    tokens = tokens[:n].reshape(-1, span)
+    tokens = np.frombuffer(blob, dtype=np.int32)
     from_context().info(
         "webdataset volume published", volume=args.volume,
-        samples=len(payloads), sequences=tokens.shape[0],
+        samples=len(payloads), tokens=tokens.size,
     )
-    i = 0
-    while True:
-        idx = np.arange(i, i + cfg.batch_size) % tokens.shape[0]
-        yield {"tokens": tokens[idx]}
-        i += cfg.batch_size
+    yield from _cycle_token_batches(tokens, cfg, args.volume)
 
 
 def main(argv: list[str] | None = None) -> int:

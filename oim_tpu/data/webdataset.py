@@ -93,20 +93,41 @@ def _as_view(data: bytes | np.ndarray) -> memoryview:
     return memoryview(data)
 
 
+_BLOCK = 512
+
+
 def index_shard(data: bytes | np.ndarray) -> list[TarEntry]:
-    """Index every regular file in one tar shard without extracting or
-    copying it (offsets address into ``data`` directly). ignore_zeros lets
-    this walk a CONCATENATED shard sequence too — exactly what a staged
-    multi-shard volume (read_shards) holds."""
+    """Index every regular file without extracting or copying (offsets
+    address into ``data`` directly). Walks a CONCATENATED shard sequence —
+    what a staged multi-shard volume (read_shards) holds — by strictly
+    parsing one archive at a time and skipping only the all-zero
+    end-of-archive padding between them. Unlike tarfile's ignore_zeros
+    (which also skips INVALID blocks), a corrupted header still raises
+    tarfile.ReadError — damaged shards fail loudly, never silently losing
+    samples."""
+    view = _as_view(data)
+    n = len(view)
     entries = []
-    with tarfile.open(
-        fileobj=_MemFile(_as_view(data)), mode="r:", ignore_zeros=True
-    ) as tf:
-        for member in tf:
-            if member.isfile():
-                entries.append(
-                    TarEntry(member.name, member.offset_data, member.size)
-                )
+    pos = 0
+    while pos + _BLOCK <= n:
+        block = view[pos:pos + _BLOCK]
+        if bytes(block).count(0) == _BLOCK:  # end-of-archive padding
+            pos += _BLOCK
+            continue
+        last_end = pos
+        with tarfile.open(fileobj=_MemFile(view[pos:]), mode="r:") as tf:
+            got_any = False
+            for member in tf:
+                got_any = True
+                if member.isfile():
+                    entries.append(TarEntry(
+                        member.name, pos + member.offset_data, member.size
+                    ))
+                data_blocks = -(-member.size // _BLOCK) * _BLOCK
+                last_end = pos + member.offset_data + data_blocks
+            if not got_any:
+                break
+        pos = last_end
     return entries
 
 

@@ -1,0 +1,138 @@
+"""Autoregressive decoding for the Llama family: KV cache + sampled/greedy
+generation.
+
+New scope relative to the reference (a storage control plane has no
+inference path); this completes the model-family API so a checkpoint
+trained by oim-trainer is directly servable. TPU-first shape:
+
+- The cache is a pair of [L, B, S, kv_heads, head_dim] arrays scanned in
+  lockstep with the stacked layer params — one trace per layer regardless
+  of depth, like the training path.
+- Decode attends over the FULL fixed-size cache with a position mask
+  (static shapes; no growing arrays inside jit). Prefill and decode are the
+  same function at different T, so there is exactly one cached-forward
+  implementation to keep correct.
+- The decode loop is a ``lax.scan`` over steps: one compiled program
+  generates any number of tokens.
+
+Sharding: the cache dims follow the attention heads, so under TP_SP_RULES
+the kv_heads axis shards over "model" exactly like wk/wv; generate() works
+unchanged under jit with sharded params.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from oim_tpu.models.llama import Config, _ffn
+from oim_tpu.ops.norms import rmsnorm
+from oim_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+def init_cache(cfg: Config, batch: int, max_seq: int):
+    """Zeroed KV cache: {"k","v"} of [L, B, max_seq, kv_heads, head_dim]."""
+    shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _cache_attention(q, ck, cv, pos, cfg: Config):
+    """q [B,T,H,hd] over the full cache [B,S,kvh,hd], masked to positions
+    <= pos+t (unwritten cache slots mask out with everything else)."""
+    B, T, H, hd = q.shape
+    S = ck.shape[1]
+    group = H // cfg.n_kv_heads
+    k = jnp.repeat(ck, group, axis=2)  # [B,S,H,hd]
+    v = jnp.repeat(cv, group, axis=2)
+    qf = q.astype(jnp.float32) * (hd ** -0.5)
+    scores = jnp.einsum("bthd,bshd->bhts", qf, k.astype(jnp.float32))
+    s_idx = jnp.arange(S)[None, None, None, :]
+    t_idx = pos + jnp.arange(T)[None, None, :, None]
+    scores = jnp.where(s_idx <= t_idx, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def cached_forward(params, tokens, cache, pos, cfg: Config):
+    """Forward ``tokens`` [B,T] occupying absolute positions pos..pos+T-1.
+
+    Returns (logits [B,T,vocab] f32, updated cache). Serves both prefill
+    (T = prompt length, pos = 0) and decode (T = 1).
+    """
+    B, T = tokens.shape
+    S = cache["k"].shape[2]
+    # Host-numpy weight trees (a freshly restored checkpoint) must work:
+    # numpy arrays can't be indexed by traced token ids inside the decode
+    # scan, so lift everything to jax arrays first (no-op when already on
+    # device).
+    params = jax.tree.map(jnp.asarray, params)
+    cos, sin = rope_frequencies(cfg.head_dim, S, cfg.rope_theta)
+    positions = jnp.broadcast_to(pos + jnp.arange(T), (B, T))
+    x = params["embed"][tokens].astype(cfg.dtype)
+
+    def body(x, inp):
+        layer, ck, cv = inp
+        h = rmsnorm(x, layer["attn_norm"])
+        q = (h @ layer["wq"]).reshape(B, T, cfg.n_heads, cfg.head_dim)
+        k = (h @ layer["wk"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ layer["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        ck = lax.dynamic_update_slice_in_dim(ck, k, pos, axis=1)
+        cv = lax.dynamic_update_slice_in_dim(cv, v, pos, axis=1)
+        attn = _cache_attention(q, ck, cv, pos, cfg)
+        x = x + attn.reshape(B, T, cfg.q_dim) @ layer["wo"]
+        h = rmsnorm(x, layer["mlp_norm"])
+        ffn, _ = _ffn(h, layer, cfg)
+        return x + ffn, (ck, cv)
+
+    x, (ck, cv) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rmsnorm(x, params["final_norm"])
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": ck, "v": cv}
+
+
+def generate(params, prompt, n_new: int, cfg: Config,
+             temperature: float = 0.0, rng=None, max_seq: int | None = None):
+    """prompt [B,T0] int32 -> [B, T0+n_new]: prefill once, then one
+    compiled lax.scan decode loop. temperature 0 = greedy, else categorical
+    sampling. Wrap in jax.jit(..., static_argnums=...) for repeated use.
+    """
+    B, t0 = prompt.shape
+    if n_new < 0:
+        raise ValueError(f"n_new must be >= 0, got {n_new}")
+    if n_new == 0:
+        return prompt
+    s = max_seq or (t0 + n_new)
+    if s < t0 + n_new:
+        raise ValueError(f"max_seq {s} < prompt {t0} + n_new {n_new}")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def sample(logits, key):
+        if temperature > 0:
+            return jax.random.categorical(
+                key, logits / temperature).astype(prompt.dtype)
+        return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+
+    cache = init_cache(cfg, B, s)
+    logits, cache = cached_forward(params, prompt, cache, 0, cfg)
+    rng, sub = jax.random.split(rng)
+    tok = sample(logits[:, -1], sub)
+
+    def step(carry, _):
+        cache, tok, pos, key = carry
+        key, sub = jax.random.split(key)
+        logits, cache = cached_forward(params, tok[:, None], cache, pos, cfg)
+        nxt = sample(logits[:, -1], sub)
+        return (cache, nxt, pos + 1, key), nxt
+
+    (cache, _, _, _), rest = lax.scan(
+        step, (cache, tok, jnp.int32(t0), rng), None, length=n_new - 1
+    )
+    new_tokens = jnp.concatenate(
+        [tok[:, None]] + ([rest.T] if n_new > 1 else []), axis=1
+    )
+    return jnp.concatenate([prompt, new_tokens], axis=1)

@@ -153,6 +153,19 @@ def param_logical_axes(cfg: Config = LLAMA3_8B):
 AttentionFn = Callable[..., Any]  # (q, k, v, causal=...) -> out
 
 
+def _ffn(h, layer, cfg: Config):
+    """FFN half of a block on the pre-normed activations; returns
+    (out, aux) — aux is 0 for the dense FFN, the load-balance loss for MoE.
+    Shared by the training path (_layer) and the KV-cached decode path
+    (models/generate.py)."""
+    if cfg.n_experts:
+        from oim_tpu.models import moe
+
+        return moe.apply(layer["moe"], h, cfg.moe)
+    gated = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
+    return gated @ layer["w_down"], jnp.zeros((), jnp.float32)
+
+
 def _layer(x, layer, cfg: Config, cos, sin, attn_fn: AttentionFn):
     """Returns (x, aux_loss); aux is 0 for dense FFN layers."""
     B, T, D = x.shape
@@ -165,13 +178,8 @@ def _layer(x, layer, cfg: Config, cos, sin, attn_fn: AttentionFn):
     attn = attn_fn(q, k, v, causal=True)
     x = x + attn.reshape(B, T, cfg.q_dim) @ layer["wo"]
     h = rmsnorm(x, layer["mlp_norm"])
-    if cfg.n_experts:
-        from oim_tpu.models import moe
-
-        ffn, aux = moe.apply(layer["moe"], h, cfg.moe)
-        return x + ffn, aux
-    gated = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
-    return x + gated @ layer["w_down"], jnp.zeros((), jnp.float32)
+    ffn, aux = _ffn(h, layer, cfg)
+    return x + ffn, aux
 
 
 def apply(params, tokens, cfg: Config = LLAMA3_8B,

@@ -176,3 +176,24 @@ class TestSpecDrift:
         import scripts.gen_proto as gen
 
         assert gen.main(check=True) == 0
+
+
+class TestProfiling:
+    def test_profile_trace_writes_a_trace(self, tmp_path):
+        """SURVEY §5.1: jax.profiler trace is the Jaeger replacement; the
+        context manager must produce a loadable trace dir around real work."""
+        import jax.numpy as jnp
+
+        from oim_tpu.common.profiling import profile_trace
+
+        d = tmp_path / "trace"
+        with profile_trace(str(d)):
+            float(jnp.arange(256.0).sum())
+        files = list(d.rglob("*")) if d.exists() else []
+        assert any(f.is_file() for f in files), "no trace artifacts written"
+
+    def test_profile_trace_noop_on_empty(self):
+        from oim_tpu.common.profiling import profile_trace
+
+        with profile_trace(""):
+            pass

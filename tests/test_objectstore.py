@@ -178,6 +178,21 @@ class TestWebDataset:
         assert samples[1]["jpg"] == self.SAMPLES["000/b"]["jpg"]
         assert samples[2]["cls"] == b"1"
 
+    def test_corrupted_header_fails_loudly(self):
+        # Concatenation support must NOT cost corruption detection: a
+        # clobbered member header raises instead of silently dropping the
+        # sample (the ignore_zeros failure mode).
+        import tarfile as tarfile_mod
+
+        shard = bytearray(make_tar(
+            {"a": {"bin": b"AA"}, "b": {"bin": b"BB"}, "c": {"bin": b"CC"}}
+        ))
+        entries = webdataset.index_shard(bytes(shard))
+        hdr = next(e.offset - 512 for e in entries if e.key == "b")
+        shard[hdr] ^= 0xFF  # flip a byte in b's header
+        with pytest.raises(tarfile_mod.ReadError):
+            webdataset.index_shard(bytes(shard))
+
     def test_concatenated_shards_index_as_one_stream(self):
         # A staged multi-shard volume is shards laid back to back; the tar
         # walk must cross the end-of-archive zero blocks (ignore_zeros).
