@@ -163,7 +163,10 @@ def _cycle_token_batches(tokens_flat, cfg: TrainConfig, volume: str):
             f"volume {volume!r} holds {tokens_flat.size} tokens "
             f"< seq_len+1={span}"
         )
-    tokens = np.asarray(tokens_flat[:n]).reshape(-1, span).astype(np.int32)
+    # copy=False: the webdataset feed arrives already int32 — don't
+    # duplicate a multi-GB volume in host RAM for a no-op cast.
+    tokens = np.asarray(tokens_flat[:n]).reshape(-1, span).astype(
+        np.int32, copy=False)
     i = 0
     while True:
         idx = np.arange(i, i + cfg.batch_size) % tokens.shape[0]

@@ -31,6 +31,21 @@ from oim_tpu.ops.norms import rmsnorm
 from oim_tpu.ops.rope import apply_rope, rope_frequencies
 
 
+def _no_drop(cfg: Config) -> Config:
+    """MoE inference must not drop tokens: training groups tokens per call
+    and caps expert capacity, but a decode step has so few tokens that the
+    cap would route trained tokens to nothing. A capacity factor of
+    n_experts/top_k makes capacity == n_tokens — mathematically no drop."""
+    if not cfg.n_experts:
+        return cfg
+    import dataclasses
+
+    factor = cfg.n_experts / cfg.moe_top_k
+    if cfg.moe_capacity_factor >= factor:
+        return cfg
+    return dataclasses.replace(cfg, moe_capacity_factor=factor)
+
+
 def init_cache(cfg: Config, batch: int, max_seq: int):
     """Zeroed KV cache: {"k","v"} of [L, B, max_seq, kv_heads, head_dim]."""
     shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
@@ -39,20 +54,24 @@ def init_cache(cfg: Config, batch: int, max_seq: int):
 
 def _cache_attention(q, ck, cv, pos, cfg: Config):
     """q [B,T,H,hd] over the full cache [B,S,kvh,hd], masked to positions
-    <= pos+t (unwritten cache slots mask out with everything else)."""
+    <= pos+t (unwritten cache slots mask out with everything else).
+
+    GQA rides a grouped einsum against the kv-head cache directly — no
+    head-expanded copy of the cache, no f32 materialization of K (the
+    einsum accumulates in f32 from bf16 operands, the same numerics as the
+    training path's mha_reference)."""
     B, T, H, hd = q.shape
     S = ck.shape[1]
-    group = H // cfg.n_kv_heads
-    k = jnp.repeat(ck, group, axis=2)  # [B,S,H,hd]
-    v = jnp.repeat(cv, group, axis=2)
-    qf = q.astype(jnp.float32) * (hd ** -0.5)
-    scores = jnp.einsum("bthd,bshd->bhts", qf, k.astype(jnp.float32))
-    s_idx = jnp.arange(S)[None, None, None, :]
-    t_idx = pos + jnp.arange(T)[None, None, :, None]
-    scores = jnp.where(s_idx <= t_idx, scores, -jnp.inf)
+    g = H // cfg.n_kv_heads
+    qg = q.reshape(B, T, cfg.n_kv_heads, g, hd)
+    scores = jnp.einsum(
+        "btkgd,bskd->bkgts", qg, ck, preferred_element_type=jnp.float32
+    ) * (hd ** -0.5)
+    mask = (pos + jnp.arange(T))[:, None] >= jnp.arange(S)[None, :]  # [T,S]
+    scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhts,bshd->bthd", probs, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, cv.astype(jnp.float32))
+    return out.reshape(B, T, H, hd).astype(q.dtype)
 
 
 def cached_forward(params, tokens, cache, pos, cfg: Config):
@@ -63,6 +82,7 @@ def cached_forward(params, tokens, cache, pos, cfg: Config):
     """
     B, T = tokens.shape
     S = cache["k"].shape[2]
+    cfg = _no_drop(cfg)
     # Host-numpy weight trees (a freshly restored checkpoint) must work:
     # numpy arrays can't be indexed by traced token ids inside the decode
     # scan, so lift everything to jax arrays first (no-op when already on

@@ -227,14 +227,12 @@ def make_pipelined_loss(mesh, cfg: Config, n_microbatches: int,
     pipelined stack (replicated — they are a small fraction of the FLOPs).
 
     Returns ``loss_fn(params, tokens[B, T+1]) -> scalar`` to be called
-    inside a jitted train step over ``mesh``.
+    inside a jitted train step over ``mesh``. MoE configs work too: the
+    load-balance aux loss rides the pipeline's masked aux accumulator
+    (bubble-tick garbage never leaks into it). Note the MoE capacity is
+    computed per MICROBATCH (mb*T tokens per expert group), a slightly
+    tighter bound than the sequential full-batch grouping.
     """
-    if cfg.n_experts:
-        raise NotImplementedError(
-            "pipe rules currently support the dense FFN only (the GPipe "
-            "carry is a single activation tensor; the MoE aux loss would "
-            "need a second carried accumulator)"
-        )
     if attn_fn is None:
         attn_fn = default_attention
     from oim_tpu.parallel.pipeline import make_pipelined_apply
@@ -244,10 +242,11 @@ def make_pipelined_loss(mesh, cfg: Config, n_microbatches: int,
         # XLA constant-folds them, so nothing traced crosses the shard_map
         # boundary by closure.
         cos, sin = rope_frequencies(cfg.head_dim, h.shape[1], cfg.rope_theta)
-        out, _ = _layer(h, layer, cfg, cos, sin, attn_fn)
-        return out
+        return _layer(h, layer, cfg, cos, sin, attn_fn)
 
-    pipe_fn = make_pipelined_apply(mesh, layer_fn, n_microbatches, axis=axis)
+    pipe_fn = make_pipelined_apply(
+        mesh, layer_fn, n_microbatches, axis=axis, with_aux=True
+    )
 
     def loss_fn(params, tokens):
         inputs = tokens[:, :-1]
@@ -258,10 +257,14 @@ def make_pipelined_loss(mesh, cfg: Config, n_microbatches: int,
             )
         x = params["embed"][inputs].astype(cfg.dtype)
         x = x.reshape(n_microbatches, B // n_microbatches, T, cfg.dim)
-        y = pipe_fn(params["layers"], x).reshape(B, T, cfg.dim)
+        y, aux = pipe_fn(params["layers"], x)
+        y = y.reshape(B, T, cfg.dim)
         y = rmsnorm(y, params["final_norm"])
         logits = (y @ params["lm_head"]).astype(jnp.float32)
-        return softmax_cross_entropy(logits, tokens[:, 1:], ignore_index)
+        loss = softmax_cross_entropy(logits, tokens[:, 1:], ignore_index)
+        if cfg.n_experts:
+            loss = loss + cfg.moe_aux_weight * aux
+        return loss
 
     return loss_fn
 

@@ -34,19 +34,27 @@ def pipeline_apply(
     x: Any,
     n_microbatches: int,
     axis: str = "pipe",
+    with_aux: bool = False,
+    aux_reduce_axes: tuple[str, ...] = (),
 ):
     """Run microbatched pipeline over the ``axis`` mesh axis.
 
     Must be called inside shard_map with ``axis`` bound.
 
-    layer_fn(carry, layer_params) -> carry: one layer (the same body the
-        sequential model scans with).
+    layer_fn(carry, layer_params) -> carry (or (carry, aux_scalar) when
+        ``with_aux``): one layer (the same body the sequential model scans
+        with). Aux losses (MoE load balance) are summed over a stage's
+        layers, masked to REAL microbatch ticks (bubble ticks compute
+        garbage activations whose aux must not leak into the loss), and
+        reduced across stages.
     stage_params: THIS stage's layer stack [L/P, ...] pytree (the "pipe"
         axis of the global [L, ...] stack, sharded by shard_map).
     x: [M, mb, ...] microbatched input (real data on every stage; only
         stage 0's is consumed).
     Returns [M, mb, ...] outputs (valid on every stage — the last stage's
-    results are rotated forward so stage 0 holds them too; see below).
+    results are rotated forward so stage 0 holds them too; see below), or
+    (outputs, aux_mean) when ``with_aux`` — aux_mean is the per-microbatch
+    mean of the summed layer aux, matching the sequential scan's value.
     """
     p = lax.psum(1, axis)  # concrete under shard_map
     idx = lax.axis_index(axis)
@@ -57,20 +65,27 @@ def pipeline_apply(
 
     def run_stage(h):
         def body(carry, layer):
-            return layer_fn(carry, layer), None
+            out = layer_fn(carry, layer)
+            if with_aux:
+                return out[0], out[1]
+            return out, jnp.zeros((), jnp.float32)
 
-        out, _ = lax.scan(body, h, stage_params)
-        return out
+        out, aux = lax.scan(body, h, stage_params)
+        return out, jnp.sum(aux)
 
     outputs = jnp.zeros((m,) + mb_shape, x.dtype)
     h = jnp.zeros(mb_shape, x.dtype)  # activation arriving from the left
+    aux_total = jnp.zeros((), jnp.float32)
 
     for t in range(m + p - 1):
         # Stage 0 injects microbatch t; other stages consume what arrived.
         mb_idx = jnp.clip(t, 0, m - 1)
         inject = lax.dynamic_index_in_dim(x, mb_idx, keepdims=False)
         h_in = jnp.where(idx == 0, inject, h)
-        out = run_stage(h_in)
+        out, aux = run_stage(h_in)
+        # Stage s processes microbatch t-s at tick t: real iff 0 <= t-s < m.
+        real = jnp.logical_and(idx <= t, t < idx + m)
+        aux_total = aux_total + jnp.where(real, aux, 0.0)
         # The last stage banks its result for microbatch t - (p - 1).
         out_idx = jnp.clip(t - (p - 1), 0, m - 1)
         bank = jnp.logical_and(idx == p - 1, t >= p - 1)
@@ -89,7 +104,16 @@ def pipeline_apply(
     outputs = lax.psum(
         jnp.where(idx == p - 1, outputs, jnp.zeros_like(outputs)), axis
     )
-    return outputs
+    if not with_aux:
+        return outputs
+    # Sum over stages; divide by M so per-microbatch means average to the
+    # sequential full-batch value (each microbatch saw every layer once);
+    # then mean over the batch shards (equal-sized, so mean-of-means is the
+    # global mean the auto-sharded sequential path computes).
+    aux_mean = lax.psum(aux_total, axis) / m
+    for batch_axis in aux_reduce_axes:
+        aux_mean = lax.pmean(aux_mean, batch_axis)
+    return outputs, aux_mean
 
 
 def pipeline_stage_slice(n_layers: int, axis_size: int, stage: int) -> slice:
@@ -106,13 +130,14 @@ def make_pipelined_apply(
     n_microbatches: int,
     axis: str = "pipe",
     batch_axes: tuple[str, ...] | None = None,
+    with_aux: bool = False,
 ):
     """shard_map-wrapped pipelined layer stack over ``mesh``.
 
     Returns fn(stacked_params, x) where stacked_params is the global
     [L, ...] stack (sharded over ``axis`` on dim 0) and x is [M, mb, ...]
     (microbatch dim replicated across stages, batch dim sharded over
-    ``batch_axes``).
+    ``batch_axes``). With ``with_aux``, fn returns (outputs, aux_mean).
     """
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
@@ -123,6 +148,7 @@ def make_pipelined_apply(
             if n not in (axis, "model", "expert", "seq")
         )
     x_spec = P(None, batch_axes or None)
+    out_specs = (x_spec, P()) if with_aux else x_spec
 
     def fn(stacked_params, x):
         """Not jitted here — wrap in jax.jit (or call inside a jitted train
@@ -130,11 +156,12 @@ def make_pipelined_apply(
         p_spec = jax.tree.map(lambda _: P(axis), stacked_params)
         return shard_map(
             lambda sp, xx: pipeline_apply(
-                layer_fn, sp, xx, n_microbatches, axis
+                layer_fn, sp, xx, n_microbatches, axis, with_aux=with_aux,
+                aux_reduce_axes=batch_axes,
             ),
             mesh=mesh,
             in_specs=(p_spec, x_spec),
-            out_specs=x_spec,
+            out_specs=out_specs,
             check_vma=False,
         )(stacked_params, x)
 

@@ -185,7 +185,11 @@ class TestGenerate:
         assert out.shape == (1, 9)
         assert (np.asarray(out) >= 0).all() and (np.asarray(out) < cfg.vocab).all()
 
-    def test_generate_moe_model(self):
+    def test_generate_moe_matches_no_drop_rollout(self):
+        # Decode uses no-drop routing (capacity == n_tokens); the reference
+        # rollout must use the same no-drop config for token-exact parity.
+        import dataclasses
+
         from oim_tpu.models import generate as gen
 
         cfg = llama.tiny(n_experts=4)
@@ -193,6 +197,11 @@ class TestGenerate:
         prompt = jax.random.randint(jax.random.PRNGKey(8), (2, 3), 0, cfg.vocab)
         out = gen.generate(params, prompt, 4, cfg)
         assert out.shape == (2, 7)
+        no_drop = dataclasses.replace(
+            cfg, moe_capacity_factor=cfg.n_experts / cfg.moe_top_k
+        )
+        expected = self._rollout_nocache(params, prompt, 4, no_drop)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(expected))
 
     def test_generate_zero_new_tokens_returns_prompt(self):
         from oim_tpu.models import generate as gen
