@@ -85,16 +85,15 @@ def feeder_batches(args, cfg: TrainConfig, tls):
         from_context().info(
             "volume published", volume=args.volume, shape=str(data.shape)
         )
-        i = 0
+        seed = _shuffle_seed(args)
         if cfg.model.startswith("llama"):
-            yield from _cycle_token_batches(data.reshape(-1), cfg, args.volume)
+            yield from _cycle_token_batches(
+                data.reshape(-1), cfg, args.volume, seed)
         else:
             images = data.astype(np.float32)
             labels = np.zeros((images.shape[0],), np.int32)
-            while True:
-                idx = np.arange(i, i + cfg.batch_size) % images.shape[0]
+            for idx in _cycle_indices(images.shape[0], cfg.batch_size, seed):
                 yield {"images": images[idx], "labels": labels[idx]}
-                i += cfg.batch_size
         return
 
     from oim_tpu.controller.backend import spec_dtype
@@ -153,7 +152,34 @@ def feeder_batches(args, cfg: TrainConfig, tls):
         offset += w.size
 
 
-def _cycle_token_batches(tokens_flat, cfg: TrainConfig, volume: str):
+def _shuffle_seed(args) -> int | None:
+    return getattr(args, "shuffle_seed", 0) if getattr(args, "shuffle", False) else None
+
+
+def _cycle_indices(n: int, batch: int, shuffle_seed: int | None = None):
+    """Endless batch-index generator over n records: sequential wraparound
+    by default, or permutation-queue shuffling when shuffle_seed is set —
+    each permutation is consumed exactly once before the next is drawn, so
+    every record is served exactly once per epoch even when batch doesn't
+    divide n (batches may straddle epoch boundaries; nothing is dropped or
+    double-sampled)."""
+    if shuffle_seed is None:
+        i = 0
+        while True:
+            yield np.arange(i, i + batch) % n
+            i = (i + batch) % n
+        return
+    rng = np.random.RandomState(shuffle_seed)
+    queue = rng.permutation(n)
+    while True:
+        while queue.size < batch:
+            queue = np.concatenate([queue, rng.permutation(n)])
+        yield queue[:batch]
+        queue = queue[batch:]
+
+
+def _cycle_token_batches(tokens_flat, cfg: TrainConfig, volume: str,
+                         shuffle_seed: int | None = None):
     """Flat token stream -> cyclic [batch, seq_len+1] batches (the record
     framing + epoch-wrap loop shared by the file and webdataset feeds)."""
     span = cfg.seq_len + 1
@@ -167,11 +193,8 @@ def _cycle_token_batches(tokens_flat, cfg: TrainConfig, volume: str):
     # duplicate a multi-GB volume in host RAM for a no-op cast.
     tokens = np.asarray(tokens_flat[:n]).reshape(-1, span).astype(
         np.int32, copy=False)
-    i = 0
-    while True:
-        idx = np.arange(i, i + cfg.batch_size) % tokens.shape[0]
+    for idx in _cycle_indices(tokens.shape[0], cfg.batch_size, shuffle_seed):
         yield {"tokens": tokens[idx]}
-        i += cfg.batch_size
 
 
 def _webdataset_token_batches(args, cfg: TrainConfig, feeder, pub):
@@ -206,7 +229,7 @@ def _webdataset_token_batches(args, cfg: TrainConfig, feeder, pub):
         "webdataset volume published", volume=args.volume,
         samples=len(payloads), tokens=tokens.size,
     )
-    yield from _cycle_token_batches(tokens, cfg, args.volume)
+    yield from _cycle_token_batches(tokens, cfg, args.volume, _shuffle_seed(args))
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -246,6 +269,11 @@ def main(argv: list[str] | None = None) -> int:
                              "(local paths or http(s)) to stage and train on")
     parser.add_argument("--wds-ext", default="bin",
                         help="sample extension holding int32 tokens")
+    parser.add_argument("--shuffle", action="store_true",
+                        help="reshuffle record order each epoch "
+                             "(whole-volume feeds; windowed feed streams "
+                             "in volume order)")
+    parser.add_argument("--shuffle-seed", type=int, default=0)
     parser.add_argument("--feed-window-bytes", type=int, default=64 << 20,
                         help="host-resident feed window; 0 = materialize "
                              "the whole volume (small volumes only)")

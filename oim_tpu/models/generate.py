@@ -70,7 +70,12 @@ def _cache_attention(q, ck, cv, pos, cfg: Config):
     mask = (pos + jnp.arange(T))[:, None] >= jnp.arange(S)[None, :]  # [T,S]
     scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgts,bskd->btkgd", probs, cv.astype(jnp.float32))
+    # Probs drop to the cache dtype (what the flash kernels do) so the V
+    # side also avoids an f32 copy of the cache; accumulation stays f32.
+    out = jnp.einsum(
+        "bkgts,bskd->btkgd", probs.astype(cv.dtype), cv,
+        preferred_element_type=jnp.float32,
+    )
     return out.reshape(B, T, H, hd).astype(q.dtype)
 
 
