@@ -217,7 +217,9 @@ def loss_fn(params, tokens, cfg: Config = LLAMA3_8B,
 
 def make_pipelined_loss(mesh, cfg: Config, n_microbatches: int,
                         attn_fn: AttentionFn | None = None,
-                        axis: str = "pipe", ignore_index: int = -1):
+                        axis: str = "pipe", ignore_index: int = -1,
+                        seq_axis: str | None = None,
+                        seq_parallel: str = "ring"):
     """Next-token CE with the stacked layer axis pipelined over ``axis``.
 
     The decoder body runs as a GPipe schedule (parallel/pipeline.py): each
@@ -226,6 +228,12 @@ def make_pipelined_loss(mesh, cfg: Config, n_microbatches: int,
     microbatches. Embedding, final norm and the LM head run outside the
     pipelined stack (replicated — they are a small fraction of the FLOPs).
 
+    ``seq_axis`` composes sequence parallelism INSIDE the pipeline: the
+    activation sequence dim shards over it and attention runs as
+    ring/Ulysses over that axis within the pipeline's shard_map (RoPE
+    positions are offset by the shard's global position). PP x SP x DP in
+    one jitted step.
+
     Returns ``loss_fn(params, tokens[B, T+1]) -> scalar`` to be called
     inside a jitted train step over ``mesh``. MoE configs work too: the
     load-balance aux loss rides the pipeline's masked aux accumulator
@@ -233,19 +241,54 @@ def make_pipelined_loss(mesh, cfg: Config, n_microbatches: int,
     computed per MICROBATCH (mb*T tokens per expert group), a slightly
     tighter bound than the sequential full-batch grouping.
     """
-    if attn_fn is None:
-        attn_fn = default_attention
     from oim_tpu.parallel.pipeline import make_pipelined_apply
 
-    def layer_fn(h, layer):
-        # RoPE tables are recomputed per layer call from static shapes only;
-        # XLA constant-folds them, so nothing traced crosses the shard_map
-        # boundary by closure.
-        cos, sin = rope_frequencies(cfg.head_dim, h.shape[1], cfg.rope_theta)
-        return _layer(h, layer, cfg, cos, sin, attn_fn)
+    seq_size = mesh.shape.get(seq_axis, 1) if seq_axis else 1
+    if seq_size <= 1:
+        seq_axis = None
+
+    if seq_axis is not None:
+        if attn_fn is not None:
+            raise ValueError(
+                "attn_fn and seq_axis are mutually exclusive: with a seq "
+                "axis the pipeline uses raw ring/Ulysses attention over "
+                "that axis (a custom attn_fn would silently be dropped)"
+            )
+        from oim_tpu.parallel.ring import ring_attention, ulysses_attention
+
+        inner = ring_attention if seq_parallel == "ring" else ulysses_attention
+
+        def sp_attn(q, k, v, causal=True):
+            return inner(q, k, v, axis_name=seq_axis, causal=causal)
+
+        def layer_fn(h, layer):
+            # h is the LOCAL sequence shard [mb, T/s, D]; RoPE needs the
+            # shard's global positions, gathered from the full-length table
+            # (static shapes: T_global = T_local * seq_size).
+            t_local = h.shape[1]
+            cos, sin = rope_frequencies(
+                cfg.head_dim, t_local * seq_size, cfg.rope_theta
+            )
+            start = lax.axis_index(seq_axis) * t_local
+            positions = start + jnp.arange(t_local)
+            return _layer(
+                h, layer, cfg, cos[positions], sin[positions], sp_attn
+            )
+    else:
+        local_attn = attn_fn if attn_fn is not None else default_attention
+
+        def layer_fn(h, layer):
+            # RoPE tables are recomputed per layer call from static shapes
+            # only; XLA constant-folds them, so nothing traced crosses the
+            # shard_map boundary by closure.
+            cos, sin = rope_frequencies(
+                cfg.head_dim, h.shape[1], cfg.rope_theta
+            )
+            return _layer(h, layer, cfg, cos, sin, local_attn)
 
     pipe_fn = make_pipelined_apply(
-        mesh, layer_fn, n_microbatches, axis=axis, with_aux=True
+        mesh, layer_fn, n_microbatches, axis=axis, with_aux=True,
+        seq_axis=seq_axis,
     )
 
     def loss_fn(params, tokens):

@@ -131,6 +131,7 @@ def make_pipelined_apply(
     axis: str = "pipe",
     batch_axes: tuple[str, ...] | None = None,
     with_aux: bool = False,
+    seq_axis: str | None = None,
 ):
     """shard_map-wrapped pipelined layer stack over ``mesh``.
 
@@ -138,6 +139,12 @@ def make_pipelined_apply(
     [L, ...] stack (sharded over ``axis`` on dim 0) and x is [M, mb, ...]
     (microbatch dim replicated across stages, batch dim sharded over
     ``batch_axes``). With ``with_aux``, fn returns (outputs, aux_mean).
+
+    ``seq_axis`` composes sequence parallelism INSIDE the pipeline: x's
+    third dim ([M, mb, T, ...]) is sharded over that axis, and because the
+    shard_map binds every mesh axis, layer_fn can use the raw ring/Ulysses
+    attention (parallel/ring.py) and collectives over ``seq_axis`` directly
+    — PP x SP x DP in one program.
     """
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
@@ -147,7 +154,10 @@ def make_pipelined_apply(
             n for n in mesh.axis_names
             if n not in (axis, "model", "expert", "seq")
         )
-    x_spec = P(None, batch_axes or None)
+    if seq_axis is None:
+        x_spec = P(None, batch_axes or None)
+    else:
+        x_spec = P(None, batch_axes or None, seq_axis)
     out_specs = (x_spec, P()) if with_aux else x_spec
 
     def fn(stacked_params, x):
@@ -157,7 +167,9 @@ def make_pipelined_apply(
         return shard_map(
             lambda sp, xx: pipeline_apply(
                 layer_fn, sp, xx, n_microbatches, axis, with_aux=with_aux,
-                aux_reduce_axes=batch_axes,
+                aux_reduce_axes=(
+                    batch_axes + ((seq_axis,) if seq_axis else ())
+                ),
             ),
             mesh=mesh,
             in_specs=(p_spec, x_spec),

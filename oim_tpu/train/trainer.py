@@ -172,7 +172,11 @@ def make_train_step(
 
     if cfg.model.startswith("llama"):
         logical = llama.param_logical_axes(mcfg)
-        attn_fn = _llama_attn_fn(cfg, mesh)
+        has_seq = mesh.shape.get("seq", 1) > 1
+        # Pipe+seq uses raw ring/Ulysses INSIDE the pipeline's shard_map;
+        # the standalone shard_map attention wrapper is for the other rules.
+        pipe_with_seq = cfg.rules == "pipe" and has_seq
+        attn_fn = None if pipe_with_seq else _llama_attn_fn(cfg, mesh)
 
         def init_params(rng):
             return llama.init(rng, mcfg), {}
@@ -183,14 +187,10 @@ def make_train_step(
                     "pipe rules need a mesh with a 'pipe' axis "
                     f"(got axes {tuple(mesh.shape)}); e.g. --mesh data=2,pipe=2"
                 )
-            if mesh.shape.get("seq", 1) > 1:
-                raise ValueError(
-                    "pipe rules do not compose with a seq axis yet: the "
-                    "ring/Ulysses attention is itself a shard_map, which "
-                    "cannot nest inside the pipeline's shard_map"
-                )
             pipe_loss = llama.make_pipelined_loss(
-                mesh, mcfg, cfg.microbatches, attn_fn
+                mesh, mcfg, cfg.microbatches, attn_fn,
+                seq_axis="seq" if pipe_with_seq else None,
+                seq_parallel=cfg.seq_parallel,
             )
 
             def loss_fn(params, extra, batch):

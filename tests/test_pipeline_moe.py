@@ -175,14 +175,31 @@ class TestLlamaPipeline:
         with pytest.raises(ValueError, match="pipe' axis"):
             Trainer(cfg)  # default mesh is data-only
 
-    def test_pipe_rules_reject_seq_axis(self):
-        # Ring/Ulysses attention is itself a shard_map and cannot nest
-        # inside the pipeline's shard_map.
+    def test_pipe_composes_with_ring_sequence_parallelism(self):
+        # PP x SP: the sequence dim shards over "seq" INSIDE the pipeline's
+        # shard_map (raw ring attention + offset RoPE); the loss must match
+        # the plain sequential model.
+        cfg = llama.tiny(n_layers=4)
         mesh = build_mesh([("data", 1), ("seq", 2), ("pipe", 2)])
-        cfg = TrainConfig(model="llama-tiny", rules="pipe", batch_size=4,
-                          seq_len=16, microbatches=2)
-        with pytest.raises(ValueError, match="seq"):
-            Trainer(cfg, mesh=mesh)
+        params = llama.init(jax.random.PRNGKey(7), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(8), (4, 17), 0, cfg.vocab)
+
+        pipe_loss = jax.jit(llama.make_pipelined_loss(
+            mesh, cfg, n_microbatches=2, seq_axis="seq"))
+        expected = float(llama.loss_fn(params, tokens, cfg))
+        got = float(pipe_loss(params, tokens))
+        np.testing.assert_allclose(got, expected, rtol=2e-5)
+
+    def test_trainer_pipe_seq_data_full_step(self):
+        # DP x SP x PP in one jitted step.
+        cfg = TrainConfig(
+            model="llama-tiny", rules="pipe", batch_size=4, seq_len=16,
+            microbatches=2, seq_parallel="ring", log_every=1,
+            warmup_steps=1, total_steps=2,
+        )
+        mesh = build_mesh([("data", 2), ("seq", 2), ("pipe", 2)])
+        loss = Trainer(cfg, mesh=mesh).run(steps=2)
+        assert np.isfinite(loss)
 
 
 class TestMoE:
