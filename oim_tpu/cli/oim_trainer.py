@@ -243,6 +243,11 @@ def main(argv: list[str] | None = None) -> int:
                         choices=("ring", "ulysses"))
     parser.add_argument("--microbatches", type=int, default=4,
                         help="GPipe microbatch count (--rules pipe)")
+    parser.add_argument("--remat", action="store_true",
+                        help="recompute activations in the backward pass "
+                             "(fit bigger models/batches in HBM)")
+    parser.add_argument("--accum-steps", type=int, default=1,
+                        help="gradient accumulation microbatches per update")
     parser.add_argument("--mesh", default="", help="e.g. data=4,model=2")
     parser.add_argument("--steps", type=int, default=100)
     parser.add_argument("--batch-size", type=int, default=8)
@@ -274,6 +279,8 @@ def main(argv: list[str] | None = None) -> int:
                              "(whole-volume feeds; windowed feed streams "
                              "in volume order)")
     parser.add_argument("--shuffle-seed", type=int, default=0)
+    parser.add_argument("--augment", action="store_true",
+                        help="host-side random flip + crop on image batches")
     parser.add_argument("--feed-window-bytes", type=int, default=64 << 20,
                         help="host-resident feed window; 0 = materialize "
                              "the whole volume (small volumes only)")
@@ -317,6 +324,8 @@ def main(argv: list[str] | None = None) -> int:
         rules=args.rules,
         seq_parallel=args.seq_parallel,
         microbatches=args.microbatches,
+        remat=args.remat,
+        accum_steps=args.accum_steps,
         batch_size=args.batch_size,
         seq_len=args.seq_len,
         image_size=args.image_size,
@@ -348,6 +357,14 @@ def main(argv: list[str] | None = None) -> int:
         data = feeder_batches(args, cfg, tls)
     elif not args.synthetic:
         args.synthetic = True
+    if args.augment:
+        from oim_tpu.data.augment import augment_batches
+        from oim_tpu.train.trainer import synthetic_batches
+
+        data = augment_batches(
+            data if data is not None else synthetic_batches(cfg),
+            seed=args.shuffle_seed,
+        )
 
     from oim_tpu.common.profiling import profile_trace
 

@@ -36,6 +36,10 @@ class Config:
     dtype: Any = jnp.bfloat16
     bn_momentum: float = 0.9
     bn_eps: float = 1e-5
+    # Recompute each bottleneck's activations in the backward pass
+    # (jax.checkpoint per block): extra fwd FLOPs for per-block activation
+    # memory — lets large per-chip batches fit without XLA's forced remat.
+    remat: bool = False
 
 
 def _conv_init(rng, kh, kw, cin, cout, dtype):
@@ -171,9 +175,14 @@ def apply(params, state, images, cfg: Config = Config(), training: bool = False)
         for b in range(n_blocks):
             name = f"stage{s_idx}_block{b}"
             stride = 2 if (b == 0 and s_idx > 0) else 1
-            x, new_state[name] = _bottleneck(
-                x, params[name], state[name], stride, training,
-                cfg.bn_momentum, cfg.bn_eps)
+
+            def block_fn(x, p, s, _stride=stride):
+                return _bottleneck(x, p, s, _stride, training,
+                                   cfg.bn_momentum, cfg.bn_eps)
+
+            if cfg.remat:
+                block_fn = jax.checkpoint(block_fn)
+            x, new_state[name] = block_fn(x, params[name], state[name])
     x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))  # global average pool
     logits = x @ params["head"]["kernel"].astype(jnp.float32) + params["head"]["bias"]
     return logits, new_state

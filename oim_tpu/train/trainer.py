@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax import lax
 
 from oim_tpu.common import metrics as M
 from oim_tpu.common.logging import from_context
@@ -96,6 +97,8 @@ class TrainConfig:
     rules: str = "dp"  # dp | fsdp | tp_sp | pipe
     seq_parallel: str = "ring"  # ring | ulysses (used when mesh seq axis > 1)
     microbatches: int = 4  # GPipe microbatch count (rules == "pipe")
+    remat: bool = False  # recompute activations in bwd (fit big configs)
+    accum_steps: int = 1  # gradient accumulation: split the batch, one update
     batch_size: int = 8
     seq_len: int = 128
     image_size: int = 224
@@ -111,14 +114,18 @@ class TrainConfig:
 
     def model_config(self):
         if self.model == "llama-tiny":
-            return llama.tiny()
-        if self.model == "llama-tiny-moe":
-            return llama.tiny(n_experts=4)
-        if self.model == "llama3-8b":
-            return llama.LLAMA3_8B
-        if self.model == "resnet50":
-            return resnet.Config(num_classes=self.num_classes)
-        raise ValueError(f"unknown model {self.model!r}")
+            mcfg = llama.tiny()
+        elif self.model == "llama-tiny-moe":
+            mcfg = llama.tiny(n_experts=4)
+        elif self.model == "llama3-8b":
+            mcfg = llama.LLAMA3_8B
+        elif self.model == "resnet50":
+            mcfg = resnet.Config(num_classes=self.num_classes)
+        else:
+            raise ValueError(f"unknown model {self.model!r}")
+        if self.remat:
+            mcfg = dataclasses.replace(mcfg, remat=True)
+        return mcfg
 
 
 def _llama_attn_fn(cfg: TrainConfig, mesh):
@@ -251,8 +258,50 @@ def make_train_step(
 
     init_fn = jax.jit(abstract_state, out_shardings=state_shardings)
 
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    accum = max(1, cfg.accum_steps)
+
+    def compute_grads(params, extra, batch):
+        if accum == 1:
+            return grad_fn(params, extra, batch)
+        # Gradient accumulation: split the batch into `accum` microbatches
+        # and scan, averaging grads/loss — one optimizer update per step,
+        # activation memory of one microbatch. (For CE-mean losses the
+        # average of microbatch grads equals the full-batch gradient.)
+        b0 = jax.tree.leaves(batch)[0].shape[0]
+        if b0 % accum:
+            raise ValueError(
+                f"batch {b0} not divisible by accum_steps {accum}"
+            )
+        micro = jax.tree.map(
+            lambda x: x.reshape((accum, x.shape[0] // accum) + x.shape[1:]),
+            batch,
+        )
+
+        def body(carry, mb):
+            gsum, extra, loss_sum = carry
+            (loss, new_extra), grads = grad_fn(params, extra, mb)
+            # Accumulate in f32: a bf16 accumulator (param dtype) rounds
+            # away low bits every add — the drift grows with accum_steps on
+            # exactly the big-model configs accumulation exists for.
+            gsum = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), gsum, grads
+            )
+            return (gsum, new_extra, loss_sum + loss), None
+
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        (gsum, new_extra, loss_sum), _ = lax.scan(
+            body, (zeros, extra, jnp.zeros((), jnp.float32)), micro
+        )
+        grads = jax.tree.map(
+            lambda g, p: (g / accum).astype(p.dtype), gsum, params
+        )
+        return (loss_sum / accum, new_extra), grads
+
     def step_fn(state: TrainState, batch):
-        (loss, new_extra), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        (loss, new_extra), grads = compute_grads(
             state.params, state.extra, batch
         )
         updates, new_opt = tx.update(grads, state.opt_state, state.params)

@@ -76,3 +76,32 @@ class TestInferCLI:
         )
         assert out.returncode != 0
         assert "no checkpoint" in out.stdout + out.stderr
+
+
+class TestAugment:
+    def test_shapes_dtype_and_determinism(self):
+        from oim_tpu.data.augment import augment_images
+
+        imgs = np.random.RandomState(0).rand(8, 32, 32, 3).astype(np.float32)
+        a1 = augment_images(imgs, np.random.RandomState(1))
+        a2 = augment_images(imgs, np.random.RandomState(1))
+        assert a1.shape == imgs.shape and a1.dtype == imgs.dtype
+        np.testing.assert_array_equal(a1, a2)  # seeded determinism
+        assert not np.array_equal(a1, imgs)  # something actually moved
+
+    def test_pixel_content_preserved_without_pad(self):
+        # flip-only mode: every row must be the original or its mirror.
+        from oim_tpu.data.augment import augment_images
+
+        imgs = np.arange(2 * 4 * 4 * 1, dtype=np.float32).reshape(2, 4, 4, 1)
+        out = augment_images(imgs, np.random.RandomState(0), crop_pad=0)
+        for i in range(2):
+            assert (np.array_equal(out[i], imgs[i])
+                    or np.array_equal(out[i], imgs[i, :, ::-1]))
+
+    def test_batch_wrapper_leaves_token_batches_alone(self):
+        from oim_tpu.data.augment import augment_batches
+
+        batches = iter([{"tokens": np.ones((2, 5), np.int32)}])
+        out = next(augment_batches(batches))
+        np.testing.assert_array_equal(out["tokens"], np.ones((2, 5), np.int32))

@@ -5,12 +5,14 @@ and the oim-trainer smoke CLI."""
 import urllib.request
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from oim_tpu.common.metrics import MetricsServer, Registry
 from oim_tpu.parallel import build_mesh
 from oim_tpu.train import TrainConfig, Trainer
+from oim_tpu.train.trainer import synthetic_batches
 
 
 def _run(cfg, axes, steps=3):
@@ -186,4 +188,74 @@ def test_trainer_feeder_data_path(tmp_path):
 
     trainer = Trainer(cfg, axes=[("data", 2)])
     loss = trainer.run(steps=2, data=batches())
+    assert np.isfinite(loss)
+
+
+def test_remat_matches_no_remat():
+    """jax.checkpoint changes memory, not math: loss and grads identical."""
+    import dataclasses
+
+    from oim_tpu.models import llama
+
+    cfg = llama.tiny()
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0, cfg.vocab)
+    rcfg = dataclasses.replace(cfg, remat=True)
+    np.testing.assert_allclose(
+        float(llama.loss_fn(params, tokens, cfg)),
+        float(llama.loss_fn(params, tokens, rcfg)),
+        rtol=1e-6,
+    )
+    g = jax.grad(lambda p: llama.loss_fn(p, tokens, cfg))(params)
+    gr = jax.grad(lambda p: llama.loss_fn(p, tokens, rcfg))(params)
+    np.testing.assert_allclose(
+        np.asarray(g["embed"]), np.asarray(gr["embed"]), atol=1e-6
+    )
+
+
+def test_resnet_remat_matches_no_remat():
+    import dataclasses
+
+    from oim_tpu.models import resnet
+
+    cfg = resnet.Config(num_classes=10, dtype=jnp.float32)
+    params, state = resnet.init(jax.random.PRNGKey(0), cfg)
+    imgs = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 64, 3))
+    logits, _ = resnet.apply(params, state, imgs, cfg, training=True)
+    rcfg = dataclasses.replace(cfg, remat=True)
+    logits_r, _ = resnet.apply(params, state, imgs, rcfg, training=True)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(logits_r), atol=1e-5
+    )
+
+
+def test_grad_accumulation_matches_full_batch():
+    """accum_steps=2 must produce the same update as one full-batch step
+    (CE is a token mean; microbatch-grad average == full-batch grad)."""
+    base = dict(model="llama-tiny", batch_size=8, seq_len=16, log_every=1,
+                warmup_steps=1, total_steps=1, seed=3)
+    batch = next(synthetic_batches(TrainConfig(**base)))
+
+    results = []
+    for accum in (1, 2):
+        cfg = TrainConfig(**base, accum_steps=accum)
+        trainer = Trainer(cfg, axes=[("data", 2)])
+        trainer.state = trainer.init_fn(jax.random.PRNGKey(0))
+        placed = trainer.place_batch(batch)
+        new_state, stats = trainer.step_fn(trainer.state, placed)
+        results.append((new_state, stats))
+    (s1, st1), (s2, st2) = results
+    np.testing.assert_allclose(
+        float(st1["loss"]), float(st2["loss"]), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(s1.params["embed"]), np.asarray(s2.params["embed"]),
+        atol=1e-6,
+    )
+
+
+def test_remat_trainer_full_step():
+    cfg = TrainConfig(model="llama-tiny", batch_size=4, seq_len=16,
+                      remat=True, log_every=1, warmup_steps=1, total_steps=2)
+    loss = Trainer(cfg, axes=[("data", 2)]).run(steps=2)
     assert np.isfinite(loss)
