@@ -258,6 +258,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--log-every", type=int, default=10)
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--checkpoint-every", type=int, default=0)
+    parser.add_argument("--eval-every", type=int, default=0,
+                        help="run a forward-only eval pass every N steps")
+    parser.add_argument("--eval-steps", type=int, default=8,
+                        help="batches per eval pass")
     parser.add_argument("--metrics-port", type=int, default=-1,
                         help=">=0 serves GET /metrics (0 = ephemeral port)")
     parser.add_argument("--smoke", action="store_true",
@@ -335,6 +339,8 @@ def main(argv: list[str] | None = None) -> int:
         log_every=args.log_every,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
+        eval_every=args.eval_every,
+        eval_steps=args.eval_steps,
     )
 
     server = None
@@ -358,12 +364,17 @@ def main(argv: list[str] | None = None) -> int:
     elif not args.synthetic:
         args.synthetic = True
     if args.augment:
+        import jax
+
         from oim_tpu.data.augment import augment_batches
         from oim_tpu.train.trainer import synthetic_batches
 
+        # Per-host decorrelated stream, offset from the shuffle seed so the
+        # two RNGs never alias.
+        aug_seed = (args.shuffle_seed + 1) * 1_000_003 + jax.process_index()
         data = augment_batches(
             data if data is not None else synthetic_batches(cfg),
-            seed=args.shuffle_seed,
+            seed=aug_seed,
         )
 
     from oim_tpu.common.profiling import profile_trace

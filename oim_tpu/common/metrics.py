@@ -93,6 +93,8 @@ TRAIN_EXAMPLES_PER_SEC = DEFAULT.gauge(
     "oim_train_examples_per_sec", "examples/sec of the most recent step")
 TRAIN_MFU = DEFAULT.gauge(
     "oim_train_mfu", "model flops utilization of the most recent step")
+EVAL_LOSS = DEFAULT.gauge(
+    "oim_eval_loss", "mean loss of the most recent evaluation pass")
 
 
 class MetricsServer:

@@ -110,6 +110,8 @@ class TrainConfig:
     log_every: int = 10
     checkpoint_dir: str = ""
     checkpoint_every: int = 0
+    eval_every: int = 0  # run an eval pass every N steps (0 = off)
+    eval_steps: int = 8  # batches per eval pass
     seed: int = 0
 
     def model_config(self):
@@ -168,11 +170,13 @@ def _follow_param_shardings(abstract_tree, params_abstract, p_shardings, replica
 
 def make_train_step(
     cfg: TrainConfig, mesh, tx
-) -> tuple[Callable, Any, Callable]:
-    """Returns (jitted_step, state_shardings, init_fn).
+) -> tuple[Callable, Any, Callable, Callable]:
+    """Returns (jitted_step, state_shardings, init_fn, eval_fn).
 
     ``init_fn(rng)`` materializes the TrainState directly sharded (jit with
     out_shardings — an 8B model never exists unsharded anywhere).
+    ``eval_fn(state, batch)`` is the forward-only loss: no grads, no state
+    mutation, inference-mode model (ResNet uses running BN statistics).
     """
     rules = RULES[cfg.rules]
     mcfg = cfg.model_config()
@@ -208,6 +212,8 @@ def make_train_step(
                 loss = llama.loss_fn(params, batch["tokens"], mcfg, attn_fn)
                 return loss, extra
 
+        eval_loss_fn = loss_fn  # llama eval = same forward, no update
+
         # Tokens arrive [B, T+1] — the +1 label shift makes the length
         # indivisible by a seq axis, so tokens stay batch-sharded only;
         # sequence sharding happens on activations inside the model
@@ -226,6 +232,13 @@ def make_train_step(
                 params, extra, batch["images"], mcfg, training=True
             )
             return softmax_cross_entropy(logits, batch["labels"]), new_extra
+
+        def eval_loss_fn(params, extra, batch):
+            # Inference mode: running BN statistics, state untouched.
+            logits, _ = resnet.apply(
+                params, extra, batch["images"], mcfg, training=False
+            )
+            return softmax_cross_entropy(logits, batch["labels"]), extra
 
         batch_logical = {
             "images": (BATCH, None, None, None),
@@ -324,7 +337,15 @@ def make_train_step(
         out_shardings=(state_shardings, None),
         donate_argnums=(0,),
     )
-    return jitted, state_shardings, init_fn
+
+    def eval_step(state: TrainState, batch):
+        loss, _ = eval_loss_fn(state.params, state.extra, batch)
+        return loss.astype(jnp.float32)
+
+    eval_fn = jax.jit(
+        eval_step, in_shardings=(state_shardings, batch_shardings)
+    )
+    return jitted, state_shardings, init_fn, eval_fn
 
 
 def synthetic_batches(cfg: TrainConfig) -> Iterator[dict]:
@@ -381,9 +402,8 @@ class Trainer:
             total_steps=cfg.total_steps,
             weight_decay=cfg.weight_decay,
         )
-        self.step_fn, self.state_shardings, self.init_fn = make_train_step(
-            cfg, mesh, self.tx
-        )
+        (self.step_fn, self.state_shardings, self.init_fn,
+         self.eval_fn) = make_train_step(cfg, mesh, self.tx)
         self.state: TrainState | None = None
         self.checkpointer = None
         if cfg.checkpoint_dir:
@@ -420,11 +440,41 @@ class Trainer:
             )
         return out
 
-    def run(self, steps: int | None = None, data: Iterator[dict] | None = None):
+    def evaluate(self, data: Iterator[dict], n_batches: int | None = None) -> float:
+        """Forward-only mean loss over n_batches (inference-mode model)."""
+        n = n_batches or self.cfg.eval_steps
+        total = 0.0
+        for _ in range(n):
+            total += float(self.eval_fn(self.state, self.place_batch(next(data))))
+        loss = total / max(n, 1)
+        M.EVAL_LOSS.set(loss)
+        return loss
+
+    def run(self, steps: int | None = None, data: Iterator[dict] | None = None,
+            eval_data: Iterator[dict] | None = None):
         log = from_context()
         cfg = self.cfg
         steps = steps or cfg.total_steps
-        data = data or synthetic_batches(cfg)
+        synthetic_default = None
+        if data is None:
+            data = synthetic_default = synthetic_batches(cfg)
+        eval_every = cfg.eval_every
+        if eval_every and eval_data is None:
+            if data is not synthetic_default:
+                # A real feed with no held-out stream: a synthetic fallback
+                # would report loss on noise while LOOKING like a held-out
+                # loss — skip eval loudly instead.
+                log.warning(
+                    "eval_every set but no eval_data supplied for a real "
+                    "feed; skipping eval (pass eval_data to run())"
+                )
+                eval_every = 0
+            else:
+                # Synthetic training stream: a shifted seed never replays
+                # the training batches.
+                eval_data = synthetic_batches(
+                    dataclasses.replace(cfg, seed=cfg.seed + 10_000)
+                )
         start_step = self.init_or_resume() if self.state is None else int(self.state.step)
         fps = flops_per_step(cfg)
         peak = peak_flops_per_device() * self.mesh.size
@@ -457,6 +507,13 @@ class Trainer:
                     grad_norm=round(float(stats["grad_norm"]), 4),
                     step_s=round(dt, 4), mfu=round(mfu, 4),
                 )
+            if eval_every and (i + 1) % eval_every == 0:
+                eval_loss = self.evaluate(eval_data)
+                log.info("eval", step=i + 1, eval_loss=round(eval_loss, 4))
+                # Keep eval wall time out of the train step-timing window
+                # (it would inflate step_s and understate MFU/examples-sec).
+                t_prev = time.monotonic()
+                last_logged = i + 1
             if (
                 self.checkpointer is not None
                 and cfg.checkpoint_every
