@@ -71,10 +71,12 @@ def feeder_batches(args, cfg: TrainConfig, tls):
     pub = feeder.publish(req, timeout=args.publish_timeout)
     window = getattr(args, "feed_window_bytes", 0)
     if req.WhichOneof("params") == "webdataset":
-        # Shards are tars: a byte window could split a header, so the
-        # sample index is built over the whole staged volume (config-5
-        # shape: llama fed from webdataset shards through MapVolume).
-        yield from _webdataset_token_batches(args, cfg, feeder, pub)
+        # Config-5 shape: llama fed from webdataset shards through
+        # MapVolume. Shards are tars, so windows are SHARD-granular (a byte
+        # window could split a header): with --feed-window-bytes > 0 one
+        # shard is host-resident at a time; 0 materializes the volume.
+        yield from _webdataset_token_batches(
+            args, cfg, feeder, pub, list(req.webdataset.shard_urls))
         return
 
     if window <= 0:
@@ -197,39 +199,95 @@ def _cycle_token_batches(tokens_flat, cfg: TrainConfig, volume: str,
         yield {"tokens": tokens[idx]}
 
 
-def _webdataset_token_batches(args, cfg: TrainConfig, feeder, pub):
-    """Samples from a staged webdataset volume -> token batches.
-
-    The staged flat bytes are a (concatenated) tar stream; the tar index
-    (data/webdataset.py) groups members into samples, and each sample's
-    --wds-ext payload holds raw int32 tokens. Sample order is shard order.
-    """
+def _wds_tokens(shard, ext: str, volume: str) -> np.ndarray:
+    """Token payloads of one (or a concatenation of) tar shard(s)."""
     from oim_tpu.data import webdataset as wds
 
-    data = np.asarray(pub.array) if pub.array is not None else feeder.fetch(
-        args.volume, timeout=args.publish_timeout)
-    ext = getattr(args, "wds_ext", "bin")
-    payloads = [
-        s[ext] for s in wds.iter_samples([np.asarray(data)]) if ext in s
-    ]
+    payloads = [s[ext] for s in wds.iter_samples([np.asarray(shard)]) if ext in s]
     if not payloads:
-        raise SystemExit(
-            f"webdataset volume {args.volume!r} has no samples with "
-            f"extension {ext!r}"
-        )
+        return np.zeros((0,), np.int32)
     blob = b"".join(payloads)
     if len(blob) % 4:
         raise SystemExit(
-            f"webdataset volume {args.volume!r}: payloads under extension "
+            f"webdataset volume {volume!r}: payloads under extension "
             f"{ext!r} total {len(blob)} bytes — not int32-aligned; is "
             f"--wds-ext pointing at the token member?"
         )
-    tokens = np.frombuffer(blob, dtype=np.int32)
+    return np.frombuffer(blob, dtype=np.int32)
+
+
+def _webdataset_token_batches(args, cfg: TrainConfig, feeder, pub, urls):
+    """Samples from a staged webdataset volume -> token batches.
+
+    The staged flat bytes are shards laid back to back; the tar index
+    (data/webdataset.py) groups members into samples, and each sample's
+    --wds-ext payload holds raw int32 tokens. Sample order is shard order.
+
+    Streaming mode (feed_window_bytes > 0, the default): shard boundaries
+    are recomputed from the request's URLs and one shard is fetched
+    host-side at a time through the ReadVolume data window — the host
+    working set is one shard, not the dataset. Whole-volume mode
+    (--feed-window-bytes 0) materializes everything and supports --shuffle.
+    """
+    ext = getattr(args, "wds_ext", "bin")
+    window = getattr(args, "feed_window_bytes", 0)
+    span = cfg.seq_len + 1
+
+    if window <= 0:
+        data = (np.asarray(pub.array) if pub.array is not None
+                else feeder.fetch(args.volume, timeout=args.publish_timeout))
+        tokens = _wds_tokens(data, ext, args.volume)
+        if tokens.size == 0:
+            raise SystemExit(
+                f"webdataset volume {args.volume!r} has no samples with "
+                f"extension {ext!r}"
+            )
+        from_context().info(
+            "webdataset volume published", volume=args.volume,
+            tokens=tokens.size,
+        )
+        yield from _cycle_token_batches(
+            tokens, cfg, args.volume, _shuffle_seed(args))
+        return
+
+    from oim_tpu.data import webdataset as wds
+
+    sizes = wds.shard_sizes(urls)
+    offsets = np.concatenate([[0], np.cumsum(sizes)])
     from_context().info(
-        "webdataset volume published", volume=args.volume,
-        samples=len(payloads), tokens=tokens.size,
+        "webdataset streaming feed", volume=args.volume, shards=len(urls),
+        max_shard_bytes=int(max(sizes)),
     )
-    yield from _cycle_token_batches(tokens, cfg, args.volume, _shuffle_seed(args))
+    carry = np.zeros((0,), np.int32)
+    rows = np.zeros((0, span), np.int32)
+    produced = False
+    while True:
+        for i, size in enumerate(sizes):
+            shard, _, _ = feeder.fetch_window(
+                args.volume, int(offsets[i]), int(size),
+                timeout=args.publish_timeout,
+            )
+            toks = _wds_tokens(shard, ext, args.volume)
+            if toks.size:
+                carry = np.concatenate([carry, toks])
+                n = (carry.size // span) * span
+                if n:
+                    rows = np.concatenate(
+                        [rows, carry[:n].reshape(-1, span)])
+                    carry = carry[n:]
+            while rows.shape[0] >= cfg.batch_size:
+                produced = True
+                yield {"tokens": rows[:cfg.batch_size]}
+                rows = rows[cfg.batch_size:]
+        if not produced:
+            raise SystemExit(
+                f"webdataset volume {args.volume!r}: one full pass over "
+                f"{len(urls)} shards produced no {ext!r} token batches"
+            )
+        # Epoch wrap: drop the partial-record token tail so every epoch
+        # frames rows identically (whole-volume mode truncates once up
+        # front; without this the tail would shift all framing each epoch).
+        carry = carry[:0]
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -259,9 +317,14 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--checkpoint-every", type=int, default=0)
     parser.add_argument("--eval-every", type=int, default=0,
-                        help="run a forward-only eval pass every N steps")
+                        help="run a forward-only eval pass every N steps "
+                             "(real feeds need --eval-volume-file; "
+                             "synthetic runs get a held-out stream)")
     parser.add_argument("--eval-steps", type=int, default=8,
                         help="batches per eval pass")
+    parser.add_argument("--eval-volume-file", default="",
+                        help="held-out volume staged as '<volume>-eval' "
+                             "and used for --eval-every in feeder mode")
     parser.add_argument("--metrics-port", type=int, default=-1,
                         help=">=0 serves GET /metrics (0 = ephemeral port)")
     parser.add_argument("--smoke", action="store_true",
@@ -351,6 +414,7 @@ def main(argv: list[str] | None = None) -> int:
         log.info("metrics", port=server.port)
 
     data = None
+    eval_data = None
     if args.registry:
         tls = load_tls_flags(args)
         if args.expected_hosts > 1:
@@ -361,6 +425,16 @@ def main(argv: list[str] | None = None) -> int:
             )
             log.info("distributed", process_id=pid, num_processes=n)
         data = feeder_batches(args, cfg, tls)
+        if args.eval_every and args.eval_volume_file:
+            eval_args = argparse.Namespace(**{
+                **vars(args),
+                "volume": f"{args.volume}-eval",
+                "volume_file": args.eval_volume_file,
+                "volume_webdataset": "",
+                "feed_window_bytes": 0,
+                "shuffle": False,
+            })
+            eval_data = feeder_batches(eval_args, cfg, tls)
     elif not args.synthetic:
         args.synthetic = True
     if args.augment:
@@ -381,7 +455,7 @@ def main(argv: list[str] | None = None) -> int:
 
     trainer = Trainer(cfg, axes=parse_mesh(args.mesh))
     with profile_trace(args.profile):
-        loss = trainer.run(steps=args.steps, data=data)
+        loss = trainer.run(steps=args.steps, data=data, eval_data=eval_data)
     log.info("done", final_loss=round(loss, 4))
     if server is not None:
         server.stop()

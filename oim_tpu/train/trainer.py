@@ -441,12 +441,22 @@ class Trainer:
         return out
 
     def evaluate(self, data: Iterator[dict], n_batches: int | None = None) -> float:
-        """Forward-only mean loss over n_batches (inference-mode model)."""
+        """Forward-only mean loss over n_batches (inference-mode model).
+        A finite iterator that runs dry mid-pass ends the pass (mean over
+        what ran) instead of crashing training."""
         n = n_batches or self.cfg.eval_steps
-        total = 0.0
+        total, ran = 0.0, 0
         for _ in range(n):
-            total += float(self.eval_fn(self.state, self.place_batch(next(data))))
-        loss = total / max(n, 1)
+            try:
+                batch = next(data)
+            except StopIteration:
+                from_context().warning(
+                    "eval data exhausted mid-pass", batches_run=ran
+                )
+                break
+            total += float(self.eval_fn(self.state, self.place_batch(batch)))
+            ran += 1
+        loss = total / max(ran, 1)
         M.EVAL_LOSS.set(loss)
         return loss
 

@@ -204,7 +204,8 @@ class TestReadmeQuickstart:
         )
         assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-3000:]
         combined = out.stdout + out.stderr
-        assert "webdataset volume published" in combined
+        # Default window > 0 -> the shard-streaming feed.
+        assert "webdataset streaming feed" in combined
         assert "done" in combined
 
     def test_soft_state_reregistration_across_processes(self, cluster):
