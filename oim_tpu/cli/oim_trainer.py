@@ -261,12 +261,25 @@ def _webdataset_token_batches(args, cfg: TrainConfig, feeder, pub, urls):
     carry = np.zeros((0,), np.int32)
     rows = np.zeros((0, span), np.int32)
     produced = False
+    checked = False
     while True:
         for i, size in enumerate(sizes):
-            shard, _, _ = feeder.fetch_window(
+            shard, total, _ = feeder.fetch_window(
                 args.volume, int(offsets[i]), int(size),
                 timeout=args.publish_timeout,
             )
+            if not checked:
+                # Offsets were recomputed from the URLs at feed time; if a
+                # shard changed size since staging the layout no longer
+                # matches and windows would slice mid-tar — fail with the
+                # real cause instead of a tar-parse error later.
+                if int(offsets[-1]) != int(total):
+                    raise SystemExit(
+                        f"webdataset volume {args.volume!r}: staged volume "
+                        f"is {total} bytes but the shard URLs now sum to "
+                        f"{int(offsets[-1])} — shards changed since staging?"
+                    )
+                checked = True
             toks = _wds_tokens(shard, ext, args.volume)
             if toks.size:
                 carry = np.concatenate([carry, toks])

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from oim_tpu.ops.attention import attention as default_attention
-from oim_tpu.ops.losses import softmax_cross_entropy
+from oim_tpu.ops.losses import chunked_softmax_cross_entropy, softmax_cross_entropy
 from oim_tpu.ops.norms import rmsnorm
 from oim_tpu.ops.rope import apply_rope, rope_frequencies
 from oim_tpu.parallel.sharding import EMBED, HEAD, KV_HEAD, LAYER, MLP, VOCAB
@@ -56,6 +56,10 @@ class Config:
     # activation memory — what makes 8B-class configs at long context fit
     # in HBM (SURVEY's "trade FLOPs for memory" lever).
     remat: bool = False
+    # vocab_chunk > 0 computes the training loss without materializing the
+    # [B, T, vocab] logits (ops/losses.py chunked_softmax_cross_entropy) —
+    # at 128k vocab that tensor is the step's biggest activation.
+    vocab_chunk: int = 0
 
     @property
     def moe(self):
@@ -76,7 +80,7 @@ class Config:
         return self.n_kv_heads * self.head_dim
 
 
-LLAMA3_8B = Config()
+LLAMA3_8B = Config(vocab_chunk=16384)  # 128k-vocab logits never materialize
 
 
 def tiny(vocab: int = 256, dim: int = 64, n_layers: int = 2,
@@ -187,10 +191,9 @@ def _layer(x, layer, cfg: Config, cos, sin, attn_fn: AttentionFn):
     return x + ffn, aux
 
 
-def apply(params, tokens, cfg: Config = LLAMA3_8B,
-          attn_fn: AttentionFn | None = None, return_aux: bool = False):
-    """tokens: [B, T] int32. Returns logits [B, T, vocab] float32 (and the
-    summed MoE load-balance aux loss when return_aux)."""
+def hidden_states(params, tokens, cfg: Config = LLAMA3_8B,
+                  attn_fn: AttentionFn | None = None):
+    """tokens [B, T] -> (final-normed hidden [B, T, D], summed MoE aux)."""
     if attn_fn is None:
         attn_fn = default_attention
     T = tokens.shape[1]
@@ -205,19 +208,37 @@ def apply(params, tokens, cfg: Config = LLAMA3_8B,
         # prevent_cse=False: unnecessary (and costly) inside a scan body.
         body = jax.checkpoint(body, prevent_cse=False)
     x, aux = lax.scan(body, x, params["layers"])
-    x = rmsnorm(x, params["final_norm"])
+    return rmsnorm(x, params["final_norm"]), jnp.sum(aux)
+
+
+def apply(params, tokens, cfg: Config = LLAMA3_8B,
+          attn_fn: AttentionFn | None = None, return_aux: bool = False):
+    """tokens: [B, T] int32. Returns logits [B, T, vocab] float32 (and the
+    summed MoE load-balance aux loss when return_aux)."""
+    x, aux = hidden_states(params, tokens, cfg, attn_fn)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     if return_aux:
-        return logits, jnp.sum(aux)
+        return logits, aux
     return logits
 
 
 def loss_fn(params, tokens, cfg: Config = LLAMA3_8B,
             attn_fn: AttentionFn | None = None,
             ignore_index: int = -1):
-    """Next-token cross entropy (+ weighted MoE aux loss); tokens [B, T+1]."""
-    logits, aux = apply(params, tokens[:, :-1], cfg, attn_fn, return_aux=True)
-    loss = softmax_cross_entropy(logits, tokens[:, 1:], ignore_index)
+    """Next-token cross entropy (+ weighted MoE aux loss); tokens [B, T+1].
+
+    With cfg.vocab_chunk the CE comes straight from the hidden states via
+    the vocab-chunked logsumexp — the [B, T, vocab] logits never exist.
+    """
+    if cfg.vocab_chunk:
+        x, aux = hidden_states(params, tokens[:, :-1], cfg, attn_fn)
+        loss = chunked_softmax_cross_entropy(
+            x, params["lm_head"], tokens[:, 1:], cfg.vocab_chunk, ignore_index
+        )
+    else:
+        logits, aux = apply(params, tokens[:, :-1], cfg, attn_fn,
+                            return_aux=True)
+        loss = softmax_cross_entropy(logits, tokens[:, 1:], ignore_index)
     if cfg.n_experts:
         loss = loss + cfg.moe_aux_weight * aux
     return loss

@@ -30,3 +30,111 @@ def softmax_cross_entropy(logits, labels, ignore_index: int | None = None):
 
 def accuracy(logits, labels):
     return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+
+
+def chunked_softmax_cross_entropy(
+    x, lm_head, labels, vocab_chunk: int, ignore_index: int | None = None
+):
+    """CE straight from hidden states, never materializing [N, vocab].
+
+    At 128k vocab (llama3) the full logits tensor is the single biggest
+    activation of the train step (~4GB f32 for batch 4 x 8k tokens); this
+    computes the same mean CE by scanning vocab CHUNKS: each chunk's
+    logits ([N, C]) exist only transiently while an online logsumexp and
+    the label logits accumulate. The backward pass recomputes each chunk's
+    logits from the saved (small) residuals — the remat idea applied to
+    the vocabulary dimension.
+
+    x: [..., D] final hidden states (post final-norm);
+    lm_head: [D, V]; labels: [...] int32. Returns the scalar mean CE.
+    A vocab that isn't a multiple of vocab_chunk is zero-padded to the next
+    chunk boundary; padded columns are masked out of the logsumexp.
+    """
+    d = x.shape[-1]
+    vocab = lm_head.shape[-1]
+    xf = x.reshape(-1, d)
+    yf = labels.reshape(-1)
+    n = xf.shape[0]
+    n_chunks = -(-vocab // vocab_chunk)
+    pad = n_chunks * vocab_chunk - vocab
+    if pad:
+        lm_head = jnp.pad(lm_head, ((0, 0), (0, pad)))
+    w = lm_head.reshape(d, n_chunks, vocab_chunk).transpose(1, 0, 2)
+
+    def scan_stats(x2, w_chunks):
+        """Online (max, sumexp, label-logit) over vocab chunks."""
+
+        def body(carry, inp):
+            m, s, lab = carry
+            w_c, idx = inp
+            logits = (x2 @ w_c).astype(jnp.float32)  # [N, C]
+            cols_valid = idx * vocab_chunk + jnp.arange(vocab_chunk) < vocab
+            logits = jnp.where(cols_valid[None, :], logits, -jnp.inf)
+            cmax = jnp.max(logits, axis=-1)
+            m_new = jnp.maximum(m, cmax)
+            s = s * jnp.exp(m - m_new) + jnp.sum(
+                jnp.exp(logits - m_new[:, None]), axis=-1
+            )
+            local = yf - idx * vocab_chunk
+            hit = (local >= 0) & (local < vocab_chunk)
+            picked = jnp.take_along_axis(
+                logits, jnp.clip(local, 0, vocab_chunk - 1)[:, None], axis=-1
+            )[:, 0]
+            lab = jnp.where(hit, picked, lab)
+            return (m_new, s, lab), None
+
+        init = (
+            jnp.full((n,), -jnp.inf, jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+        )
+        (m, s, lab), _ = jax.lax.scan(
+            body, init, (w_chunks, jnp.arange(n_chunks))
+        )
+        return m, s, lab
+
+    @jax.custom_vjp
+    def nll_fn(xf, w):
+        m, s, lab = scan_stats(xf, w)
+        return jnp.log(s) + m - lab
+
+    def nll_fwd(xf, w):
+        m, s, lab = scan_stats(xf, w)
+        return jnp.log(s) + m - lab, (xf, w, m, s)
+
+    def nll_bwd(res, g):
+        xf, w, m, s = res
+        # d nll / d logits_c = softmax_c - onehot_c; chunk logits are
+        # recomputed, gradients accumulate chunk by chunk (dx in f32 — a
+        # low-precision accumulator would drift over many chunks).
+
+        def body(dx, inp):
+            w_c, idx = inp
+            logits = (xf @ w_c).astype(jnp.float32)
+            cols_valid = idx * vocab_chunk + jnp.arange(vocab_chunk) < vocab
+            logits = jnp.where(cols_valid[None, :], logits, -jnp.inf)
+            p = jnp.exp(logits - m[:, None]) / s[:, None]
+            local = yf - idx * vocab_chunk
+            hit = (local >= 0) & (local < vocab_chunk)
+            onehot = (
+                (jnp.clip(local, 0, vocab_chunk - 1)[:, None]
+                 == jnp.arange(vocab_chunk)[None, :])
+                & hit[:, None]
+            ).astype(jnp.float32)
+            dlogits = ((p - onehot) * g[:, None]).astype(xf.dtype)
+            dx = dx + (dlogits @ w_c.T).astype(jnp.float32)
+            dw = xf.T @ dlogits
+            return dx, dw
+
+        dx, dw = jax.lax.scan(
+            body, jnp.zeros(xf.shape, jnp.float32), (w, jnp.arange(n_chunks))
+        )
+        return dx.astype(xf.dtype), dw
+
+    nll_fn.defvjp(nll_fwd, nll_bwd)
+
+    nll = nll_fn(xf, w)
+    if ignore_index is not None:
+        mask = (yf != ignore_index).astype(jnp.float32)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)

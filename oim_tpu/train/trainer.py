@@ -456,7 +456,11 @@ class Trainer:
                 break
             total += float(self.eval_fn(self.state, self.place_batch(batch)))
             ran += 1
-        loss = total / max(ran, 1)
+        if ran == 0:
+            # Zero batches is not a perfect loss: don't touch the gauge,
+            # don't return a plausible-looking 0.0.
+            return float("nan")
+        loss = total / ran
         M.EVAL_LOSS.set(loss)
         return loss
 

@@ -226,3 +226,15 @@ class TestGenerate:
         expected = gen.generate(params, prompt, 5, cfg)
         got = gen.generate(placed, prompt, 5, cfg)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+
+
+def test_llama3_8b_flagship_loss_traces():
+    """The flagship config's loss must TRACE cleanly (eval_shape: no
+    allocation) — regression for vocab_chunk not dividing the 128256
+    vocab, which crashed every llama3-8b step at trace time."""
+    cfg = llama.LLAMA3_8B
+    assert cfg.vocab_chunk > 0  # the chunked-CE path is the default at 8B
+    params = jax.eval_shape(lambda: llama.init(jax.random.PRNGKey(0), cfg))
+    tokens = jax.ShapeDtypeStruct((2, 129), jnp.int32)
+    out = jax.eval_shape(lambda p, t: llama.loss_fn(p, t, cfg), params, tokens)
+    assert out.shape == () and out.dtype == jnp.float32
