@@ -127,18 +127,30 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *,
         lse_ref[0] = (m_ref[:, 0] + jnp.log(l))[:, None]
 
 
+def _kv_row_map(h: int, hkv: int):
+    """Grid row (b*h + q_head) -> K/V row (b*hkv + q_head // group): GQA is
+    an index-map concern, not a data-movement one — the kv-head shard is
+    READ by every q head of its group and never materialized per-q-head."""
+    group = h // hkv
+    return lambda bh: (bh // h) * hkv + (bh % h) // group
+
+
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
-    """Returns (out [B,T,H,D], lse [B*H, Tq] f32)."""
+    """Returns (out [B,T,H,D], lse [B*H, Tq] f32). K/V may carry fewer
+    (GQA) heads than q; they are consumed in place via the index map."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     b, tq, h, d = q.shape
-    tk = k.shape[1]
+    tk, hkv = k.shape[1], k.shape[2]
+    if h % hkv:
+        raise ValueError(f"q heads {h} not divisible by kv heads {hkv}")
     # Kernel works in [B*H, T, D] layout: heads become grid rows and every
     # block is a clean (T_block, d) tile for the MXU.
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, tk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, tk, d)
+    kv_row = _kv_row_map(h, hkv)
 
     block_q = min(block_q, tq)
     block_k = min(block_k, tk)
@@ -155,8 +167,10 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, kj: (kv_row(bh), kj, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda bh, qi, kj: (kv_row(bh), kj, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
@@ -300,10 +314,12 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     from jax.experimental.pallas import tpu as pltpu
 
     b, tq, h, d = q.shape
-    tk = k.shape[1]
+    tk, hkv = k.shape[1], k.shape[2]
+    group = h // hkv
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, tk, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * hkv, tk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * hkv, tk, d)
+    kv_row = _kv_row_map(h, hkv)
     dot = g.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
     ot = out.transpose(0, 2, 1, 3).reshape(b * h, tq, d)
     # delta_i = rowsum(dO_i * O_i): the softmax-normalization term of dS.
@@ -317,8 +333,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
 
     in_specs_kmajor = [
         pl.BlockSpec((1, block_q, d), lambda bh, kj, qi: (bh, qi, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (bh, kj, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (kv_row(bh), kj, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, kj, qi: (kv_row(bh), kj, 0)),
         pl.BlockSpec((1, block_q, d), lambda bh, kj, qi: (bh, qi, 0)),
         pl.BlockSpec((1, block_q, 1), lambda bh, kj, qi: (bh, qi, 0)),
         pl.BlockSpec((1, block_q, 1), lambda bh, kj, qi: (bh, qi, 0)),
@@ -344,11 +360,19 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
         ],
         interpret=interpret,
     )(qt, kt, vt, dot, lse, delta)
+    if group > 1:
+        # dk/dv came out PER Q HEAD (each grid row writes only its own
+        # block — no cross-row write races); the kv-head gradient is the
+        # sum over its group, the vjp of the implicit GQA broadcast.
+        dk = dk.reshape(b, hkv, group, tk, d).sum(axis=2).reshape(
+            b * hkv, tk, d)
+        dv = dv.reshape(b, hkv, group, tk, d).sum(axis=2).reshape(
+            b * hkv, tk, d)
 
     in_specs_qmajor = [
         pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (bh, kj, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (kv_row(bh), kj, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh, qi, kj: (kv_row(bh), kj, 0)),
         pl.BlockSpec((1, block_q, d), lambda bh, qi, kj: (bh, qi, 0)),
         pl.BlockSpec((1, block_q, 1), lambda bh, qi, kj: (bh, qi, 0)),
         pl.BlockSpec((1, block_q, 1), lambda bh, qi, kj: (bh, qi, 0)),
@@ -366,8 +390,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
         interpret=interpret,
     )(qt, kt, vt, dot, lse, delta)
 
-    unflat = lambda x, t: x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
-    return unflat(dq, tq), unflat(dk, tk), unflat(dv, tk)
+    unflat = lambda x, hh, t: x.reshape(b, hh, t, d).transpose(0, 2, 1, 3)
+    return unflat(dq, h, tq), unflat(dk, hkv, tk), unflat(dv, hkv, tk)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
@@ -379,8 +403,9 @@ def flash_attention(
     block_k: int = 512,
     interpret: bool = False,
 ):
-    """Pallas flash attention. Requires q/kv head counts equal (expand GQA
-    first) and seq lengths divisible by the block sizes."""
+    """Pallas flash attention. GQA-native: kv heads may divide q heads (the
+    kv shard is routed to its query group by the block index map — never
+    expanded in HBM). Seq lengths must be divisible by the block sizes."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
@@ -412,8 +437,7 @@ def attention(q, k, v, causal: bool = True, scale: float | None = None):
     tq, tk = q.shape[1], k.shape[1]
     d = q.shape[-1]
     aligned = tq % 128 == 0 and tk % 128 == 0 and d % 128 == 0
-    if on_tpu and aligned:
-        k, v = _expand_gqa(q, k, v)
+    if on_tpu and aligned and q.shape[2] % k.shape[2] == 0:
         bq = 512 if tq % 512 == 0 else 128
         bk = 512 if tk % 512 == 0 else 128
         return flash_attention(q, k, v, causal, scale, bq, bk)

@@ -278,3 +278,28 @@ class TestChunkedCrossEntropy:
         np.testing.assert_allclose(
             np.asarray(gc["embed"]), np.asarray(g["embed"]), atol=2e-5
         )
+
+
+@pytest.mark.parametrize("hkv", [1, 2])
+def test_flash_gqa_native_forward_and_backward(hkv):
+    """GQA-native flash: kv heads ride the block index map (never expanded
+    in HBM); fwd AND all three grads must match the reference, whose GQA
+    path is an explicit jnp.repeat."""
+    q, k, v = _qkv(b=2, t=128, h=4, hkv=hkv, d=32, seed=7)
+    g = jnp.asarray(np.random.RandomState(8).randn(*q.shape), q.dtype)
+
+    ref = mha_reference(q, k, v, True)
+    out = flash_attention(q, k, v, True, None, 64, 64, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4)
+
+    _, vjp_ref = jax.vjp(lambda q, k, v: mha_reference(q, k, v, True), q, k, v)
+    _, vjp_fl = jax.vjp(
+        lambda q, k, v: flash_attention(q, k, v, True, None, 64, 64, True),
+        q, k, v,
+    )
+    for a, b, name in zip(vjp_fl(g), vjp_ref(g), "qkv"):
+        assert a.shape == b.shape, f"d{name} shape {a.shape} vs {b.shape}"
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-4,
+            err_msg=f"d{name} mismatch (GQA hkv={hkv})",
+        )
