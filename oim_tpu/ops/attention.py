@@ -41,6 +41,38 @@ def _expand_gqa(q, k, v):
     return k, v
 
 
+def ref_attention_lse(q, k, v, causal: bool = True, scale: float | None = None):
+    """GQA-native jnp attention returning ``(out f32, lse [B,Tq,H] f32)``.
+
+    The merge interface for ring attention (oim_tpu/parallel/ring.py): two
+    blocks' normalized outputs combine exactly via their logsumexps. K/V are
+    consumed at kv-head width — queries are grouped, K/V never repeat.
+    """
+    b, tq, h, d = q.shape
+    tk, hkv = k.shape[1], k.shape[2]
+    if h % hkv:
+        raise ValueError(f"q heads {h} not divisible by kv heads {hkv}")
+    group = h // hkv
+    if scale is None:
+        scale = d ** -0.5
+    qg = q.reshape(b, tq, hkv, group, d)
+    scores = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
+    ) * scale  # [B, hkv, group, Tq, Tk]
+    if causal:
+        q_pos = (tk - tq) + jnp.arange(tq)
+        mask = q_pos[:, None] >= jnp.arange(tk)[None, :]
+        scores = jnp.where(mask[None, None, None], scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)
+    p = jnp.exp(scores - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    lse = m + jnp.log(l)
+    out = jnp.einsum(
+        "bhgqk,bkhd->bqhgd", p / l[..., None], v.astype(jnp.float32)
+    ).reshape(b, tq, h, d)
+    return out, lse.transpose(0, 3, 1, 2).reshape(b, tq, h)
+
+
 def mha_reference(q, k, v, causal: bool = True, scale: float | None = None):
     """Plain jnp attention; the numerical ground truth for the kernels."""
     k, v = _expand_gqa(q, k, v)
@@ -65,7 +97,8 @@ def mha_reference(q, k, v, causal: bool = True, scale: float | None = None):
 # ---------------------------------------------------------------- pallas ----
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k, q_offset):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                  *, scale, causal, block_q, block_k, q_offset):
     """One (q-block, k-block) cell; innermost grid dim walks k blocks
     sequentially so the VMEM scratch (acc/m/l) carries across them."""
     import jax.numpy as jnp
@@ -309,7 +342,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
-                    interpret):
+                    interpret, g_lse=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -326,6 +359,11 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     delta = jnp.sum(
         dot.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1, keepdims=True
     )
+    if g_lse is not None:
+        # lse is also a primal output (flash_attention_lse): d lse_i/d s_ij
+        # = p_ij, so the lse cotangent adds g_lse_i * p_ij to dS — folded
+        # into delta since dS = P * (dP - delta + g_lse).
+        delta = delta - g_lse.astype(jnp.float32)
 
     block_q = min(block_q, tq)
     block_k = min(block_k, tk)
@@ -405,7 +443,14 @@ def flash_attention(
 ):
     """Pallas flash attention. GQA-native: kv heads may divide q heads (the
     kv shard is routed to its query group by the block index map — never
-    expanded in HBM). Seq lengths must be divisible by the block sizes."""
+    expanded in HBM). Seq lengths must be divisible by the block sizes.
+
+    GQA memory caveat: the FORWARD never expands K/V; the backward's dK/dV
+    transiently come out per-q-head ([B*H, Tk, D]) before the group sum
+    (each grid row writes only its own block — no cross-row write races),
+    so peak bwd memory scales with q heads. Accumulating the group sum
+    inside the kernel grid would remove this at the cost of racing writes
+    or an extra sequential grid dim."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
@@ -431,14 +476,86 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _lse_bth(lse, b, h, tq):
+    """[B*H, Tq, 1] kernel layout -> [B, Tq, H]."""
+    return lse.reshape(b, h, tq).transpose(0, 2, 1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_lse(
+    q, k, v,
+    causal: bool = True,
+    scale: float | None = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+):
+    """Flash attention that also returns the per-row logsumexp [B, Tq, H].
+
+    Both outputs are differentiable: the lse cotangent is folded into the
+    backward kernels' delta term. This is the TPU block primitive for ring
+    attention — per-ring-step (out, lse) pairs merge exactly downstream.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    b, tq, h, _ = q.shape
+    return out, _lse_bth(lse, b, h, tq)
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    b, tq, h, _ = q.shape
+    return (out, _lse_bth(lse, b, h, tq)), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v, out, lse = res
+    g_out, g_lse = g
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    b, tq, h, _ = q.shape
+    g_lse_flat = g_lse.transpose(0, 2, 1).reshape(b * h, tq, 1)
+    return _flash_backward(
+        q, k, v, out, lse, g_out, causal, scale, block_q, block_k, interpret,
+        g_lse=g_lse_flat,
+    )
+
+
+flash_attention_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def _flash_plan(q, k) -> tuple[int, int] | None:
+    """(block_q, block_k) when the pallas kernels apply to these shapes on
+    this backend, else None — THE dispatch rule, shared by every entry
+    point so they cannot drift apart."""
+    if jax.default_backend() != "tpu":
+        return None
+    tq, tk, d = q.shape[1], k.shape[1], q.shape[-1]
+    if tq % 128 or tk % 128 or d % 128 or q.shape[2] % k.shape[2]:
+        return None
+    return (512 if tq % 512 == 0 else 128, 512 if tk % 512 == 0 else 128)
+
+
+def attention_with_lse(q, k, v, causal: bool = True, scale: float | None = None):
+    """Dispatching block attention returning ``(out f32, lse [B,Tq,H] f32)``.
+
+    Pallas flash on TPU when block-aligned (GQA-native via the kv-row index
+    map), GQA-native jnp reference otherwise. The (out, lse) pair is the
+    mergeable unit ring attention accumulates across ring steps.
+    """
+    plan = _flash_plan(q, k)
+    if plan is not None:
+        out, lse = flash_attention_lse(q, k, v, causal, scale, *plan)
+        return out.astype(jnp.float32), lse
+    return ref_attention_lse(q, k, v, causal, scale)
+
+
 def attention(q, k, v, causal: bool = True, scale: float | None = None):
     """Dispatch: pallas flash on TPU when block-aligned, reference otherwise."""
-    on_tpu = jax.default_backend() == "tpu"
-    tq, tk = q.shape[1], k.shape[1]
-    d = q.shape[-1]
-    aligned = tq % 128 == 0 and tk % 128 == 0 and d % 128 == 0
-    if on_tpu and aligned and q.shape[2] % k.shape[2] == 0:
-        bq = 512 if tq % 512 == 0 else 128
-        bk = 512 if tk % 512 == 0 else 128
-        return flash_attention(q, k, v, causal, scale, bq, bk)
+    plan = _flash_plan(q, k)
+    if plan is not None:
+        return flash_attention(q, k, v, causal, scale, *plan)
     return mha_reference(q, k, v, causal, scale)

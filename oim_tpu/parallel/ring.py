@@ -33,72 +33,65 @@ from jax import lax
 NEG_INF = -1e30
 
 
-def _block_accum(q, k, v, o, m, l, q_off, k_off, causal, scale):
-    """One online-softmax accumulation step.
-
-    q: [B, Tq, H, D]; k, v: [B, Tk, H, D]
-    o: [B, Tq, H, D] f32 numerator; m, l: [B, Tq, H] f32 running max / denom.
-    """
-    scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * scale  # [B, H, Tq, Tk]
-    if causal:
-        q_pos = q_off + jnp.arange(q.shape[1])
-        k_pos = k_off + jnp.arange(k.shape[1])
-        mask = q_pos[:, None] >= k_pos[None, :]  # [Tq, Tk]
-        scores = jnp.where(mask[None, None], scores, NEG_INF)
-    block_max = jnp.max(scores, axis=-1)  # [B, H, Tq]
-    m_bhq = jnp.moveaxis(m, -1, 1)  # [B, H, Tq]
-    m_new = jnp.maximum(m_bhq, block_max)
-    p = jnp.exp(scores - m_new[..., None])
-    if causal:
-        p = jnp.where(mask[None, None], p, 0.0)
-    correction = jnp.exp(m_bhq - m_new)  # [B, H, Tq]
-    l_new = jnp.moveaxis(l, -1, 1) * correction + jnp.sum(p, axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
-    o_new = o * jnp.moveaxis(correction, 1, -1)[..., None] + pv
-    return o_new, jnp.moveaxis(m_new, 1, -1), jnp.moveaxis(l_new, 1, -1)
-
-
 def ring_attention(q, k, v, axis_name: str, causal: bool = True):
     """Ring attention over the ``axis_name`` mesh axis.
 
     Must run inside shard_map/jit with ``axis_name`` bound; q/k/v are the
-    local sequence shards [B, T_local, H, D]. Returns [B, T_local, H, D] in
-    q's dtype.
+    local sequence shards [B, T_local, H, D] (K/V at kv-head width — GQA is
+    never expanded; the flash kernel routes kv heads via its index map).
+    Returns [B, T_local, H, D] in q's dtype.
+
+    Each ring step runs the full flash-attention block kernel
+    (oim_tpu/ops/attention.py) on the currently-held K/V shard and merges
+    the resulting (out, lse) pair into the running accumulator — the exact
+    blockwise-softmax merge, so HBM traffic per chip stays at flash level
+    (no [T_local, T_local] score materialization). Under the causal mask a
+    K/V shard is either fully visible (src < my: unmasked kernel), the
+    diagonal (src == my: causal kernel), or fully hidden (src > my:
+    skipped via a zero/NEG_INF neutral element).
     """
-    from oim_tpu.ops.attention import _expand_gqa
+    from oim_tpu.ops.attention import attention_with_lse
     from oim_tpu.parallel.collectives import ppermute_ring
 
-    k, v = _expand_gqa(q, k, v)
     size = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
-    t_local = q.shape[1]
     scale = q.shape[-1] ** -0.5
+    b, t_local, h, _ = q.shape
+
+    def diag(q, k, v):
+        return attention_with_lse(q, k, v, causal=True, scale=scale)
+
+    def full(q, k, v):
+        return attention_with_lse(q, k, v, causal=False, scale=scale)
+
+    def skip(q, k, v):
+        return (jnp.zeros(q.shape, jnp.float32),
+                jnp.full((b, t_local, h), NEG_INF, jnp.float32))
 
     o0 = jnp.zeros(q.shape, jnp.float32)
-    m0 = jnp.full(q.shape[:3], NEG_INF, jnp.float32)  # [B, Tq, H]
-    l0 = jnp.zeros(q.shape[:3], jnp.float32)
+    lse0 = jnp.full((b, t_local, h), NEG_INF, jnp.float32)
 
     def step(carry, i):
-        o, m, l, k_cur, v_cur = carry
+        o, lse, k_cur, v_cur = carry
         # Rotate first: the sends depend only on k_cur/v_cur, so XLA overlaps
-        # them with the block matmuls below.
+        # them with the block kernel below.
         k_next = ppermute_ring(k_cur, axis_name)
         v_next = ppermute_ring(v_cur, axis_name)
         src = (my - i) % size  # whose K/V shard we currently hold
-        o, m, l = _block_accum(
-            q, k_cur, v_cur, o, m, l,
-            q_off=my * t_local, k_off=src * t_local,
-            causal=causal, scale=scale,
-        )
-        return (o, m, l, k_next, v_next), None
+        if causal:
+            branch = jnp.where(src == my, 1, jnp.where(src < my, 2, 0))
+            o_blk, lse_blk = lax.switch(branch, [skip, diag, full], q, k_cur, v_cur)
+        else:
+            o_blk, lse_blk = full(q, k_cur, v_cur)
+        # Merge normalized block outputs through their logsumexps. NEG_INF is
+        # finite (-1e30), so the all-masked neutral element stays NaN-free.
+        lse_new = jnp.logaddexp(lse, lse_blk)
+        o = (o * jnp.exp(lse - lse_new)[..., None]
+             + o_blk * jnp.exp(lse_blk - lse_new)[..., None])
+        return (o, lse_new, k_next, v_next), None
 
-    (o, m, l, _, _), _ = lax.scan(
-        step, (o0, m0, l0, k, v), jnp.arange(size)
-    )
-    out = o / jnp.maximum(l, 1e-30)[..., None]
-    return out.astype(q.dtype)
+    (o, _, _, _), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(size))
+    return o.astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = True):
@@ -106,16 +99,24 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True):
 
     Swaps sharding seq->heads with one tiled all_to_all each way; local
     attention in between sees the full sequence for heads/size heads.
+
+    GQA-native when kv heads divide the axis size: K/V ride the all_to_all
+    at kv-head width and the local attention consumes them grouped (chip j
+    receives exactly the kv heads its query group needs — the head ranges
+    [j*H/s, (j+1)*H/s) and [j*Hkv/s, (j+1)*Hkv/s) align because H/Hkv
+    divides H/s). Only when Hkv does not divide the axis size do K/V fall
+    back to full expansion.
     """
     from oim_tpu.ops.attention import _expand_gqa
 
-    k, v = _expand_gqa(q, k, v)
     size = lax.psum(1, axis_name)  # concrete under shard_map
     if q.shape[2] % size:
         raise ValueError(
             f"ulysses needs heads ({q.shape[2]}) divisible by the "
             f"{axis_name!r} axis size ({size})"
         )
+    if k.shape[2] % size:
+        k, v = _expand_gqa(q, k, v)
 
     def seq_to_heads(x):  # [B, T/s, H, D] -> [B, T, H/s, D]
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)

@@ -303,3 +303,83 @@ def test_flash_gqa_native_forward_and_backward(hkv):
             np.asarray(a), np.asarray(b), atol=2e-4,
             err_msg=f"d{name} mismatch (GQA hkv={hkv})",
         )
+
+
+class TestAttentionWithLse:
+    """The (out, lse) block interface ring attention merges across steps."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("hkv", [4, 2, 1])
+    def test_ref_lse_matches_reference(self, causal, hkv):
+        from oim_tpu.ops.attention import ref_attention_lse
+
+        q, k, v = _qkv(t=64, h=4, hkv=hkv, seed=11)
+        out, lse = ref_attention_lse(q, k, v, causal=causal)
+        ref = mha_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+        # lse must equal logsumexp of the (scaled, masked) score rows.
+        scale = q.shape[-1] ** -0.5
+        from oim_tpu.ops.attention import _expand_gqa
+
+        ke, _ = _expand_gqa(q, k, v)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, ke) * scale
+        if causal:
+            t = q.shape[1]
+            mask = jnp.arange(t)[:, None] >= jnp.arange(t)[None, :]
+            scores = jnp.where(mask[None, None], scores, -1e30)
+        want = jax.nn.logsumexp(scores, axis=-1).transpose(0, 2, 1)  # [B,T,H]
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(want), atol=2e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("hkv", [4, 2])
+    def test_flash_lse_matches_ref_lse(self, causal, hkv):
+        from oim_tpu.ops.attention import flash_attention_lse, ref_attention_lse
+
+        q, k, v = _qkv(t=128, h=4, hkv=hkv, seed=12)
+        out_f, lse_f = flash_attention_lse(q, k, v, causal, None, 64, 64, True)
+        out_r, lse_r = ref_attention_lse(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_r), atol=2e-5)
+        np.testing.assert_allclose(np.asarray(lse_f), np.asarray(lse_r), atol=2e-5)
+
+    @pytest.mark.parametrize("hkv", [2, 4])
+    def test_flash_lse_vjp_including_lse_cotangent(self, hkv):
+        """Gradients must flow through BOTH outputs: a loss touching out and
+        lse (exactly what the ring-step merge does) must match the jnp path."""
+        from oim_tpu.ops.attention import flash_attention_lse, ref_attention_lse
+
+        q, k, v = _qkv(b=1, t=64, h=4, hkv=hkv, d=32, seed=13)
+
+        def loss(fn):
+            def run(q, k, v):
+                out, lse = fn(q, k, v)
+                return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+            return run
+
+        g_fl = jax.grad(
+            loss(lambda q, k, v: flash_attention_lse(q, k, v, True, None, 32, 32, True)),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        g_ref = jax.grad(
+            loss(lambda q, k, v: ref_attention_lse(q, k, v, causal=True)),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        for a, b, name in zip(g_fl, g_ref, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-4,
+                err_msg=f"d{name} mismatch with lse cotangent",
+            )
+
+    def test_two_block_merge_equals_full_attention(self):
+        """Splitting K/V in two and merging (out, lse) pairs — the exact ring
+        accumulation — must reproduce full attention."""
+        from oim_tpu.ops.attention import ref_attention_lse
+
+        q, k, v = _qkv(t=64, h=2, d=16, seed=14)
+        half = 32
+        o1, l1 = ref_attention_lse(q, k[:, :half], v[:, :half], causal=False)
+        o2, l2 = ref_attention_lse(q, k[:, half:], v[:, half:], causal=False)
+        lse = jnp.logaddexp(l1, l2)
+        merged = (o1 * jnp.exp(l1 - lse)[..., None]
+                  + o2 * jnp.exp(l2 - lse)[..., None])
+        ref = mha_reference(q, k, v, causal=False)
+        np.testing.assert_allclose(np.asarray(merged), np.asarray(ref), atol=2e-5)

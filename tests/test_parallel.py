@@ -124,3 +124,47 @@ def test_ring_attention_long_context_gradients():
     g_ring = jax.grad(lambda q: jnp.sum(ring(q, k, v) ** 2))(q)
     g_ref = jax.grad(lambda q: jnp.sum(mha_reference(q, k, v, True) ** 2))(q)
     np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref), atol=1e-4)
+
+
+@pytest.mark.parametrize("kind", ["ring", "ulysses"])
+@pytest.mark.parametrize("hkv", [2, 1])
+def test_sequence_parallel_attention_gqa(kind, hkv):
+    """GQA rides sequence parallelism without K/V head expansion: ring keeps
+    kv-width shards on the ring; ulysses all_to_alls them at kv width when
+    hkv divides the axis (hkv=2 falls back to expansion on a 4-wide axis)."""
+    mesh = build_mesh([("data", 2), ("seq", 4)])
+    rng = np.random.RandomState(3)
+    b, t, h, d = 2, 64, 4, 16
+    q = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, t, hkv, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, t, hkv, d), jnp.float32)
+    fn = make_sequence_parallel_attention(mesh, kind=kind, causal=True)
+    out = jax.jit(fn)(q, k, v)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_ring_attention_gqa_gradients():
+    mesh = build_mesh([("data", 1), ("seq", 4)])
+    rng = np.random.RandomState(5)
+    q = jnp.asarray(rng.randn(1, 64, 4, 8), jnp.float32)
+    k = jnp.asarray(rng.randn(1, 64, 2, 8), jnp.float32)
+    v = jnp.asarray(rng.randn(1, 64, 2, 8), jnp.float32)
+    ring = make_sequence_parallel_attention(mesh, kind="ring", causal=True)
+
+    g_ring = jax.grad(lambda k: jnp.sum(ring(q, k, v) ** 2))(k)
+    g_ref = jax.grad(lambda k: jnp.sum(mha_reference(q, k, v, True) ** 2))(k)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref), atol=1e-4)
+
+
+def test_ulysses_gqa_native_width():
+    # hkv divides the seq axis: K/V ride the all_to_all at kv width.
+    mesh = build_mesh([("data", 4), ("seq", 2)])
+    rng = np.random.RandomState(6)
+    q = jnp.asarray(rng.randn(4, 32, 4, 16), jnp.float32)
+    k = jnp.asarray(rng.randn(4, 32, 2, 16), jnp.float32)
+    v = jnp.asarray(rng.randn(4, 32, 2, 16), jnp.float32)
+    fn = make_sequence_parallel_attention(mesh, kind="ulysses", causal=True)
+    out = jax.jit(fn)(q, k, v)
+    ref = mha_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
