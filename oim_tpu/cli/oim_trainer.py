@@ -56,12 +56,20 @@ def feeder_batches(args, cfg: TrainConfig, tls):
     )
     req = pb.MapVolumeRequest(volume_id=args.volume)
     if getattr(args, "volume_webdataset", ""):
-        # Checked before publish: staging a full shard set only to discover
-        # the model can't consume it would waste minutes and HBM.
-        if not cfg.model.startswith("llama"):
-            raise SystemExit("--volume-webdataset feeds llama-family models")
         req.webdataset.shard_urls.extend(
             u for u in args.volume_webdataset.split(",") if u
+        )
+    elif getattr(args, "volume_tfrecord", ""):
+        # Checked BEFORE publish: staging a multi-GB volume only to discover
+        # the model can't consume it would waste minutes and HBM.
+        if cfg.model.startswith("llama"):
+            raise SystemExit(
+                "--volume-tfrecord holds labeled tf.Example images (feeds "
+                "resnet); llama-family models take --volume-file or "
+                "--volume-webdataset token volumes"
+            )
+        req.tfrecord.paths.extend(
+            p for p in args.volume_tfrecord.split(",") if p
         )
     elif args.volume_file:
         req.file.path = args.volume_file
@@ -70,13 +78,26 @@ def feeder_batches(args, cfg: TrainConfig, tls):
         req.malloc.SetInParent()
     pub = feeder.publish(req, timeout=args.publish_timeout)
     window = getattr(args, "feed_window_bytes", 0)
-    if req.WhichOneof("params") == "webdataset":
-        # Config-5 shape: llama fed from webdataset shards through
-        # MapVolume. Shards are tars, so windows are SHARD-granular (a byte
-        # window could split a header): with --feed-window-bytes > 0 one
-        # shard is host-resident at a time; 0 materializes the volume.
-        yield from _webdataset_token_batches(
-            args, cfg, feeder, pub, list(req.webdataset.shard_urls))
+    kind = req.WhichOneof("params")
+    if kind == "webdataset":
+        if cfg.model.startswith("llama"):
+            # Config-5 shape: llama fed from webdataset shards through
+            # MapVolume. Shards are tars, so windows are SHARD-granular (a
+            # byte window could split a header): with --feed-window-bytes >
+            # 0 one shard is host-resident at a time; 0 materializes the
+            # volume.
+            yield from _webdataset_token_batches(
+                args, cfg, feeder, pub, list(req.webdataset.shard_urls))
+        else:
+            # Supervised vision: jpg/cls sample pairs, decoded host-side.
+            yield from _webdataset_image_batches(
+                args, cfg, feeder, pub, list(req.webdataset.shard_urls))
+        return
+    if kind == "tfrecord":
+        # Labeled tf.Example records (image/encoded + image/class/label):
+        # the framed bytes are staged; framing + proto parse + JPEG decode
+        # happen in the feed — real labels end to end (config 3/4).
+        yield from _tfrecord_image_batches(args, cfg, feeder, pub)
         return
 
     if window <= 0:
@@ -303,6 +324,190 @@ def _webdataset_token_batches(args, cfg: TrainConfig, feeder, pub, urls):
         carry = carry[:0]
 
 
+def _example_to_sample(ex: dict, cfg: TrainConfig, volume: str):
+    """Parsed tf.Example -> (image [S,S,3] f32 in [0,1], label int32).
+
+    Keys follow the ImageNet-TFRecord convention: image/encoded (JPEG/PNG
+    bytes), image/class/label (int64) — the third-party format the feed
+    translates, the role of the reference's emulation personality
+    (ceph-csi.go:34-108)."""
+    from oim_tpu.data import readers
+
+    img = ex.get("image/encoded")
+    if not img:
+        raise SystemExit(
+            f"volume {volume!r}: tf.Example has no image/encoded feature "
+            f"(found {sorted(ex)})"
+        )
+    label = ex.get("image/class/label")
+    if label is None or not len(label):
+        raise SystemExit(
+            f"volume {volume!r}: tf.Example has no image/class/label feature"
+        )
+    arr = readers.resize_image(readers.decode_image(img[0]), cfg.image_size)
+    return arr.astype(np.float32) / 255.0, int(label[0])
+
+
+def _tfrecord_image_batches(args, cfg: TrainConfig, feeder, pub):
+    """Labeled (image, label) batches from a staged TFRecord volume.
+
+    The volume holds TFRecord-FRAMED serialized tf.Examples (framing
+    survives staging, data/readers.py read_tfrecord_batch). Whole-volume
+    mode decodes everything once and cycles (supports --shuffle); windowed
+    mode carries framed bytes across ReadVolume windows and decodes whole
+    records as they complete — host working set is one window of JPEGs.
+    """
+    from oim_tpu.data import readers
+
+    window = getattr(args, "feed_window_bytes", 0)
+    if window <= 0:
+        data = (np.asarray(pub.array) if pub.array is not None
+                else feeder.fetch(args.volume, timeout=args.publish_timeout))
+        images, labels = [], []
+        for rec in readers.iter_tfrecord_bytes(data):
+            im, lab = _example_to_sample(
+                readers.parse_example(rec), cfg, args.volume)
+            images.append(im)
+            labels.append(lab)
+        if not images:
+            raise SystemExit(f"volume {args.volume!r} holds no tf.Examples")
+        images = np.stack(images)
+        labels = np.asarray(labels, np.int32)
+        from_context().info(
+            "labeled tfrecord volume published", volume=args.volume,
+            examples=images.shape[0],
+        )
+        for idx in _cycle_indices(
+                images.shape[0], cfg.batch_size, _shuffle_seed(args)):
+            yield {"images": images[idx], "labels": labels[idx]}
+        return
+
+    from_context().info(
+        "labeled tfrecord streaming feed", volume=args.volume,
+        window_bytes=window,
+    )
+    carry = np.zeros((0,), np.uint8)
+    imgs: list[np.ndarray] = []
+    labs: list[int] = []
+    offset, produced = 0, False
+    while True:
+        w, total, _ = feeder.fetch_window(
+            args.volume, offset, window, timeout=args.publish_timeout
+        )
+        offset += w.size
+        w8 = np.asarray(w, np.uint8)
+        carry = np.concatenate([carry, w8]) if carry.size else w8
+        cut = readers.complete_tfrecord_prefix(carry)
+        for rec in readers.iter_tfrecord_bytes(carry[:cut]):
+            im, lab = _example_to_sample(
+                readers.parse_example(rec), cfg, args.volume)
+            imgs.append(im)
+            labs.append(lab)
+        carry = carry[cut:]
+        while len(imgs) >= cfg.batch_size:
+            produced = True
+            yield {
+                "images": np.stack(imgs[:cfg.batch_size]),
+                "labels": np.asarray(labs[:cfg.batch_size], np.int32),
+            }
+            del imgs[:cfg.batch_size], labs[:cfg.batch_size]
+        if offset >= total:
+            if not produced and not imgs:
+                raise SystemExit(
+                    f"volume {args.volume!r}: a full pass produced no "
+                    f"tf.Example records"
+                )
+            # Framing restarts at the volume head; a partial-record byte
+            # tail cannot continue across the wrap.
+            offset, carry = 0, carry[:0]
+
+
+def _wds_image_sample(sample: dict, cfg: TrainConfig, imgs, labs):
+    from oim_tpu.data import readers
+
+    payload = sample.get("jpg") or sample.get("jpeg") or sample.get("png")
+    if payload is None:
+        return
+    cls = sample.get("cls")
+    if cls is None:
+        raise SystemExit(
+            "webdataset image sample has no 'cls' member (label); "
+            f"members: {sorted(sample)}"
+        )
+    imgs.append(readers.resize_image(
+        readers.decode_image(payload), cfg.image_size
+    ).astype(np.float32) / 255.0)
+    labs.append(int(cls.decode().strip() or 0))
+
+
+def _webdataset_image_batches(args, cfg: TrainConfig, feeder, pub, urls):
+    """Supervised-vision twin of _webdataset_token_batches: each sample's
+    jpg/png member is decoded and its cls member is the integer label.
+    Windowed mode streams shard-granular; whole-volume supports --shuffle."""
+    from oim_tpu.data import webdataset as wds
+
+    window = getattr(args, "feed_window_bytes", 0)
+    if window <= 0:
+        data = (np.asarray(pub.array) if pub.array is not None
+                else feeder.fetch(args.volume, timeout=args.publish_timeout))
+        imgs: list[np.ndarray] = []
+        labs: list[int] = []
+        for s in wds.iter_samples([np.asarray(data)]):
+            _wds_image_sample(s, cfg, imgs, labs)
+        if not imgs:
+            raise SystemExit(
+                f"webdataset volume {args.volume!r} has no jpg/cls samples"
+            )
+        images = np.stack(imgs)
+        labels = np.asarray(labs, np.int32)
+        from_context().info(
+            "webdataset image volume published", volume=args.volume,
+            samples=images.shape[0],
+        )
+        for idx in _cycle_indices(
+                images.shape[0], cfg.batch_size, _shuffle_seed(args)):
+            yield {"images": images[idx], "labels": labels[idx]}
+        return
+
+    sizes = wds.shard_sizes(urls)
+    offsets = np.concatenate([[0], np.cumsum(sizes)])
+    from_context().info(
+        "webdataset image streaming feed", volume=args.volume,
+        shards=len(urls),
+    )
+    imgs, labs = [], []
+    produced = False
+    while True:
+        for i, size in enumerate(sizes):
+            shard, total, _ = feeder.fetch_window(
+                args.volume, int(offsets[i]), int(size),
+                timeout=args.publish_timeout,
+            )
+            if int(offsets[-1]) != int(total):
+                raise SystemExit(
+                    f"webdataset volume {args.volume!r}: staged volume is "
+                    f"{total} bytes but the shard URLs now sum to "
+                    f"{int(offsets[-1])} — shards changed since staging?"
+                )
+            for s in wds.iter_samples([np.asarray(shard)]):
+                _wds_image_sample(s, cfg, imgs, labs)
+            while len(imgs) >= cfg.batch_size:
+                produced = True
+                yield {
+                    "images": np.stack(imgs[:cfg.batch_size]),
+                    "labels": np.asarray(labs[:cfg.batch_size], np.int32),
+                }
+                del imgs[:cfg.batch_size], labs[:cfg.batch_size]
+        # Samples smaller than one batch carry into the next pass (same
+        # rule as the tfrecord feed); only a pass that parsed NOTHING is
+        # a dead volume.
+        if not produced and not imgs:
+            raise SystemExit(
+                f"webdataset volume {args.volume!r}: one full pass over "
+                f"{len(urls)} shards produced no jpg/cls image batches"
+            )
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser("oim-trainer")
     parser.add_argument("--model", default="llama-tiny",
@@ -324,6 +529,7 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--batch-size", type=int, default=8)
     parser.add_argument("--seq-len", type=int, default=128)
     parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--num-classes", type=int, default=1000)
     parser.add_argument("--lr", type=float, default=3e-4)
     parser.add_argument("--warmup-steps", type=int, default=100)
     parser.add_argument("--log-every", type=int, default=10)
@@ -338,6 +544,9 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--eval-volume-file", default="",
                         help="held-out volume staged as '<volume>-eval' "
                              "and used for --eval-every in feeder mode")
+    parser.add_argument("--eval-volume-tfrecord", default="",
+                        help="held-out labeled TFRecord volume (tf.Examples)"
+                             " for --eval-every in feeder mode")
     parser.add_argument("--metrics-port", type=int, default=-1,
                         help=">=0 serves GET /metrics (0 = ephemeral port)")
     parser.add_argument("--smoke", action="store_true",
@@ -349,6 +558,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--volume", default="train-data")
     parser.add_argument("--volume-file", default="",
                         help="stage this file as the training volume")
+    parser.add_argument("--volume-tfrecord", default="",
+                        help="comma-separated TFRecord paths (serialized "
+                             "tf.Examples: image/encoded + image/class/label)"
+                             " staged as a labeled image volume")
     parser.add_argument("--volume-webdataset", default="",
                         help="comma-separated webdataset shard URLs "
                              "(local paths or http(s)) to stage and train on")
@@ -361,6 +574,9 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--shuffle-seed", type=int, default=0)
     parser.add_argument("--augment", action="store_true",
                         help="host-side random flip + crop on image batches")
+    parser.add_argument("--prefetch-batches", type=int, default=2,
+                        help="feed batches decoded ahead in a background "
+                             "thread (0 = synchronous feed)")
     parser.add_argument("--feed-window-bytes", type=int, default=64 << 20,
                         help="host-resident feed window; 0 = materialize "
                              "the whole volume (small volumes only)")
@@ -372,6 +588,11 @@ def main(argv: list[str] | None = None) -> int:
         "--expected-hosts", type=int, default=1,
         help="multi-host: wait for this many controllers in the registry, "
              "derive ranks from the topology, jax.distributed.initialize",
+    )
+    parser.add_argument(
+        "--coordinator-port", type=int, default=8476,
+        help="port for the rank-0 jax.distributed coordinator (derived "
+             "from the registry-elected rank-0 host's address)",
     )
     parser.add_argument(
         "--platform", default="",
@@ -409,6 +630,7 @@ def main(argv: list[str] | None = None) -> int:
         batch_size=args.batch_size,
         seq_len=args.seq_len,
         image_size=args.image_size,
+        num_classes=args.num_classes,
         lr=args.lr,
         warmup_steps=args.warmup_steps,
         total_steps=args.steps,
@@ -434,15 +656,18 @@ def main(argv: list[str] | None = None) -> int:
             from oim_tpu.parallel.bootstrap import initialize_from_registry
 
             pid, n = initialize_from_registry(
-                args.registry, args.controller_id, args.expected_hosts, tls
+                args.registry, args.controller_id, args.expected_hosts, tls,
+                coordinator_port=args.coordinator_port,
             )
             log.info("distributed", process_id=pid, num_processes=n)
         data = feeder_batches(args, cfg, tls)
-        if args.eval_every and args.eval_volume_file:
+        if args.eval_every and (args.eval_volume_file
+                                or args.eval_volume_tfrecord):
             eval_args = argparse.Namespace(**{
                 **vars(args),
                 "volume": f"{args.volume}-eval",
                 "volume_file": args.eval_volume_file,
+                "volume_tfrecord": args.eval_volume_tfrecord,
                 "volume_webdataset": "",
                 "feed_window_bytes": 0,
                 "shuffle": False,
@@ -451,6 +676,8 @@ def main(argv: list[str] | None = None) -> int:
     elif not args.synthetic:
         args.synthetic = True
     if args.augment:
+        import dataclasses as _dc
+
         import jax
 
         from oim_tpu.data.augment import augment_batches
@@ -459,10 +686,23 @@ def main(argv: list[str] | None = None) -> int:
         # Per-host decorrelated stream, offset from the shuffle seed so the
         # two RNGs never alias.
         aug_seed = (args.shuffle_seed + 1) * 1_000_003 + jax.process_index()
+        if data is None and args.eval_every and eval_data is None:
+            # Augmentation wraps the synthetic stream in a generator the
+            # Trainer no longer recognizes as its own default — build the
+            # shifted-seed held-out stream here so eval still runs instead
+            # of being skipped with a misleading real-feed warning.
+            eval_data = synthetic_batches(
+                _dc.replace(cfg, seed=cfg.seed + 10_000)
+            )
         data = augment_batches(
             data if data is not None else synthetic_batches(cfg),
             seed=aug_seed,
         )
+    if data is not None and args.prefetch_batches > 0:
+        # Fetch/decode of batch N+1 overlaps the train step on batch N.
+        from oim_tpu.data.prefetch import prefetch_batches
+
+        data = prefetch_batches(data, depth=args.prefetch_batches)
 
     from oim_tpu.common.profiling import profile_trace
 

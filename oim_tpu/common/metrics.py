@@ -95,6 +95,9 @@ TRAIN_MFU = DEFAULT.gauge(
     "oim_train_mfu", "model flops utilization of the most recent step")
 EVAL_LOSS = DEFAULT.gauge(
     "oim_eval_loss", "mean loss of the most recent evaluation pass")
+EVAL_ACCURACY = DEFAULT.gauge(
+    "oim_eval_accuracy",
+    "mean classification accuracy of the most recent evaluation pass")
 
 
 class MetricsServer:

@@ -113,6 +113,9 @@ class TrainConfig:
     eval_every: int = 0  # run an eval pass every N steps (0 = off)
     eval_steps: int = 8  # batches per eval pass
     seed: int = 0
+    # dataclasses.replace overrides applied to the named model's config
+    # (e.g. a tiny-depth llama3-8b for dryruns: full vocab, 2 layers).
+    model_overrides: dict = dataclasses.field(default_factory=dict)
 
     def model_config(self):
         if self.model == "llama-tiny":
@@ -127,6 +130,8 @@ class TrainConfig:
             raise ValueError(f"unknown model {self.model!r}")
         if self.remat:
             mcfg = dataclasses.replace(mcfg, remat=True)
+        if self.model_overrides:
+            mcfg = dataclasses.replace(mcfg, **self.model_overrides)
         return mcfg
 
 
@@ -212,7 +217,10 @@ def make_train_step(
                 loss = llama.loss_fn(params, batch["tokens"], mcfg, attn_fn)
                 return loss, extra
 
-        eval_loss_fn = loss_fn  # llama eval = same forward, no update
+        def eval_stats_fn(params, extra, batch):
+            # llama eval = same forward, no update.
+            loss, _ = loss_fn(params, extra, batch)
+            return {"loss": loss.astype(jnp.float32)}
 
         # Tokens arrive [B, T+1] — the +1 label shift makes the length
         # indivisible by a seq axis, so tokens stay batch-sharded only;
@@ -233,12 +241,22 @@ def make_train_step(
             )
             return softmax_cross_entropy(logits, batch["labels"]), new_extra
 
-        def eval_loss_fn(params, extra, batch):
+        def eval_stats_fn(params, extra, batch):
             # Inference mode: running BN statistics, state untouched.
+            # Accuracy rides along — the honest config-3/4 metric for a
+            # labeled OIM-fed classifier (loss alone can fall on garbage).
             logits, _ = resnet.apply(
                 params, extra, batch["images"], mcfg, training=False
             )
-            return softmax_cross_entropy(logits, batch["labels"]), extra
+            acc = jnp.mean(
+                (jnp.argmax(logits, axis=-1) == batch["labels"]).astype(
+                    jnp.float32)
+            )
+            return {
+                "loss": softmax_cross_entropy(
+                    logits, batch["labels"]).astype(jnp.float32),
+                "accuracy": acc,
+            }
 
         batch_logical = {
             "images": (BATCH, None, None, None),
@@ -339,8 +357,7 @@ def make_train_step(
     )
 
     def eval_step(state: TrainState, batch):
-        loss, _ = eval_loss_fn(state.params, state.extra, batch)
-        return loss.astype(jnp.float32)
+        return eval_stats_fn(state.params, state.extra, batch)
 
     eval_fn = jax.jit(
         eval_step, in_shardings=(state_shardings, batch_shardings)
@@ -405,6 +422,7 @@ class Trainer:
         (self.step_fn, self.state_shardings, self.init_fn,
          self.eval_fn) = make_train_step(cfg, mesh, self.tx)
         self.state: TrainState | None = None
+        self.last_eval_stats: dict[str, float] = {}
         self.checkpointer = None
         if cfg.checkpoint_dir:
             from oim_tpu.train.checkpoint import Checkpointer
@@ -430,22 +448,34 @@ class Trainer:
 
     def place_batch(self, batch: dict) -> dict:
         rules = RULES[self.cfg.rules]
+        multihost = jax.process_count() > 1
         out = {}
         for k, v in batch.items():
             axes = (BATCH,) + (None,) * (np.ndim(v) - 1)
             if k == "tokens":
                 axes = (BATCH, None)  # seq dim of the (T+1) batch stays host-split
-            out[k] = jax.device_put(
-                v, logical_sharding(self.mesh, rules, axes)
-            )
+            sharding = logical_sharding(self.mesh, rules, axes)
+            if multihost:
+                # The mesh spans processes: each host holds the GLOBAL batch
+                # (every feed is deterministic per volume) and contributes
+                # only the shards its addressable devices own.
+                v = np.asarray(v)
+                out[k] = jax.make_array_from_callback(
+                    v.shape, sharding, lambda idx, v=v: v[idx]
+                )
+            else:
+                out[k] = jax.device_put(v, sharding)
         return out
 
     def evaluate(self, data: Iterator[dict], n_batches: int | None = None) -> float:
         """Forward-only mean loss over n_batches (inference-mode model).
         A finite iterator that runs dry mid-pass ends the pass (mean over
-        what ran) instead of crashing training."""
+        what ran) instead of crashing training. Classifier models also
+        report mean accuracy (``last_eval_stats`` / the EVAL_ACCURACY
+        gauge)."""
         n = n_batches or self.cfg.eval_steps
-        total, ran = 0.0, 0
+        totals: dict[str, float] = {}
+        ran = 0
         for _ in range(n):
             try:
                 batch = next(data)
@@ -454,14 +484,19 @@ class Trainer:
                     "eval data exhausted mid-pass", batches_run=ran
                 )
                 break
-            total += float(self.eval_fn(self.state, self.place_batch(batch)))
+            stats = self.eval_fn(self.state, self.place_batch(batch))
+            for k, v in stats.items():
+                totals[k] = totals.get(k, 0.0) + float(v)
             ran += 1
         if ran == 0:
             # Zero batches is not a perfect loss: don't touch the gauge,
             # don't return a plausible-looking 0.0.
             return float("nan")
-        loss = total / ran
+        self.last_eval_stats = {k: v / ran for k, v in totals.items()}
+        loss = self.last_eval_stats["loss"]
         M.EVAL_LOSS.set(loss)
+        if "accuracy" in self.last_eval_stats:
+            M.EVAL_ACCURACY.set(self.last_eval_stats["accuracy"])
         return loss
 
     def run(self, steps: int | None = None, data: Iterator[dict] | None = None,

@@ -1,0 +1,234 @@
+"""The supervised fed path, end to end (VERDICT r2 #1 / BASELINE configs
+3-4): labeled tf.Example / webdataset(jpg+cls) volumes staged through
+MapVolume feed a ResNet classifier with REAL labels — train loss falls below
+chance and eval accuracy rises above it.
+
+Reader-codec ring-0 tests live here too: the tf.Example wire-format
+parse/encode twins, TFRecord framing over staged bytes, and the JPEG
+decode/resize pipeline (all TF-free; readers.py)."""
+
+import argparse
+
+import numpy as np
+import pytest
+
+from oim_tpu.controller import ControllerService, MallocBackend
+from oim_tpu.controller.controller import controller_server
+from oim_tpu.data import readers
+from oim_tpu.registry import MemRegistryDB, RegistryService
+from oim_tpu.registry.registry import registry_server
+from oim_tpu.train import TrainConfig
+
+
+# ------------------------------------------------------------ ring 0: codec
+
+
+class TestTFExampleCodec:
+    def test_round_trip(self):
+        ex = {
+            "image/encoded": b"\xff\xd8fakejpeg",
+            "image/class/label": [7],
+            "image/height": [16],
+            "weights": [0.5, 1.25],
+            "names": [b"a", b"bc"],
+        }
+        got = readers.parse_example(readers.encode_example(ex))
+        assert got["image/encoded"] == [b"\xff\xd8fakejpeg"]
+        assert got["image/class/label"].tolist() == [7]
+        assert got["image/height"].tolist() == [16]
+        np.testing.assert_allclose(got["weights"], [0.5, 1.25])
+        assert got["names"] == [b"a", b"bc"]
+
+    def test_negative_and_large_ints(self):
+        ex = {"v": [-1, 0, 2**40]}
+        got = readers.parse_example(readers.encode_example(ex))
+        assert got["v"].tolist() == [-1, 0, 2**40]
+
+    def test_parses_real_tensorflow_encoding(self):
+        # Byte-for-byte tf.train.Example(features=...{label: int64_list
+        # {value: [5]}}).SerializeToString() captured from TensorFlow —
+        # guards the hand-rolled parser against the canonical encoder.
+        # Example{features{feature{key:"label" value{int64_list{value:5}}}}}
+        # = 0a10( 0a0e( 0a05"label" 1205( 1a03( 0a01 05 )))).
+        blob = bytes.fromhex("0a100a0e0a056c6162656c12051a030a0105")
+        got = readers.parse_example(blob)
+        assert got["label"].tolist() == [5]
+
+    def test_framing_round_trip_in_memory(self):
+        import struct
+
+        recs = [b"a" * 3, b"b" * 17, b"c"]
+
+        framed = b"".join(
+            struct.pack("<Q", len(r)) + b"\0\0\0\0" + r + b"\0\0\0\0"
+            for r in recs
+        )
+        assert list(readers.iter_tfrecord_bytes(framed)) == recs
+        arr = np.frombuffer(framed, np.uint8)
+        assert readers.complete_tfrecord_prefix(arr) == len(framed)
+        # A truncated tail is excluded from the prefix, not an error.
+        # (the last frame is 12 + 1 + 4 = 17 bytes)
+        assert readers.complete_tfrecord_prefix(arr[:-1]) == len(framed) - 17
+        with pytest.raises(IOError):
+            list(readers.iter_tfrecord_bytes(framed[:-1]))
+
+    def test_jpeg_decode_resize(self):
+        img = np.zeros((16, 16, 3), np.uint8)
+        img[:, :, 0] = 200
+        out = readers.decode_image(readers.encode_jpeg(img, quality=95))
+        assert out.shape == (16, 16, 3)
+        assert abs(int(out[:, :, 0].mean()) - 200) < 10
+        assert readers.resize_image(out, 32).shape == (32, 32, 3)
+
+
+def _labeled_tfrecord(path, n=32, seed=0):
+    """n tf.Examples: class 0 = dark image, class 1 = bright image (a
+    linearly separable toy so a few train steps beat chance)."""
+    rng = np.random.RandomState(seed)
+    records = []
+    labels = []
+    for i in range(n):
+        label = i % 2
+        base = 40 if label == 0 else 215
+        img = np.clip(
+            base + rng.randint(-25, 25, (16, 16, 3)), 0, 255
+        ).astype(np.uint8)
+        records.append(readers.encode_example({
+            "image/encoded": readers.encode_jpeg(img, quality=95),
+            "image/class/label": [label],
+        }))
+        labels.append(label)
+    readers.write_tfrecords(path, records)
+    return labels
+
+
+@pytest.fixture
+def cluster():
+    db = MemRegistryDB()
+    registry = registry_server("tcp://localhost:0", RegistryService(db=db))
+    controller_service = ControllerService(MallocBackend())
+    controller = controller_server("tcp://localhost:0", controller_service)
+    db.set("host-0/address", controller.addr)
+    yield registry
+    registry.force_stop()
+    controller.force_stop()
+
+
+def _feed_args(registry, volume, window=0, **over):
+    base = dict(
+        registry=registry.addr, controller_id="host-0", volume=volume,
+        volume_file="", volume_tfrecord="", volume_webdataset="",
+        feed_window_bytes=window, publish_timeout=30.0,
+    )
+    base.update(over)
+    return argparse.Namespace(**base)
+
+
+class TestLabeledFeeds:
+    def test_tfrecord_feed_yields_real_labels(self, cluster, tmp_path):
+        from oim_tpu.cli.oim_trainer import feeder_batches
+
+        path = tmp_path / "train.tfrecord"
+        labels = _labeled_tfrecord(path, n=16)
+        cfg = TrainConfig(model="resnet50", num_classes=2, image_size=16,
+                          batch_size=8)
+        feed = feeder_batches(
+            _feed_args(cluster, "vol-sup", volume_tfrecord=str(path)),
+            cfg, None)
+        b = next(feed)
+        assert b["images"].shape == (8, 16, 16, 3)
+        assert b["images"].dtype == np.float32
+        assert 0.0 <= b["images"].min() and b["images"].max() <= 1.0
+        assert b["labels"].tolist() == labels[:8]
+        # Bright class must actually be brighter: pixels carry the signal.
+        bright = b["images"][np.asarray(labels[:8]) == 1].mean()
+        dark = b["images"][np.asarray(labels[:8]) == 0].mean()
+        assert bright > dark + 0.3
+
+    def test_tfrecord_windowed_matches_whole_volume(self, cluster, tmp_path):
+        from oim_tpu.cli.oim_trainer import feeder_batches
+
+        path = tmp_path / "w.tfrecord"
+        _labeled_tfrecord(path, n=24, seed=3)
+        cfg = TrainConfig(model="resnet50", num_classes=2, image_size=16,
+                          batch_size=4)
+        whole = feeder_batches(
+            _feed_args(cluster, "vol-w0", volume_tfrecord=str(path)),
+            cfg, None)
+        # Window smaller than the volume: records straddle window seams.
+        windowed = feeder_batches(
+            _feed_args(cluster, "vol-w1", volume_tfrecord=str(path),
+                       window=1500),
+            cfg, None)
+        for _ in range(8):
+            a, b = next(whole), next(windowed)
+            np.testing.assert_array_equal(a["labels"], b["labels"])
+            np.testing.assert_allclose(a["images"], b["images"])
+
+    def test_webdataset_image_feed(self, cluster, tmp_path):
+        import io
+        import tarfile
+
+        from oim_tpu.cli.oim_trainer import feeder_batches
+
+        shard = tmp_path / "imgs-000.tar"
+        rng = np.random.RandomState(5)
+        want = []
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tf:
+            for i in range(8):
+                label = i % 2
+                img = np.clip(
+                    (60 if label == 0 else 200)
+                    + rng.randint(0, 20, (16, 16, 3)), 0, 255
+                ).astype(np.uint8)
+                for name, payload in (
+                    (f"sample{i:04d}.jpg", readers.encode_jpeg(img, quality=95)),
+                    (f"sample{i:04d}.cls", str(label).encode()),
+                ):
+                    info = tarfile.TarInfo(name)
+                    info.size = len(payload)
+                    tf.addfile(info, io.BytesIO(payload))
+                want.append(label)
+        shard.write_bytes(buf.getvalue())
+        cfg = TrainConfig(model="resnet50", num_classes=2, image_size=16,
+                          batch_size=4)
+        feed = feeder_batches(
+            _feed_args(cluster, "vol-wds",
+                       volume_webdataset=str(shard)),
+            cfg, None)
+        b = next(feed)
+        assert b["images"].shape == (4, 16, 16, 3)
+        assert b["labels"].tolist() == want[:4]
+
+    def test_supervised_fed_training_beats_chance(self, cluster, tmp_path):
+        """THE config-3/4 claim: a labeled volume staged through MapVolume
+        trains fed-ResNet below chance loss, and held-out eval accuracy
+        beats chance. Real labels, real JPEG decode, real control plane."""
+        from oim_tpu.cli.oim_trainer import feeder_batches
+        from oim_tpu.train import Trainer
+
+        train_path = tmp_path / "train.tfrecord"
+        eval_path = tmp_path / "eval.tfrecord"
+        _labeled_tfrecord(train_path, n=32, seed=1)
+        _labeled_tfrecord(eval_path, n=16, seed=2)
+
+        cfg = TrainConfig(
+            model="resnet50", num_classes=2, image_size=32, batch_size=8,
+            lr=1e-3, warmup_steps=2, total_steps=24, log_every=8,
+            eval_steps=2,
+        )
+        data = feeder_batches(
+            _feed_args(cluster, "vol-train", volume_tfrecord=str(train_path)),
+            cfg, None)
+        eval_data = feeder_batches(
+            _feed_args(cluster, "vol-eval", volume_tfrecord=str(eval_path)),
+            cfg, None)
+
+        trainer = Trainer(cfg, axes=[("data", 4)])
+        loss = trainer.run(steps=24, data=data)
+        chance = float(np.log(cfg.num_classes))
+        assert loss < chance, f"train loss {loss} never beat chance {chance}"
+        trainer.evaluate(eval_data, n_batches=2)
+        acc = trainer.last_eval_stats["accuracy"]
+        assert acc > 0.5, f"eval accuracy {acc} is not above chance"
