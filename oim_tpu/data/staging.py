@@ -225,14 +225,20 @@ def stage_file_to_device(
     dtype: str = "uint8",
     shape: tuple[int, ...] | None = None,
     chunk_bytes: int = 64 << 20,
+    progress=None,
 ):
     """File -> single-device jax array, overlapping disk read-ahead (C++)
     with host->device transfers: device_put of chunk N runs while the
     filler thread preads chunk N+1 into another pinned buffer; the chunks
     are concatenated on-device.
 
+    ``progress``, when given, is called with cumulative bytes after each
+    chunk lands on device; returning False aborts the stage (staged parts
+    are freed) and the function returns None — the hook production staging
+    uses for StageStatus progress and unmap-during-staging cancellation.
+
     Returns the staged jax.Array (dtype/shape applied at the end, zero-copy
-    on device).
+    on device), or None when aborted.
     """
     import jax
     import jax.numpy as jnp
@@ -240,6 +246,7 @@ def stage_file_to_device(
     if device is None:
         device = jax.devices()[0]
     parts = []
+    done = 0
     on_cpu = device.platform == "cpu"
     for chunk in stream(path, chunk_bytes=chunk_bytes):
         if on_cpu:
@@ -259,6 +266,12 @@ def stage_file_to_device(
             # noise next to the disk read.
             np.asarray(part[:1])
             parts.append(part)
+        done += int(chunk.size)
+        if progress is not None and progress(done) is False:
+            for p in parts:
+                if hasattr(p, "delete"):
+                    p.delete()
+            return None
     if not parts:
         out = jax.device_put(np.zeros((0,), np.uint8), device)
     elif len(parts) == 1:

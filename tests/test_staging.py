@@ -135,7 +135,9 @@ lib = ctypes.CDLL(sys.argv[1])
 lib.oim_stream_open.restype = ctypes.c_void_p
 lib.oim_stream_open.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int, ctypes.c_int]
 lib.oim_stream_next.restype = ctypes.c_int64
-lib.oim_stream_next.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64)]
+lib.oim_stream_next.argtypes = [
+    ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+    ctypes.POINTER(ctypes.c_int64)]
 lib.oim_stream_release.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
 lib.oim_stream_close.argtypes = [ctypes.c_void_p]
 h = lib.oim_stream_open(sys.argv[2].encode(), 1 << 18, 3, 1)
@@ -174,3 +176,141 @@ def test_file_source_uses_staging(native, datafile):
     path, data = datafile
     arr = load_source("file", pb.FileParams(path=str(path), format="raw"))
     assert arr.tobytes() == data
+
+
+def test_stage_file_to_device_progress_and_abort(native, datafile):
+    """The production staging hook: progress reports cumulative bytes per
+    chunk; returning False aborts and frees the staged parts."""
+    path, data = datafile
+    seen = []
+    arr = staging.stage_file_to_device(
+        path, chunk_bytes=1 << 20, progress=lambda done: seen.append(done))
+    assert bytes(np.asarray(arr)) == data
+    assert seen[-1] == len(data)
+    assert seen == sorted(seen) and len(seen) == 4  # 3 MiB + tail
+
+    aborted = staging.stage_file_to_device(
+        path, chunk_bytes=1 << 20, progress=lambda done: done < (2 << 20))
+    assert aborted is None
+
+
+class TestTPUBackendChunkedStaging:
+    """MapVolume's production path rides the overlap engine (VERDICT r2 #3):
+    single-device raw-file volumes stage chunk-by-chunk (disk read-ahead in
+    C++ overlapping device_put), with StageStatus progress and
+    unmap-during-staging cancellation."""
+
+    def _stage(self, tmp_path, data, spec=None, chunk=1 << 20):
+        from oim_tpu.controller.backend import StagedVolume
+        from oim_tpu.controller.tpu_backend import TPUBackend
+        from oim_tpu.spec import pb
+
+        path = tmp_path / "vol.bin"
+        path.write_bytes(data)
+        backend = TPUBackend(chunk_bytes=chunk)
+        vol = StagedVolume(
+            volume_id="v", params_key=b"", spec=spec or pb.ArraySpec())
+        backend.stage(vol, "file", pb.FileParams(path=str(path), format="raw"))
+        return backend, vol, path
+
+    def test_raw_file_routes_chunked(self, native, tmp_path):
+        data = np.random.RandomState(7).bytes(3 * (1 << 20) + 999)
+        backend, vol, _ = self._stage(tmp_path, data)
+        assert vol.wait(timeout=60)
+        from oim_tpu.controller.backend import StageState
+
+        assert vol.state == StageState.READY
+        assert vol.total_bytes == len(data)  # set up front, before chunks
+        assert bytes(np.asarray(vol.array)) == data
+
+    def test_chunked_respects_dtype_shape(self, native, tmp_path):
+        from oim_tpu.spec import pb
+
+        vals = np.arange(1 << 18, dtype=np.int32)
+        spec = pb.ArraySpec(shape=[512, 512], dtype="int32")
+        backend, vol, _ = self._stage(tmp_path, vals.tobytes(), spec=spec,
+                                      chunk=1 << 19)
+        assert vol.wait(timeout=60)
+        out = np.asarray(vol.array)
+        assert out.shape == (512, 512) and out.dtype == np.int32
+        np.testing.assert_array_equal(out.reshape(-1), vals)
+
+    def test_unmap_mid_stage_cancels(self, native, tmp_path, monkeypatch):
+        """A racing UnmapVolume flips cancelled; the chunk loop's progress
+        callback sees it and aborts without stranding device memory."""
+        import time as _time
+
+        from oim_tpu.data import staging as staging_mod
+
+        real_stream = staging_mod.stream
+
+        def slow_stream(*a, **kw):
+            for chunk in real_stream(*a, **kw):
+                _time.sleep(0.05)
+                yield chunk
+
+        monkeypatch.setattr(staging_mod, "stream", slow_stream)
+        data = np.random.RandomState(8).bytes(2 << 20)
+        backend, vol, _ = self._stage(tmp_path, data, chunk=1 << 18)
+        _time.sleep(0.08)  # let a chunk or two land
+        backend.unstage(vol)
+        assert vol.wait(timeout=30)
+        from oim_tpu.controller.backend import StageState
+
+        assert vol.state == StageState.FAILED
+        assert "unmapped" in vol.error
+
+    def test_sharded_spec_keeps_whole_read(self, tmp_path):
+        """NamedSharding scatter needs the global array: sharded specs must
+        NOT take the single-device chunked path."""
+        from oim_tpu.controller.tpu_backend import TPUBackend
+        from oim_tpu.spec import pb
+
+        backend = TPUBackend()
+        spec = pb.ArraySpec(shape=[8, 4], dtype="float32",
+                            sharding_axes=["data", ""])
+        assert backend._chunkable_path(
+            type("V", (), {"spec": spec})(), "file",
+            pb.FileParams(path="x", format="raw")) is None
+
+
+class TestPrefetch:
+    def test_order_preserved(self):
+        from oim_tpu.data.prefetch import prefetch_batches
+
+        assert list(prefetch_batches(iter(range(100)), depth=4)) == list(range(100))
+
+    def test_zero_depth_passthrough(self):
+        from oim_tpu.data.prefetch import prefetch_batches
+
+        assert list(prefetch_batches(iter("abc"), depth=0)) == ["a", "b", "c"]
+
+    def test_producer_error_reraises(self):
+        from oim_tpu.data.prefetch import prefetch_batches
+
+        def bad():
+            yield 1
+            raise RuntimeError("feed died")
+
+        it = prefetch_batches(bad(), depth=2)
+        assert next(it) == 1
+        with pytest.raises(RuntimeError, match="feed died"):
+            list(it)
+
+    def test_overlaps_producer_and_consumer(self):
+        """10 x (20ms produce + 20ms consume): serial ~0.4s, overlapped
+        ~0.22s. Assert well under serial with slack for CI jitter."""
+        import time as _time
+
+        from oim_tpu.data.prefetch import prefetch_batches
+
+        def produce():
+            for i in range(10):
+                _time.sleep(0.02)
+                yield i
+
+        t0 = _time.monotonic()
+        for _ in prefetch_batches(produce(), depth=2):
+            _time.sleep(0.02)
+        wall = _time.monotonic() - t0
+        assert wall < 0.34, f"no overlap: wall={wall:.3f}s (serial ~0.4s)"
