@@ -55,6 +55,7 @@ class PublishedVolume:
     bytes: int
     handle: str
     array: Any = None  # populated in local mode
+    params_key: bytes = b""  # request fingerprint for idempotency checks
 
 
 class Feeder:
@@ -116,16 +117,27 @@ class Feeder:
     ) -> PublishedVolume:
         if not request.volume_id:
             raise PublishError("empty volume_id")
+        params_key = request.SerializeToString(deterministic=True)
         with self._keymutex.locked(request.volume_id):
             existing = self._published.get(request.volume_id)
             if existing is not None:
-                # Idempotency: already published (nodeserver.go:95-109).
+                # Idempotency: already published (nodeserver.go:95-109) —
+                # but only for the SAME request. A conflicting re-publish
+                # must fail loudly, not silently hand back the old volume
+                # (the controller enforces this across clients; the local
+                # cache must not mask it).
+                if existing.params_key != params_key:
+                    raise PublishError(
+                        f"volume {request.volume_id!r} already published "
+                        "with different params"
+                    )
                 return existing
             deadline = time.monotonic() + timeout
             if self.controller is not None:
                 published = self._publish_local(request, deadline)
             else:
                 published = self._publish_remote(request, deadline)
+            published.params_key = params_key
             with self._lock:
                 self._published[request.volume_id] = published
             from_context().info(

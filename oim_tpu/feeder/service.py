@@ -17,7 +17,12 @@ from oim_tpu.common.identity import IdentityService
 from oim_tpu.common.interceptors import LogServerInterceptor
 from oim_tpu.common.server import NonBlockingGRPCServer
 from oim_tpu.common.tlsutil import TLSConfig
-from oim_tpu.feeder.driver import Feeder, PublishError, PublishedVolume
+from oim_tpu.feeder.driver import (
+    DeadlineExceeded,
+    Feeder,
+    PublishError,
+    PublishedVolume,
+)
 from oim_tpu.feeder.emulation import emulations
 from oim_tpu.spec import (
     FeederServicer,
@@ -72,6 +77,11 @@ class FeederDaemon(FeederServicer):
                 )
         except ValueError as err:  # unknown emulation / bad attributes
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(err))
+        except DeadlineExceeded as err:
+            # Keep the deadline semantics visible on the wire: daemon
+            # clients must be able to tell "never materialized" from a
+            # precondition failure (nodeserver.go:348-351 analog).
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(err))
         except PublishError as err:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(err))
         return _reply_for(pub)

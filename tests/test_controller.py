@@ -261,3 +261,48 @@ class TestControllerOverGRPC:
                 stub.UnmapVolume(pb.UnmapVolumeRequest(volume_id="v"), timeout=5)
         finally:
             server.force_stop()
+
+
+class TestShardedReadVolume:
+    """Ranged ReadVolume over a NamedSharding-scattered volume (VERDICT r2
+    weak #7): the window slice must reassemble the GLOBAL array's bytes
+    even when one MapVolume scattered it across every device of the mesh."""
+
+    def test_windows_over_sharded_volume(self, tmp_path):
+        from oim_tpu.controller.tpu_backend import TPUBackend
+        from oim_tpu.parallel import build_mesh
+
+        mesh = build_mesh([("data", 8)])
+        service = ControllerService(TPUBackend(mesh=mesh))
+        vals = np.arange(64 * 128, dtype=np.float32).reshape(64, 128)
+        path = tmp_path / "sharded.npy"
+        np.save(path, vals)
+        service.MapVolume(
+            pb.MapVolumeRequest(
+                volume_id="vol-sh",
+                spec=pb.ArraySpec(shape=[64, 128], dtype="float32",
+                                  sharding_axes=["data", ""]),
+                file=pb.FileParams(path=str(path), format="npy"),
+            ),
+            _Ctx(),
+        )
+        vol = service.get_volume("vol-sh")
+        assert vol.wait(timeout=30) and vol.state == StageState.READY
+        assert len(vol.array.sharding.device_set) == 8  # really scattered
+
+        # Unaligned ranged windows (odd offset/length in BYTE space, cutting
+        # across both element and shard boundaries) must reassemble exactly.
+        want = vals.tobytes()
+        got = bytearray()
+        offset, window = 0, 7_013
+        while offset < len(want):
+            chunks = list(service.ReadVolume(
+                pb.ReadVolumeRequest(volume_id="vol-sh", offset=offset,
+                                     length=window),
+                _Ctx(),
+            ))
+            data = b"".join(c.data for c in chunks)
+            assert data, f"empty window at {offset}"
+            got += data
+            offset += len(data)
+        assert bytes(got) == want

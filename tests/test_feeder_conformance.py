@@ -1,0 +1,245 @@
+"""ONE feeder conformance battery, THREE transports (VERDICT r2 #7).
+
+The reference runs the vendored CSI sanity suite twice — locally against
+SPDK/NBD and remotely against the driver inside the VM
+(pkg/oim-csi-driver/oim-driver_test.go:79-114,
+test/e2e/storage/oim-csi.go:32-124). Same discipline here: the
+publish/read/unpublish/idempotency/deadline/error assertions below are one
+test body executed uniformly against
+
+  (a) a LOCAL Feeder (controller linked in-process),
+  (b) a REMOTE Feeder (registry proxy -> controller over real sockets),
+  (c) the FeederDaemon over gRPC (the daemon wrapping a remote Feeder).
+
+Each transport adapts to the same tiny surface (publish/read/unpublish);
+error normalization maps gRPC status codes onto the library's PublishError/
+DeadlineExceeded so the assertions are transport-agnostic."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from oim_tpu.controller import ControllerService, MallocBackend
+from oim_tpu.controller.backend import StagedVolume
+from oim_tpu.controller.controller import controller_server
+from oim_tpu.feeder import Feeder, FeederDaemon, feeder_server
+from oim_tpu.feeder.driver import DeadlineExceeded, PublishError
+from oim_tpu.registry import MemRegistryDB, RegistryService
+from oim_tpu.registry.registry import registry_server
+from oim_tpu.spec import FeederStub, pb
+
+
+class StuckBackend(MallocBackend):
+    """Staging never completes (the block device that never appears)."""
+
+    def stage(self, volume: StagedVolume, params_kind, params):
+        pass
+
+
+class LocalTransport:
+    name = "local"
+
+    def __init__(self):
+        self.service = ControllerService(MallocBackend())
+        self.feeder = Feeder(controller=self.service)
+
+    def publish(self, req: pb.MapVolumeRequest, timeout: float = 30.0):
+        return self.feeder.publish(req, timeout=timeout)
+
+    def read(self, volume_id: str) -> bytes:
+        vol = self.service.get_volume(volume_id)
+        assert vol is not None, f"{volume_id} not staged"
+        return np.asarray(vol.array).reshape(-1).view(np.uint8).tobytes()
+
+    def unpublish(self, volume_id: str) -> None:
+        self.feeder.unpublish(volume_id)
+
+    def swap_backend(self, backend) -> None:
+        self.service.backend = backend
+
+    def close(self) -> None:
+        pass
+
+
+class RemoteTransport:
+    name = "remote"
+
+    def __init__(self):
+        db = MemRegistryDB()
+        self.registry = registry_server(
+            "tcp://localhost:0", RegistryService(db=db))
+        self.service = ControllerService(MallocBackend())
+        self.controller = controller_server("tcp://localhost:0", self.service)
+        db.set("host-0/address", self.controller.addr)
+        db.set("host-0/mesh", "0,0,0")
+        self.feeder = Feeder(
+            registry_address=self.registry.addr, controller_id="host-0")
+
+    def publish(self, req, timeout: float = 30.0):
+        return self.feeder.publish(req, timeout=timeout)
+
+    def read(self, volume_id: str) -> bytes:
+        return self.feeder.fetch(volume_id, timeout=30.0).tobytes()
+
+    def unpublish(self, volume_id: str) -> None:
+        self.feeder.unpublish(volume_id)
+
+    def swap_backend(self, backend) -> None:
+        self.service.backend = backend
+
+    def close(self) -> None:
+        self.registry.force_stop()
+        self.controller.force_stop()
+
+
+class DaemonTransport(RemoteTransport):
+    name = "daemon"
+
+    def __init__(self):
+        import grpc
+
+        super().__init__()
+        self.daemon = feeder_server(
+            "tcp://localhost:0", FeederDaemon(self.feeder))
+        self._channel = grpc.insecure_channel(self.daemon.addr)
+        self.stub = FeederStub(self._channel)
+
+    def _map_rpc_error(self, err):
+        import grpc
+
+        if err.code() == grpc.StatusCode.DEADLINE_EXCEEDED or (
+                "Deadline" in (err.details() or "")):
+            return DeadlineExceeded(err.details())
+        return PublishError(err.details() or str(err))
+
+    def publish(self, req, timeout: float = 30.0):
+        import grpc
+
+        try:
+            return self.stub.PublishVolume(
+                pb.PublishVolumeRequest(map=req, timeout_seconds=timeout),
+                timeout=timeout + 10,
+            )
+        except grpc.RpcError as err:
+            raise self._map_rpc_error(err) from None
+
+    def read(self, volume_id: str) -> bytes:
+        import grpc
+
+        try:
+            chunks = list(self.stub.ReadPublished(
+                pb.ReadVolumeRequest(volume_id=volume_id), timeout=30))
+        except grpc.RpcError as err:
+            raise self._map_rpc_error(err) from None
+        return b"".join(c.data for c in chunks)
+
+    def unpublish(self, volume_id: str) -> None:
+        import grpc
+
+        try:
+            self.stub.UnpublishVolume(
+                pb.UnpublishVolumeRequest(volume_id=volume_id), timeout=30)
+        except grpc.RpcError as err:
+            raise self._map_rpc_error(err) from None
+
+    def close(self) -> None:
+        self._channel.close()
+        self.daemon.force_stop()
+        super().close()
+
+
+@pytest.fixture(params=[LocalTransport, RemoteTransport, DaemonTransport],
+                ids=["local", "remote", "daemon"])
+def transport(request):
+    t = request.param()
+    yield t
+    t.close()
+
+
+class TestFeederConformance:
+    """The sanity battery. Every test body is identical across transports."""
+
+    def test_publish_and_read_file_volume(self, transport, tmp_path):
+        data = np.random.RandomState(0).bytes(4096)
+        path = tmp_path / "v.bin"
+        path.write_bytes(data)
+        transport.publish(pb.MapVolumeRequest(
+            volume_id="vol-f",
+            file=pb.FileParams(path=str(path), format="raw"),
+        ))
+        assert transport.read("vol-f") == data
+
+    def test_publish_is_idempotent(self, transport, tmp_path):
+        data = b"x" * 512
+        path = tmp_path / "i.bin"
+        path.write_bytes(data)
+        req = pb.MapVolumeRequest(
+            volume_id="vol-i",
+            file=pb.FileParams(path=str(path), format="raw"),
+        )
+        transport.publish(req)
+        transport.publish(req)  # second publish with same params succeeds
+        assert transport.read("vol-i") == data
+
+    def test_conflicting_params_rejected(self, transport, tmp_path):
+        (tmp_path / "a.bin").write_bytes(b"a" * 64)
+        (tmp_path / "b.bin").write_bytes(b"b" * 64)
+        transport.publish(pb.MapVolumeRequest(
+            volume_id="vol-c",
+            file=pb.FileParams(path=str(tmp_path / "a.bin"), format="raw"),
+        ))
+        with pytest.raises(PublishError):
+            transport.publish(pb.MapVolumeRequest(
+                volume_id="vol-c",
+                file=pb.FileParams(path=str(tmp_path / "b.bin"), format="raw"),
+            ))
+
+    def test_missing_source_surfaces_error(self, transport):
+        with pytest.raises(PublishError):
+            transport.publish(pb.MapVolumeRequest(
+                volume_id="ghost", malloc=pb.MallocParams()))
+
+    def test_unpublish_idempotent(self, transport, tmp_path):
+        (tmp_path / "u.bin").write_bytes(b"u" * 128)
+        transport.publish(pb.MapVolumeRequest(
+            volume_id="vol-u",
+            file=pb.FileParams(path=str(tmp_path / "u.bin"), format="raw"),
+        ))
+        transport.unpublish("vol-u")
+        transport.unpublish("vol-u")  # second unpublish is a no-op
+        assert transport.service.get_volume("vol-u") is None
+
+    def test_republish_after_unpublish(self, transport, tmp_path):
+        (tmp_path / "r.bin").write_bytes(b"r" * 256)
+        req = pb.MapVolumeRequest(
+            volume_id="vol-r",
+            file=pb.FileParams(path=str(tmp_path / "r.bin"), format="raw"),
+        )
+        transport.publish(req)
+        transport.unpublish("vol-r")
+        transport.publish(req)
+        assert transport.read("vol-r") == b"r" * 256
+
+    def test_spec_shapes_the_volume(self, transport, tmp_path):
+        vals = np.arange(64, dtype=np.int32)
+        path = tmp_path / "s.bin"
+        path.write_bytes(vals.tobytes())
+        reply = transport.publish(pb.MapVolumeRequest(
+            volume_id="vol-s",
+            spec=pb.ArraySpec(shape=[8, 8], dtype="int32"),
+            file=pb.FileParams(path=str(path), format="raw"),
+        ))
+        assert transport.read("vol-s") == vals.tobytes()
+        if hasattr(reply, "placement"):  # daemon reply proto
+            assert reply.placement.bytes == vals.nbytes
+        else:  # library PublishedVolume
+            assert reply.bytes == vals.nbytes
+
+    def test_deadline_exceeded_when_never_ready(self, transport):
+        transport.swap_backend(StuckBackend())
+        with pytest.raises(DeadlineExceeded):
+            transport.publish(
+                pb.MapVolumeRequest(volume_id="stuck", malloc=pb.MallocParams()),
+                timeout=0.5,
+            )

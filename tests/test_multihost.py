@@ -1,0 +1,233 @@
+"""Multi-host execution (VERDICT r2 #2): TWO controllers behind one
+registry, and the registry-elected ``jax.distributed`` rendezvous actually
+firing — two real trainer processes (4 virtual CPU devices each) complete a
+global 8-device DP step with identical loss.
+
+This is the one multi-chip-correctness frontier the driver's single-process
+dryrun cannot see (reference analog: the 4-node QEMU cluster,
+test/e2e/e2e.go:41-183, node steering test/test-config.sh:50-57)."""
+
+from __future__ import annotations
+
+import os
+import re
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from oim_tpu.common.cmdmonitor import CmdMonitor, monitored_popen
+from oim_tpu.common.tlsutil import load_tls, secure_channel
+from oim_tpu.spec import RegistryStub, pb
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def child_env(devices: int = 0) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    if devices:
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devices}"
+    return env
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    from oim_tpu.common.ca import CertAuthority
+
+    d = tmp_path_factory.mktemp("mh-ca")
+    ca = CertAuthority("oim-mh-ca")
+    for cn in ("component.registry", "controller.host-0", "controller.host-1",
+               "host.host-0", "host.host-1", "user.admin"):
+        ca.write_files(str(d), cn)
+    return d
+
+
+class TwoHostCluster:
+    """Registry + TWO controllers as monitored child processes — the proxy
+    routes by ``controllerid`` metadata between two registered IDs."""
+
+    def __init__(self, certs):
+        self.certs = certs
+        self.registry_port = free_port()
+        self.controller_ports = [free_port(), free_port()]
+        self.procs: list[subprocess.Popen] = []
+        self.monitors: dict[str, CmdMonitor] = {}
+        self._spawn(
+            "registry", "oim_tpu.cli.oim_registry",
+            "--endpoint", f"tcp://127.0.0.1:{self.registry_port}",
+            "--ca", f"{certs}/ca.crt", "--key", f"{certs}/component.registry",
+        )
+        for i, port in enumerate(self.controller_ports):
+            self._spawn(
+                f"controller-{i}", "oim_tpu.cli.oim_controller",
+                "--endpoint", f"tcp://127.0.0.1:{port}",
+                "--controller-id", f"host-{i}",
+                "--controller-address", f"127.0.0.1:{port}",
+                "--registry", f"127.0.0.1:{self.registry_port}",
+                "--registry-delay", "1", "--backend", "malloc",
+                "--mesh-coord", f"{i},0,0",
+                "--ca", f"{certs}/ca.crt",
+                "--key", f"{certs}/controller.host-{i}",
+            )
+
+    def _spawn(self, name: str, module: str, *args) -> None:
+        proc, monitor = monitored_popen(
+            [sys.executable, "-m", module, *args],
+            env=child_env(),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        self.procs.append(proc)
+        self.monitors[name] = monitor
+
+    def admin_stub(self):
+        tls = load_tls(
+            f"{self.certs}/ca.crt", f"{self.certs}/user.admin",
+            "component.registry",
+        )
+        return RegistryStub(
+            secure_channel(f"127.0.0.1:{self.registry_port}", tls))
+
+    def wait_ready(self, timeout: float = 120.0) -> None:
+        # Generous: the full suite can run this module on a machine already
+        # saturated by other JAX compiles; child startup is CPU-starved.
+        stub = self.admin_stub()
+        deadline = time.monotonic() + timeout
+        want = {"host-0/address", "host-1/address"}
+        while time.monotonic() < deadline:
+            try:
+                reply = stub.GetValues(pb.GetValuesRequest(path=""), timeout=2)
+                if want <= {v.path for v in reply.values}:
+                    return
+            except Exception:
+                pass
+            time.sleep(0.2)
+        raise TimeoutError("two-host cluster never fully registered")
+
+    def shutdown(self) -> None:
+        for proc in self.procs:
+            proc.terminate()
+        for proc in self.procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+@pytest.fixture(scope="module")
+def cluster(certs):
+    c = TwoHostCluster(certs)
+    try:
+        c.wait_ready()
+        yield c
+    finally:
+        c.shutdown()
+
+
+class TestProxyRoutingBetweenTwoIDs:
+    def test_volumes_route_to_their_controller(self, cluster, tmp_path):
+        """Same registry, two controller IDs: each feeder's MapVolume must
+        land on ITS controller (metadata-routed per-call dial), and the data
+        windows must read back each controller's own bytes."""
+        from oim_tpu.feeder import Feeder
+
+        payloads = {}
+        feeders = {}
+        for i in range(2):
+            data = np.random.RandomState(10 + i).bytes(4096)
+            path = tmp_path / f"vol-{i}.bin"
+            path.write_bytes(data)
+            payloads[i] = data
+            tls = load_tls(
+                f"{cluster.certs}/ca.crt", f"{cluster.certs}/host.host-{i}",
+                "component.registry",
+            )
+            feeders[i] = Feeder(
+                registry_address=f"127.0.0.1:{cluster.registry_port}",
+                controller_id=f"host-{i}", tls=tls,
+            )
+            feeders[i].publish(pb.MapVolumeRequest(
+                volume_id="routed-vol",
+                file=pb.FileParams(path=str(path), format="raw"),
+            ), timeout=30)
+        # SAME volume id on both controllers: reads must not cross.
+        for i in range(2):
+            got = feeders[i].fetch("routed-vol", timeout=30)
+            assert got.tobytes() == payloads[i], f"host-{i} got wrong bytes"
+
+    def test_wrong_identity_rejected_for_second_controller(self, cluster):
+        """host-0's cert must not reach host-1 through the proxy (CN
+        authorization per target ID, registry.go:176-184 analog)."""
+        from oim_tpu.feeder import Feeder
+        from oim_tpu.feeder.driver import PublishError
+
+        tls = load_tls(
+            f"{cluster.certs}/ca.crt", f"{cluster.certs}/host.host-0",
+            "component.registry",
+        )
+        feeder = Feeder(
+            registry_address=f"127.0.0.1:{cluster.registry_port}",
+            controller_id="host-1", tls=tls,
+        )
+        with pytest.raises(PublishError):
+            feeder.publish(pb.MapVolumeRequest(
+                volume_id="x", malloc=pb.MallocParams()), timeout=10)
+
+
+class TestDistributedTrainer:
+    def test_two_process_global_dp_step(self, cluster, tmp_path):
+        """THE multi-host path, executed: two oim-trainer processes, each
+        4 virtual CPU devices, wait for both controllers, derive ranks from
+        the topology (host-0 -> rank 0), jax.distributed.initialize over a
+        registry-elected coordinator, and train a global data=8 mesh for 2
+        steps — both processes must finish with the SAME loss."""
+        tokens = np.random.RandomState(0).randint(0, 256, 8 * 33 * 4)
+        path = tmp_path / "tokens.bin"
+        tokens.astype(np.int32).tofile(path)
+        coord_port = free_port()
+
+        procs = []
+        for i in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "oim_tpu.cli.oim_trainer",
+                 "--platform", "cpu", "--model", "llama-tiny",
+                 "--steps", "2", "--batch-size", "8", "--seq-len", "32",
+                 "--log-every", "1", "--warmup-steps", "1",
+                 "--mesh", "data=8",
+                 "--registry", f"127.0.0.1:{cluster.registry_port}",
+                 "--controller-id", f"host-{i}",
+                 "--expected-hosts", "2",
+                 "--coordinator-port", str(coord_port),
+                 "--volume", "mh-tokens", "--volume-file", str(path),
+                 "--feed-window-bytes", "0",
+                 "--ca", f"{cluster.certs}/ca.crt",
+                 "--key", f"{cluster.certs}/host.host-{i}"],
+                env=child_env(devices=4),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            ))
+        outs = []
+        for i, proc in enumerate(procs):
+            out, _ = proc.communicate(timeout=600)
+            outs.append(out)
+            assert proc.returncode == 0, f"rank {i} failed:\n{out[-4000:]}"
+
+        losses = []
+        for i, out in enumerate(outs):
+            m = re.search(rf"process_id: {i}\b.*num_processes: 2", out)
+            assert m, f"rank {i} never initialized jax.distributed:\n{out[-2000:]}"
+            mloss = re.findall(r"final_loss: ([0-9.]+)", out)
+            assert mloss, f"rank {i} printed no final loss:\n{out[-2000:]}"
+            losses.append(float(mloss[-1]))
+        assert losses[0] == losses[1], (
+            f"global DP step diverged between ranks: {losses}"
+        )
