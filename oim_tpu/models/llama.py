@@ -335,8 +335,18 @@ def make_pipelined_loss(mesh, cfg: Config, n_microbatches: int,
         y, aux = pipe_fn(params["layers"], x)
         y = y.reshape(B, T, cfg.dim)
         y = rmsnorm(y, params["final_norm"])
-        logits = (y @ params["lm_head"]).astype(jnp.float32)
-        loss = softmax_cross_entropy(logits, tokens[:, 1:], ignore_index)
+        if cfg.vocab_chunk:
+            # Same chunked-vocab CE as the non-pipelined path: the
+            # [B, T, vocab] logits never materialize — at 128k vocab that
+            # is the step's biggest activation, and --rules pipe is exactly
+            # where HBM pressure peaks (ADVICE r2 #1).
+            loss = chunked_softmax_cross_entropy(
+                y, params["lm_head"], tokens[:, 1:], cfg.vocab_chunk,
+                ignore_index,
+            )
+        else:
+            logits = (y @ params["lm_head"]).astype(jnp.float32)
+            loss = softmax_cross_entropy(logits, tokens[:, 1:], ignore_index)
         if cfg.n_experts:
             loss = loss + cfg.moe_aux_weight * aux
         return loss

@@ -86,12 +86,17 @@ TP_SP_RULES = ShardingRules.of(
 
 # GPipe pipeline parallelism: the stacked layer axis is split over "pipe"
 # (parallel/pipeline.py streams microbatches through the stages); the batch
-# still splits over "data" for DP x PP. Embeddings/head replicate — they run
-# outside the pipelined stack.
+# still splits over "data" for DP x PP. Embed/lm_head run outside the
+# pipelined stack but shard their VOCAB dimension over the same "pipe" axis:
+# at llama3-8b scale those two tables are ~1.5B params, and replicating them
+# per stage would defeat the memory point of pipelining (VERDICT r2 #6) —
+# each stage persists only its vocab/P slice and XLA inserts the gather/
+# reduce collectives at the (un-pipelined) ends of the step.
 PIPE_RULES = ShardingRules.of(
     **{
         BATCH: "data",
         LAYER: "pipe",
+        VOCAB: "pipe",
     }
 )
 

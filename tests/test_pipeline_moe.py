@@ -128,7 +128,45 @@ class TestLlamaPipeline:
             mesh, PIPE_RULES, llama.param_logical_axes(cfg)
         )
         assert shardings["layers"]["wq"].spec[0] == "pipe"
-        assert all(a is None for a in shardings["embed"].spec)  # replicated
+        # embed/lm_head persist vocab-sharded over the pipe axis (never a
+        # full 1.5B-param replica per stage at 8B scale; VERDICT r2 #6).
+        assert shardings["embed"].spec[0] == "pipe"
+        assert shardings["lm_head"].spec[1] == "pipe"
+
+    def test_pipelined_chunked_ce_matches_sequential(self):
+        """cfg.vocab_chunk routes the pipelined loss through the chunked-
+        vocab CE: same value/grads as the materialized-logits path."""
+        import dataclasses
+
+        cfg = llama.tiny(n_layers=4)
+        chunked = dataclasses.replace(cfg, vocab_chunk=64)
+        mesh = build_mesh([("data", 2), ("pipe", 4)])
+        params = llama.init(jax.random.PRNGKey(4), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(5), (4, 17), 0, cfg.vocab)
+
+        plain = jax.jit(llama.make_pipelined_loss(mesh, cfg, n_microbatches=2))
+        chunk = jax.jit(llama.make_pipelined_loss(mesh, chunked, n_microbatches=2))
+        np.testing.assert_allclose(
+            float(chunk(params, tokens)), float(plain(params, tokens)), rtol=1e-5
+        )
+        g_plain = jax.jit(jax.grad(
+            llama.make_pipelined_loss(mesh, cfg, n_microbatches=2)))(params, tokens)
+        g_chunk = jax.jit(jax.grad(
+            llama.make_pipelined_loss(mesh, chunked, n_microbatches=2)))(params, tokens)
+        for name in ("embed", "lm_head"):
+            np.testing.assert_allclose(
+                np.asarray(g_chunk[name]), np.asarray(g_plain[name]), atol=2e-5
+            )
+
+    def test_model_overrides_shrink_8b_config(self):
+        cfg = TrainConfig(
+            model="llama3-8b", rules="pipe",
+            model_overrides=dict(dim=256, n_layers=2, n_heads=4,
+                                 n_kv_heads=2, head_dim=64, mlp_dim=512),
+        )
+        mcfg = cfg.model_config()
+        assert mcfg.vocab == 128256 and mcfg.vocab_chunk == 16384
+        assert mcfg.n_layers == 2 and mcfg.dim == 256
 
     def test_pipelined_moe_loss_matches_sequential(self):
         # Generous capacity so no tokens drop: the model OUTPUT (hence the
