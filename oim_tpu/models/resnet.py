@@ -40,6 +40,12 @@ class Config:
     # (jax.checkpoint per block): extra fwd FLOPs for per-block activation
     # memory — lets large per-chip batches fit without XLA's forced remat.
     remat: bool = False
+    # Space-to-depth stem (the MLPerf-ResNet TPU trick): rewrite the
+    # 7x7/stride-2 conv over 3 channels — a poor MXU mapping (C_in=3 pads
+    # to the 128-lane tile) — as an equivalent 4x4/stride-1 conv over the
+    # 2x2-blocked 12-channel input. The fold happens at APPLY time from the
+    # same [7,7,3,64] parameters, so checkpoints/grads are unchanged.
+    stem_s2d: bool = False
 
 
 def _conv_init(rng, kh, kw, cin, cout, dtype):
@@ -161,11 +167,41 @@ def _bottleneck(x, p, s, stride, training, momentum, eps):
     return jax.nn.relu(y + x), new_s
 
 
+def _space_to_depth(x):
+    """[N, H, W, C] -> [N, H/2, W/2, 4C] with channel order (dy, dx, c)."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // 2, 2, w // 2, 2, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2, 4 * c)
+
+
+def _fold_stem_kernel(k):
+    """[7,7,Cin,Cout] stride-2 kernel -> the equivalent [4,4,4Cin,Cout]
+    stride-1 kernel over the space-to-depth'd input.
+
+    Derivation: out[oi] = sum_k x[2*oi + k - 2] K[k] (SAME pad_lo=2); with
+    k = 2a + dy (a in 0..3, dy in {0,1}) the tap reads s2d row oi + a - 1,
+    channel slot dy — so pad K by one trailing zero per spatial dim and
+    regroup (a, dy, b, dx, c) into the s2d channel order. The conv then
+    runs at stride 1 with padding (1, 2).
+    """
+    kh, kw, cin, cout = k.shape
+    kp = jnp.pad(k, ((0, 8 - kh), (0, 8 - kw), (0, 0), (0, 0)))
+    kp = kp.reshape(4, 2, 4, 2, cin, cout)
+    return kp.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * cin, cout)
+
+
 def apply(params, state, images, cfg: Config = Config(), training: bool = False):
     """images: [N, H, W, 3] (any float dtype). Returns (logits_f32, new_state)."""
     x = images.astype(cfg.dtype)
     new_state: dict = {}
-    x = _conv(x, params["stem"], stride=2)
+    if cfg.stem_s2d:
+        x = jax.lax.conv_general_dilated(
+            _space_to_depth(x), _fold_stem_kernel(params["stem"]),
+            window_strides=(1, 1), padding=((1, 2), (1, 2)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+    else:
+        x = _conv(x, params["stem"], stride=2)
     x, new_state["bn_stem"] = _batchnorm(
         x, params["bn_stem"], state["bn_stem"], training, cfg.bn_momentum, cfg.bn_eps)
     x = jax.nn.relu(x)
