@@ -1,0 +1,47 @@
+# Top-level gate (reference Makefile:48-75 + test/test.make discipline):
+# `make test` chains lint, spec-drift, the native build, the TSAN stream
+# test, and the full pytest suite — one command answers "is the tree good".
+#
+# `make demo` / `make start` / `make stop` run the local demo cluster
+# (reference test/start-stop.make:1-92): certs + registry + controller +
+# feeder daemon on localhost, with the README quickstart driven end to end.
+
+PY ?= python
+RUFF := $(shell command -v ruff 2>/dev/null)
+
+.PHONY: test pytest lint drift native tsan demo start stop clean
+
+test: lint drift native tsan pytest
+
+pytest:
+	$(PY) -m pytest tests/ -q
+
+drift:
+	$(PY) -m pytest tests/test_common.py -q -k SpecDrift
+
+lint:
+ifdef RUFF
+	ruff check .
+else
+	$(PY) scripts/lint.py
+endif
+
+native:
+	$(MAKE) -C native
+
+tsan:
+	$(MAKE) -C native tsan
+	$(PY) -m pytest tests/test_staging.py -q -k thread_sanitizer
+
+demo:
+	bash scripts/demo_cluster.sh demo
+
+start:
+	bash scripts/demo_cluster.sh start
+
+stop:
+	bash scripts/demo_cluster.sh stop
+
+clean:
+	$(MAKE) -C native clean
+	rm -rf _demo

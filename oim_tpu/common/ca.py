@@ -90,7 +90,8 @@ class CertAuthority:
         )
         return key_pem, cert.public_bytes(serialization.Encoding.PEM)
 
-    def write_files(self, directory: str | Path, common_name: str, basename: str | None = None) -> Path:
+    def write_files(self, directory: str | Path, common_name: str,
+                    basename: str | None = None) -> Path:
         """Write <basename>.key/.crt (plus ca.crt) and return the key prefix path."""
         directory = Path(directory)
         directory.mkdir(parents=True, exist_ok=True)

@@ -53,7 +53,9 @@ class CmdMonitor:
         self._thread.start()
 
 
-def monitored_popen(args, on_death: Callable[[], None] | None = None, **kwargs) -> tuple[subprocess.Popen, CmdMonitor]:
+def monitored_popen(
+    args, on_death: Callable[[], None] | None = None, **kwargs
+) -> tuple[subprocess.Popen, CmdMonitor]:
     """Spawn a subprocess with a death monitor attached."""
     monitor = CmdMonitor()
     pass_fds = tuple(kwargs.pop("pass_fds", ())) + (monitor.child_fd,)

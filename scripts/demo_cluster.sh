@@ -1,0 +1,109 @@
+#!/usr/bin/env bash
+# Local demo cluster (reference test/start-stop.make:1-92): certs, registry,
+# controller, feeder daemon — all on localhost with real mTLS.
+#
+#   scripts/demo_cluster.sh start   # bring the cluster up (PID files in _demo/)
+#   scripts/demo_cluster.sh stop    # tear it down
+#   scripts/demo_cluster.sh demo    # start, drive the README quickstart, stop
+#
+# Logs land in _demo/*.log (the reference keeps demo logs under _work/,
+# README.md:443-447).
+set -euo pipefail
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+DEMO="$REPO/_demo"
+CA="$DEMO/ca"
+PY="${PY:-python}"
+REGISTRY_PORT="${OIM_DEMO_REGISTRY_PORT:-9421}"
+CONTROLLER_PORT="${OIM_DEMO_CONTROLLER_PORT:-9422}"
+FEEDER_PORT="${OIM_DEMO_FEEDER_PORT:-9423}"
+export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+export JAX_PLATFORMS="${OIM_DEMO_PLATFORM:-cpu}"
+
+certs() {
+    [ -f "$CA/ca.crt" ] && return
+    mkdir -p "$CA"
+    "$PY" -c "
+from oim_tpu.common.ca import CertAuthority
+ca = CertAuthority('oim-demo-ca')
+for cn in ['component.registry', 'controller.host-0', 'host.host-0',
+           'user.admin']:
+    ca.write_files('$CA', cn)
+print('certs written to $CA')"
+}
+
+spawn() { # name, args...
+    local name="$1"; shift
+    nohup "$@" >"$DEMO/$name.log" 2>&1 &
+    echo $! >"$DEMO/$name.pid"
+    echo "started $name (pid $(cat "$DEMO/$name.pid"), log _demo/$name.log)"
+}
+
+start() {
+    mkdir -p "$DEMO"
+    certs
+    spawn registry "$PY" -m oim_tpu.cli.oim_registry \
+        --endpoint "tcp://127.0.0.1:$REGISTRY_PORT" \
+        --ca "$CA/ca.crt" --key "$CA/component.registry"
+    spawn controller "$PY" -m oim_tpu.cli.oim_controller \
+        --endpoint "tcp://127.0.0.1:$CONTROLLER_PORT" \
+        --controller-id host-0 \
+        --controller-address "127.0.0.1:$CONTROLLER_PORT" \
+        --registry "127.0.0.1:$REGISTRY_PORT" --registry-delay 5 \
+        --backend "${OIM_DEMO_BACKEND:-malloc}" --mesh-coord 0,0,0 \
+        --ca "$CA/ca.crt" --key "$CA/controller.host-0"
+    spawn feeder "$PY" -m oim_tpu.cli.oim_feeder \
+        --endpoint "tcp://127.0.0.1:$FEEDER_PORT" \
+        --registry "127.0.0.1:$REGISTRY_PORT" --controller-id host-0 \
+        --ca "$CA/ca.crt" --key "$CA/host.host-0"
+    # Ready when the controller has self-registered.
+    for _ in $(seq 1 50); do
+        if "$PY" -m oim_tpu.cli.oimctl --registry "127.0.0.1:$REGISTRY_PORT" \
+            --ca "$CA/ca.crt" --key "$CA/user.admin" --get host-0 \
+            2>/dev/null | grep -q "host-0/address"; then
+            echo "cluster ready: registry :$REGISTRY_PORT, controller :$CONTROLLER_PORT, feeder :$FEEDER_PORT"
+            return 0
+        fi
+        sleep 0.3
+    done
+    echo "cluster did not become ready; see _demo/*.log" >&2
+    exit 1
+}
+
+stop() {
+    local name pid
+    for name in feeder controller registry; do
+        if [ -f "$DEMO/$name.pid" ]; then
+            pid="$(cat "$DEMO/$name.pid")"
+            kill "$pid" 2>/dev/null && echo "stopped $name (pid $pid)" || true
+            rm -f "$DEMO/$name.pid"
+        fi
+    done
+}
+
+quickstart() {
+    echo "== topology (oimctl) =="
+    "$PY" -m oim_tpu.cli.oimctl --registry "127.0.0.1:$REGISTRY_PORT" \
+        --ca "$CA/ca.crt" --key "$CA/user.admin" --get host-0
+    echo "== fed training (publish + ReadVolume window) =="
+    "$PY" -c "import numpy as np; np.save('$DEMO/tokens.npy',
+        np.random.randint(0, 256, 65536).astype(np.int32))"
+    "$PY" -m oim_tpu.cli.oim_trainer --platform "$JAX_PLATFORMS" \
+        --model llama-tiny --steps 5 --batch-size 2 --seq-len 32 \
+        --log-every 1 --warmup-steps 1 --mesh data=1 \
+        --registry "127.0.0.1:$REGISTRY_PORT" --controller-id host-0 \
+        --volume demo-tokens --volume-file "$DEMO/tokens.npy" \
+        --ca "$CA/ca.crt" --key "$CA/host.host-0"
+    echo "== demo OK =="
+}
+
+case "${1:-demo}" in
+    start) start ;;
+    stop) stop ;;
+    demo)
+        trap stop EXIT
+        start
+        quickstart
+        ;;
+    *) echo "usage: $0 {start|stop|demo}" >&2; exit 2 ;;
+esac

@@ -3,7 +3,8 @@
 
 Mirrors the reference's spec-as-markdown discipline (/root/reference/Makefile:78-103):
 spec.md is the single source of truth; the extracted .proto and the generated
-oim_pb2.py are committed, and tests/test_common.py::TestSpecDrift::test_proto_matches_spec_md fails if they drift.
+oim_pb2.py are committed; tests/test_common.py::TestSpecDrift fails if
+they drift.
 """
 import re
 import subprocess

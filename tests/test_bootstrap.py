@@ -58,7 +58,7 @@ def test_wait_for_hosts_converges():
     of the reference's soft-state convergence, controller_test.go:107-127)."""
     from oim_tpu.registry.db import MemRegistryDB
     from oim_tpu.registry.registry import RegistryService, registry_server
-    from oim_tpu.spec import RegistryStub, pb
+    from oim_tpu.spec import RegistryStub
 
     import grpc
 
