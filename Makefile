@@ -11,7 +11,9 @@ RUFF := $(shell command -v ruff 2>/dev/null)
 
 .PHONY: test pytest lint drift native tsan demo start stop clean
 
-test: lint drift native tsan pytest
+# drift and tsan are standalone conveniences; the full pytest target
+# already runs both (SpecDrift + the TSAN stream test build in-fixture).
+test: lint native pytest
 
 pytest:
 	$(PY) -m pytest tests/ -q

@@ -123,7 +123,7 @@ def feeder_batches(args, cfg: TrainConfig, tls):
 
     # The first window also carries the volume's ArraySpec (dtype/shape).
     w, total, spec = feeder.fetch_window(
-        args.volume, 0, window, timeout=args.publish_timeout
+        args.volume, 0, window, timeout=args.publish_timeout, heal=True
     )
     dt = (np.dtype(spec_dtype(spec))
           if spec is not None and spec.dtype else np.dtype(np.uint8))
@@ -170,7 +170,8 @@ def feeder_batches(args, cfg: TrainConfig, tls):
             offset = 0
             carry = carry[:(carry.size // rec_bytes) * rec_bytes]
         w, total, _ = feeder.fetch_window(
-            args.volume, offset, window, timeout=args.publish_timeout
+            args.volume, offset, window, timeout=args.publish_timeout,
+            heal=True,
         )
         offset += w.size
 
@@ -287,7 +288,7 @@ def _webdataset_token_batches(args, cfg: TrainConfig, feeder, pub, urls):
         for i, size in enumerate(sizes):
             shard, total, _ = feeder.fetch_window(
                 args.volume, int(offsets[i]), int(size),
-                timeout=args.publish_timeout,
+                timeout=args.publish_timeout, heal=True,
             )
             if not checked:
                 # Offsets were recomputed from the URLs at feed time; if a
@@ -322,6 +323,36 @@ def _webdataset_token_batches(args, cfg: TrainConfig, feeder, pub, urls):
         # frames rows identically (whole-volume mode truncates once up
         # front; without this the tail would shift all framing each epoch).
         carry = carry[:0]
+
+
+_DECODE_POOL = None
+
+
+def _decode_pool():
+    """Shared thread pool for image decode: Pillow releases the GIL during
+    JPEG decode, so the feed decodes a window's images in parallel instead
+    of one-at-a-time between train steps."""
+    global _DECODE_POOL
+    if _DECODE_POOL is None:
+        import concurrent.futures
+        import os
+
+        _DECODE_POOL = concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(8, os.cpu_count() or 4),
+            thread_name_prefix="oim-image-decode",
+        )
+    return _DECODE_POOL
+
+
+def _decode_examples(records, cfg: TrainConfig, volume: str):
+    """Parallel (order-preserving) decode of serialized tf.Examples ->
+    [(image f32, label int)]."""
+    from oim_tpu.data import readers
+
+    def one(rec):
+        return _example_to_sample(readers.parse_example(rec), cfg, volume)
+
+    return list(_decode_pool().map(one, records))
 
 
 def _example_to_sample(ex: dict, cfg: TrainConfig, volume: str):
@@ -363,14 +394,12 @@ def _tfrecord_image_batches(args, cfg: TrainConfig, feeder, pub):
     if window <= 0:
         data = (np.asarray(pub.array) if pub.array is not None
                 else feeder.fetch(args.volume, timeout=args.publish_timeout))
-        images, labels = [], []
-        for rec in readers.iter_tfrecord_bytes(data):
-            im, lab = _example_to_sample(
-                readers.parse_example(rec), cfg, args.volume)
-            images.append(im)
-            labels.append(lab)
-        if not images:
+        samples = _decode_examples(
+            list(readers.iter_tfrecord_bytes(data)), cfg, args.volume)
+        if not samples:
             raise SystemExit(f"volume {args.volume!r} holds no tf.Examples")
+        images = [im for im, _ in samples]
+        labels = [lab for _, lab in samples]
         images = np.stack(images)
         labels = np.asarray(labels, np.int32)
         from_context().info(
@@ -392,15 +421,16 @@ def _tfrecord_image_batches(args, cfg: TrainConfig, feeder, pub):
     offset, produced = 0, False
     while True:
         w, total, _ = feeder.fetch_window(
-            args.volume, offset, window, timeout=args.publish_timeout
+            args.volume, offset, window, timeout=args.publish_timeout,
+            heal=True,
         )
         offset += w.size
         w8 = np.asarray(w, np.uint8)
         carry = np.concatenate([carry, w8]) if carry.size else w8
         cut = readers.complete_tfrecord_prefix(carry)
-        for rec in readers.iter_tfrecord_bytes(carry[:cut]):
-            im, lab = _example_to_sample(
-                readers.parse_example(rec), cfg, args.volume)
+        for im, lab in _decode_examples(
+                list(readers.iter_tfrecord_bytes(carry[:cut])), cfg,
+                args.volume):
             imgs.append(im)
             labs.append(lab)
         carry = carry[cut:]
@@ -422,22 +452,29 @@ def _tfrecord_image_batches(args, cfg: TrainConfig, feeder, pub):
             offset, carry = 0, carry[:0]
 
 
-def _wds_image_sample(sample: dict, cfg: TrainConfig, imgs, labs):
+def _wds_image_sample(sample: dict, cfg: TrainConfig):
+    """jpg/cls sample -> (image f32, label) or None (no image member)."""
     from oim_tpu.data import readers
 
     payload = sample.get("jpg") or sample.get("jpeg") or sample.get("png")
     if payload is None:
-        return
+        return None
     cls = sample.get("cls")
     if cls is None:
         raise SystemExit(
             "webdataset image sample has no 'cls' member (label); "
             f"members: {sorted(sample)}"
         )
-    imgs.append(readers.resize_image(
+    return (readers.resize_image(
         readers.decode_image(payload), cfg.image_size
-    ).astype(np.float32) / 255.0)
-    labs.append(int(cls.decode().strip() or 0))
+    ).astype(np.float32) / 255.0, int(cls.decode().strip() or 0))
+
+
+def _decode_wds_samples(samples, cfg: TrainConfig, imgs, labs):
+    for out in _decode_pool().map(lambda s: _wds_image_sample(s, cfg), samples):
+        if out is not None:
+            imgs.append(out[0])
+            labs.append(out[1])
 
 
 def _webdataset_image_batches(args, cfg: TrainConfig, feeder, pub, urls):
@@ -452,8 +489,8 @@ def _webdataset_image_batches(args, cfg: TrainConfig, feeder, pub, urls):
                 else feeder.fetch(args.volume, timeout=args.publish_timeout))
         imgs: list[np.ndarray] = []
         labs: list[int] = []
-        for s in wds.iter_samples([np.asarray(data)]):
-            _wds_image_sample(s, cfg, imgs, labs)
+        _decode_wds_samples(list(wds.iter_samples([np.asarray(data)])), cfg,
+                            imgs, labs)
         if not imgs:
             raise SystemExit(
                 f"webdataset volume {args.volume!r} has no jpg/cls samples"
@@ -481,7 +518,7 @@ def _webdataset_image_batches(args, cfg: TrainConfig, feeder, pub, urls):
         for i, size in enumerate(sizes):
             shard, total, _ = feeder.fetch_window(
                 args.volume, int(offsets[i]), int(size),
-                timeout=args.publish_timeout,
+                timeout=args.publish_timeout, heal=True,
             )
             if int(offsets[-1]) != int(total):
                 raise SystemExit(
@@ -489,8 +526,8 @@ def _webdataset_image_batches(args, cfg: TrainConfig, feeder, pub, urls):
                     f"{total} bytes but the shard URLs now sum to "
                     f"{int(offsets[-1])} — shards changed since staging?"
                 )
-            for s in wds.iter_samples([np.asarray(shard)]):
-                _wds_image_sample(s, cfg, imgs, labs)
+            _decode_wds_samples(
+                list(wds.iter_samples([np.asarray(shard)])), cfg, imgs, labs)
             while len(imgs) >= cfg.batch_size:
                 produced = True
                 yield {

@@ -56,6 +56,7 @@ class PublishedVolume:
     handle: str
     array: Any = None  # populated in local mode
     params_key: bytes = b""  # request fingerprint for idempotency checks
+    request: Any = None  # the original MapVolumeRequest (heal re-publish)
 
 
 class Feeder:
@@ -138,6 +139,7 @@ class Feeder:
             else:
                 published = self._publish_remote(request, deadline)
             published.params_key = params_key
+            published.request = request
             with self._lock:
                 self._published[request.volume_id] = published
             from_context().info(
@@ -287,8 +289,10 @@ class Feeder:
         finally:
             channel.close()
 
+    RECOVERABLE = ("UNAVAILABLE", "NOT_FOUND", "no volume")
+
     def fetch_window(self, volume_id: str, offset: int = 0, length: int = 0,
-                     timeout: float = 120.0):
+                     timeout: float = 120.0, heal: bool = False):
         """A byte range of the staged volume: (uint8 array, total_bytes,
         ArraySpec). length == 0 means "to the end".
 
@@ -296,7 +300,64 @@ class Feeder:
         smaller than the volume streams windows instead of materializing
         the whole thing host-side (the data window stays bounded the way
         the reference bounds SCSI targets, controller.go:127-148).
+
+        ``heal=True`` makes the window survive control-plane failures
+        within ``timeout``: transient UNAVAILABLE (registry/controller
+        restarting) retries with backoff, and a NOT_FOUND after a
+        controller restart — soft state lost — re-publishes the recorded
+        MapVolumeRequest (idempotent; restages from the source) and
+        retries. This is the trainer-feed path's recovery primitive: the
+        same stance as the reference's re-registration loop, applied to
+        the data window (SURVEY.md section 5.3).
         """
+        if not heal:
+            return self._fetch_window_once(volume_id, offset, length, timeout)
+        deadline = time.monotonic() + timeout
+        delay = 0.2
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise DeadlineExceeded(
+                    f"window of {volume_id!r} unavailable for {timeout}s")
+            try:
+                return self._fetch_window_once(
+                    volume_id, offset, length, remaining)
+            except DeadlineExceeded:
+                raise
+            except PublishError as err:
+                msg = str(err)
+                if not any(tag in msg for tag in self.RECOVERABLE):
+                    raise
+                if "NOT_FOUND" in msg or "no volume" in msg:
+                    # The controller restarted and lost its soft state:
+                    # restage from the recorded request (idempotent).
+                    with self._lock:
+                        pub = self._published.pop(volume_id, None)
+                    if pub is None or pub.request is None:
+                        raise
+                    try:
+                        self.publish(
+                            pub.request,
+                            timeout=max(deadline - time.monotonic(), 1.0),
+                        )
+                        from_context().info(
+                            "healed volume after controller restart",
+                            volume=volume_id,
+                        )
+                        continue  # retry the window immediately
+                    except (PublishError, grpc.RpcError):
+                        # Registry may itself be down mid-heal (raw
+                        # RpcError from the pre-publish topology read):
+                        # restore the cache entry — losing it would make
+                        # the volume permanently unhealable — and keep
+                        # backing off toward the deadline.
+                        with self._lock:
+                            self._published.setdefault(volume_id, pub)
+                time.sleep(min(delay, max(deadline - time.monotonic(), 0)))
+                delay = min(delay * 2, 5.0)
+
+    def _fetch_window_once(self, volume_id: str, offset: int, length: int,
+                           timeout: float):
         import numpy as np
 
         if self.controller is not None:

@@ -536,7 +536,17 @@ def _flash_plan(q, k) -> tuple[int, int] | None:
     tq, tk, d = q.shape[1], k.shape[1], q.shape[-1]
     if tq % 128 or tk % 128 or d % 128 or q.shape[2] % k.shape[2]:
         return None
-    return (512 if tq % 512 == 0 else 128, 512 if tk % 512 == 0 else 128)
+
+    def pick(t):
+        # Largest measured-good block the length divides: the r3 sweep on
+        # v5e (scripts/sweep_llama.py, BASELINE.md) ranked 1024 > 512 >> 256
+        # at seq 2048 (0.6974 / 0.6916 / 0.6161 MFU).
+        for b in (1024, 512, 128):
+            if t % b == 0:
+                return b
+        return 128
+
+    return pick(tq), pick(tk)
 
 
 def attention_with_lse(q, k, v, causal: bool = True, scale: float | None = None):

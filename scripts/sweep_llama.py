@@ -1,0 +1,97 @@
+#!/usr/bin/env python3
+"""Flagship-llama MFU sweep on the real chip (tuning evidence for
+BASELINE.md): flash block sizes, sequence length, batch/remat. Same
+chained-fori differencing as bench.py. Prints one line per config."""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def measure(cfg, batch, seq, attn_fn, chain_short=2, chain_long=6):
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import lax
+
+    from oim_tpu.models import llama
+    from oim_tpu.train.state import make_optimizer
+    from oim_tpu.train.trainer import peak_flops_per_device
+
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    tx = make_optimizer(lr=3e-4, warmup_steps=10, total_steps=100)
+    opt_state = tx.init(params)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, seq + 1), 0, cfg.vocab, jnp.int32)
+
+    def one_step(_, carry):
+        params, opt_state, _ = carry
+        loss, grads = jax.value_and_grad(
+            lambda p: llama.loss_fn(p, tokens, cfg, attn_fn))(params)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt, loss
+
+    def chain(params, opt_state, n):
+        return lax.fori_loop(
+            0, n, one_step, (params, opt_state, jnp.zeros((), jnp.float32)))
+
+    jchain = jax.jit(chain, donate_argnums=(0, 1))
+
+    def run(params, opt_state, n):
+        t0 = time.monotonic()
+        params, opt_state, loss = jchain(params, opt_state, n)
+        float(loss)
+        return params, opt_state, time.monotonic() - t0
+
+    params, opt_state, _ = run(params, opt_state, chain_short)
+    params, opt_state, t_s = run(params, opt_state, chain_short)
+    params, opt_state, t_l = run(params, opt_state, chain_long)
+    dt = max((t_l - t_s) / (chain_long - chain_short), 1e-9)
+    flops = llama.num_flops_per_token(cfg, seq) * batch * seq
+    return flops / dt / peak_flops_per_device(), dt
+
+
+def main():
+    import dataclasses
+
+    from oim_tpu.models import llama
+    from oim_tpu.ops.attention import flash_attention
+
+    base = llama.Config(
+        vocab=32768, dim=2048, n_layers=8, n_heads=16, n_kv_heads=8,
+        head_dim=128, mlp_dim=8192, max_seq=8192,
+    )
+
+    def attn(bq, bk):
+        return functools.partial(
+            lambda bq, bk, q, k, v, causal=True:
+                flash_attention(q, k, v, causal, None, bq, bk),
+            bq, bk)
+
+    runs = [
+        ("baseline b4 s2048 blk512",   base, 4, 2048, None),
+        ("blk 1024/1024",              base, 4, 2048, attn(1024, 1024)),
+        ("blk 1024/512",               base, 4, 2048, attn(1024, 512)),
+        ("blk 256/256",                base, 4, 2048, attn(256, 256)),
+        ("b2 s4096",                   base, 2, 4096, None),
+        ("b8 s2048 remat",
+         dataclasses.replace(base, remat=True), 8, 2048, None),
+        ("b4 s2048 remat",
+         dataclasses.replace(base, remat=True), 4, 2048, None),
+    ]
+    for name, cfg, b, s, fn in runs:
+        try:
+            mfu, dt = measure(cfg, b, s, fn)
+            print(f"{name:28s} mfu={mfu:.4f} step={dt:.4f}s "
+                  f"tok/s={b * s / dt:.0f}", flush=True)
+        except Exception as err:  # noqa: BLE001 - sweep keeps going
+            print(f"{name:28s} FAILED: {str(err)[:100]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -322,3 +322,69 @@ class TestEmulation:
         formatted = strip_secrets(req)
         assert "hunter2" not in formatted
         assert "***stripped***" in formatted
+
+
+class TestWindowHealing:
+    """fetch_window(heal=True): the data window survives a controller
+    restart (soft state lost, volume gone) by re-publishing the recorded
+    request — the reference's re-registration stance applied to the data
+    plane (SURVEY section 5.3)."""
+
+    def test_window_heals_across_controller_restart(self, tmp_path):
+        db = MemRegistryDB()
+        registry = registry_server("tcp://localhost:0", RegistryService(db=db))
+        svc1 = ControllerService(MallocBackend())
+        ctrl1 = controller_server("tcp://localhost:0", svc1)
+        db.set("host-0/address", ctrl1.addr)
+        try:
+            data = np.random.RandomState(21).bytes(50_000)
+            path = tmp_path / "heal.bin"
+            path.write_bytes(data)
+            feeder = Feeder(registry_address=registry.addr,
+                            controller_id="host-0")
+            feeder.publish(pb.MapVolumeRequest(
+                volume_id="vol-h",
+                file=pb.FileParams(path=str(path), format="raw"),
+            ))
+            w, total, _ = feeder.fetch_window("vol-h", 0, 10_000, heal=True)
+            assert w.tobytes() == data[:10_000] and total == len(data)
+
+            # Controller dies; a REPLACEMENT with empty soft state comes up
+            # at a new address and re-registers (here: db.set, the analog
+            # of the self-registration loop).
+            ctrl1.force_stop()
+            svc2 = ControllerService(MallocBackend())
+            ctrl2 = controller_server("tcp://localhost:0", svc2)
+            db.set("host-0/address", ctrl2.addr)
+            assert svc2.get_volume("vol-h") is None  # state really lost
+
+            w2, total2, _ = feeder.fetch_window(
+                "vol-h", 10_000, 10_000, timeout=30, heal=True)
+            assert w2.tobytes() == data[10_000:20_000]
+            assert total2 == len(data)
+            # Healed by RE-STAGING on the new controller, not from a cache.
+            assert svc2.get_volume("vol-h") is not None
+            ctrl2.force_stop()
+        finally:
+            registry.force_stop()
+
+    def test_no_heal_still_fails_fast(self, tmp_path):
+        db = MemRegistryDB()
+        registry = registry_server("tcp://localhost:0", RegistryService(db=db))
+        svc = ControllerService(MallocBackend())
+        ctrl = controller_server("tcp://localhost:0", svc)
+        db.set("host-0/address", ctrl.addr)
+        try:
+            feeder = Feeder(registry_address=registry.addr,
+                            controller_id="host-0")
+            with pytest.raises(PublishError):
+                feeder.fetch_window("ghost", 0, 100)  # heal=False default
+            # heal=True on a volume never published cannot re-publish: the
+            # deadline bounds the retry loop.
+            t0 = __import__("time").monotonic()
+            with pytest.raises(PublishError):
+                feeder.fetch_window("ghost", 0, 100, timeout=1.2, heal=True)
+            assert __import__("time").monotonic() - t0 < 10
+            ctrl.force_stop()
+        finally:
+            registry.force_stop()

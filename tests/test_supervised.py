@@ -232,3 +232,22 @@ class TestLabeledFeeds:
         trainer.evaluate(eval_data, n_batches=2)
         acc = trainer.last_eval_stats["accuracy"]
         assert acc > 0.5, f"eval accuracy {acc} is not above chance"
+
+
+def test_parallel_decode_preserves_order(cluster, tmp_path):
+    """The decode thread pool must keep sample order: labels follow the
+    volume's record order exactly even with many records in flight."""
+    from oim_tpu.cli.oim_trainer import feeder_batches
+
+    path = tmp_path / "big.tfrecord"
+    labels = _labeled_tfrecord(path, n=64, seed=9)
+    cfg = TrainConfig(model="resnet50", num_classes=2, image_size=16,
+                      batch_size=16)
+    feed = feeder_batches(
+        _feed_args(cluster, "vol-order", volume_tfrecord=str(path),
+                   window=2000),
+        cfg, None)
+    got = []
+    for _ in range(4):
+        got.extend(next(feed)["labels"].tolist())
+    assert got == labels

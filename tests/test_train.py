@@ -312,3 +312,38 @@ def test_eval_skipped_for_real_feed_without_eval_data():
     Trainer(cfg2, axes=[("data", 2)]).run(
         steps=2, data=synthetic_batches(cfg2), eval_data=eval_feed)
     assert M.EVAL_LOSS.value > 0
+
+
+def test_checkpoint_resume_across_topology_change(tmp_path):
+    """Elastic restart onto a DIFFERENT mesh: save under pure DP (data=8),
+    resume under data=4,fsdp=2 with FSDP-sharded params — orbax restores
+    into the new target shardings, and training continues from the saved
+    step with the exact same values (resharding must not perturb them)."""
+    ckpt = str(tmp_path / "ckpt")
+    cfg_dp = TrainConfig(
+        model="llama-tiny", rules="dp", batch_size=8, seq_len=16,
+        log_every=1, warmup_steps=1, total_steps=3,
+        checkpoint_dir=ckpt, checkpoint_every=3,
+    )
+    t1 = Trainer(cfg_dp, axes=[("data", 8)])
+    t1.run(steps=3)
+    saved = {k: np.asarray(v) for k, v in t1.state.params["layers"].items()}
+    saved_embed = np.asarray(t1.state.params["embed"])
+    t1.checkpointer.close()
+
+    import dataclasses
+
+    cfg_fsdp = dataclasses.replace(cfg_dp, rules="fsdp")
+    t2 = Trainer(cfg_fsdp, axes=[("data", 4), ("fsdp", 2)])
+    resumed = t2.init_or_resume()
+    assert resumed == 3
+    # Params landed SHARDED per the new rules, values untouched.
+    embed = t2.state.params["embed"]
+    assert len(embed.sharding.device_set) == 8
+    assert embed.sharding.spec[1] == "fsdp"  # EMBED axis sharded now
+    np.testing.assert_array_equal(np.asarray(embed), saved_embed)
+    for k, v in t2.state.params["layers"].items():
+        np.testing.assert_array_equal(np.asarray(v), saved[k])
+    # And it trains onward on the new topology.
+    loss = t2.run(steps=5)
+    assert int(t2.state.step) == 5 and np.isfinite(loss)
