@@ -6,6 +6,7 @@ import argparse
 
 from oim_tpu.cli.common import add_common_flags, load_tls_flags, setup_logging
 from oim_tpu.registry import MemRegistryDB, RegistryService
+from oim_tpu.registry.db import FileRegistryDB
 from oim_tpu.registry.registry import registry_server
 
 
@@ -14,10 +15,16 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--endpoint", default="tcp://0.0.0.0:8999", help="listen endpoint"
     )
+    parser.add_argument(
+        "--db-file", default="",
+        help="journal the KV DB to this file (survives restarts; default "
+             "is the reference's soft-state in-memory DB)",
+    )
     add_common_flags(parser)
     args = parser.parse_args(argv)
     setup_logging(args)
-    service = RegistryService(db=MemRegistryDB(), tls=load_tls_flags(args))
+    db = FileRegistryDB(args.db_file) if args.db_file else MemRegistryDB()
+    service = RegistryService(db=db, tls=load_tls_flags(args))
     server = registry_server(args.endpoint, service)
     try:
         server.wait()

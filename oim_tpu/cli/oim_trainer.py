@@ -605,9 +605,12 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--wds-ext", default="bin",
                         help="sample extension holding int32 tokens")
     parser.add_argument("--shuffle", action="store_true",
-                        help="reshuffle record order each epoch "
-                             "(whole-volume feeds; windowed feed streams "
-                             "in volume order)")
+                        help="shuffle records: whole-volume feeds permute "
+                             "per epoch; windowed feeds run through a "
+                             "bounded reservoir (--shuffle-buffer-records)")
+    parser.add_argument("--shuffle-buffer-records", type=int, default=2048,
+                        help="reservoir size (records) for shuffling "
+                             "windowed/streaming feeds")
     parser.add_argument("--shuffle-seed", type=int, default=0)
     parser.add_argument("--augment", action="store_true",
                         help="host-side random flip + crop on image batches")
@@ -698,6 +701,13 @@ def main(argv: list[str] | None = None) -> int:
             )
             log.info("distributed", process_id=pid, num_processes=n)
         data = feeder_batches(args, cfg, tls)
+        if args.shuffle and args.feed_window_bytes > 0:
+            # Windowed feeds stream in volume order; a bounded record
+            # reservoir restores sample randomness with fixed host memory.
+            from oim_tpu.data.shuffle import shuffle_batches
+
+            data = shuffle_batches(
+                data, args.shuffle_buffer_records, seed=args.shuffle_seed)
         if args.eval_every and (args.eval_volume_file
                                 or args.eval_volume_tfrecord):
             eval_args = argparse.Namespace(**{

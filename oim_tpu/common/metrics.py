@@ -98,6 +98,10 @@ EVAL_LOSS = DEFAULT.gauge(
 EVAL_ACCURACY = DEFAULT.gauge(
     "oim_eval_accuracy",
     "mean classification accuracy of the most recent evaluation pass")
+FEED_WAIT_SECONDS = DEFAULT.gauge(
+    "oim_feed_wait_seconds",
+    "host time blocked waiting on the input feed per step (input-bound "
+    "when this approaches oim_train_step_seconds)")
 
 
 class MetricsServer:

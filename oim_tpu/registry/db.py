@@ -50,6 +50,75 @@ class MemRegistryDB:
                 return
 
 
+class FileRegistryDB(MemRegistryDB):
+    """MemRegistryDB + an append-only journal, replayed at construction.
+
+    The reference aspires to an etcd backend and never builds one
+    (README.md:36-40 vs the single memdb.go); this is the minimal durable
+    step that keeps the soft-state contract: the journal only shortens
+    topology convergence after a registry restart (entries reappear
+    immediately instead of after one registry_delay) and preserves
+    admin-written keys that no controller re-registers. Records are JSON
+    lines ({"k": path, "v": value}; empty/absent value = delete), so any
+    byte sequence MemRegistryDB accepts — spaces, newlines, unicode —
+    round-trips exactly, and a torn final line from a crash mid-append
+    fails the JSON parse and is skipped instead of replaying as a phantom
+    key. fsync per mutation (registry writes are rare control-plane
+    events — README.md:39 "short-lived, infrequent connections" — so
+    durability costs nothing that matters). The journal compacts at load.
+    """
+
+    def __init__(self, path: str) -> None:
+        import json
+        import os
+
+        super().__init__()
+        self.path = path
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    if not line.endswith("\n"):
+                        break  # torn tail from a crash mid-append
+                    try:
+                        rec = json.loads(line)
+                        key = rec["k"]
+                    except (ValueError, KeyError, TypeError):
+                        continue  # unparseable record: skip, don't invent
+                    value = rec.get("v", "")
+                    if value == "":
+                        self._data.pop(key, None)
+                    else:
+                        self._data[key] = value
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        # Compact: rewrite the current state, then append from there.
+        tmp = f"{path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for key, value in self._data.items():
+                f.write(json.dumps({"k": key, "v": value}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self._json = json
+        self._journal = open(path, "a", encoding="utf-8")  # noqa
+
+    def set(self, path: str, value: str) -> None:
+        import os
+
+        with self._lock:
+            if value == "":
+                self._data.pop(path, None)
+            else:
+                self._data[path] = value
+            self._journal.write(
+                self._json.dumps({"k": path, "v": value}) + "\n")
+            self._journal.flush()
+            os.fsync(self._journal.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            self._journal.close()
+
+
 def get_registry_entries(db: RegistryDB, prefix: str) -> dict[str, str]:
     """All entries at or under ``prefix`` (reference GetRegistryEntries,
     registry.go:44-51); empty prefix returns everything."""

@@ -536,33 +536,48 @@ class Trainer:
         # with it (the hot-path-off-the-control-plane rule of SURVEY §3.5
         # applied to the batch loop).
         pending = self.place_batch(next(data)) if start_step < steps else None
+        feed_wait = 0.0
         for i in range(start_step, steps):
             batch = pending
-            self.state, stats = self.step_fn(self.state, batch)
-            if i + 1 < steps:
-                pending = self.place_batch(next(data))
+            with jax.profiler.StepTraceAnnotation("train", step_num=i + 1):
+                self.state, stats = self.step_fn(self.state, batch)
+                if i + 1 < steps:
+                    # Host time blocked on the feed: with async dispatch the
+                    # device is still computing here, so this only becomes
+                    # real step time when it exceeds the device step — the
+                    # input-bound signal (oim_feed_wait_seconds).
+                    t_feed = time.monotonic()
+                    nxt = next(data)
+                    feed_wait += time.monotonic() - t_feed
+                    pending = self.place_batch(nxt)
             if (i + 1) % cfg.log_every == 0 or i + 1 == steps:
                 last_loss = float(stats["loss"])  # sync point
                 now = time.monotonic()
-                dt = (now - t_prev) / max(1, i + 1 - last_logged)
+                n_steps = max(1, i + 1 - last_logged)
+                dt = (now - t_prev) / n_steps
                 t_prev = now
                 last_logged = i + 1
                 M.TRAIN_STEP_SECONDS.set(dt)
                 M.TRAIN_EXAMPLES_PER_SEC.set(cfg.batch_size / dt)
+                M.FEED_WAIT_SECONDS.set(feed_wait / n_steps)
                 mfu = fps / dt / peak if peak else 0.0
                 M.TRAIN_MFU.set(mfu)
                 log.info(
                     "step", step=i + 1, loss=round(last_loss, 4),
                     grad_norm=round(float(stats["grad_norm"]), 4),
                     step_s=round(dt, 4), mfu=round(mfu, 4),
+                    feed_wait_s=round(feed_wait / n_steps, 4),
                 )
+                feed_wait = 0.0
             if eval_every and (i + 1) % eval_every == 0:
                 eval_loss = self.evaluate(eval_data)
                 log.info("eval", step=i + 1, eval_loss=round(eval_loss, 4))
                 # Keep eval wall time out of the train step-timing window
                 # (it would inflate step_s and understate MFU/examples-sec).
+                # feed_wait resets with it: both divide by steps-since-last.
                 t_prev = time.monotonic()
                 last_logged = i + 1
+                feed_wait = 0.0
             if (
                 self.checkpointer is not None
                 and cfg.checkpoint_every

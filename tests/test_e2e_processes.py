@@ -162,6 +162,7 @@ class TestReadmeQuickstart:
             "--platform", "cpu", "--model", "llama-tiny",
             "--steps", "3", "--batch-size", "2", "--seq-len", "32",
             "--log-every", "1", "--warmup-steps", "1", "--mesh", "data=1",
+            "--shuffle", "--shuffle-buffer-records", "8",
             "--registry", f"127.0.0.1:{cluster.registry_port}",
             "--controller-id", "host-0",
             "--volume", "tokens", "--volume-file", str(tmp_path / "tokens.npy"),

@@ -231,3 +231,43 @@ class TestDistributedTrainer:
         assert losses[0] == losses[1], (
             f"global DP step diverged between ranks: {losses}"
         )
+
+
+class TestDistributedFSDP:
+    def test_two_process_fsdp_step(self, cluster, tmp_path):
+        """Cross-process parameter sharding: the same two-process rig under
+        fsdp rules (data=4, fsdp=2) — params shard over processes and the
+        FSDP all-gathers ride the global mesh. One step, identical loss."""
+        tokens = np.random.RandomState(1).randint(0, 256, 8 * 33 * 2)
+        path = tmp_path / "tokens.bin"
+        tokens.astype(np.int32).tofile(path)
+        coord_port = free_port()
+
+        procs = []
+        for i in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "oim_tpu.cli.oim_trainer",
+                 "--platform", "cpu", "--model", "llama-tiny",
+                 "--rules", "fsdp",
+                 "--steps", "1", "--batch-size", "8", "--seq-len", "32",
+                 "--log-every", "1", "--warmup-steps", "1",
+                 "--mesh", "data=4,fsdp=2",
+                 "--registry", f"127.0.0.1:{cluster.registry_port}",
+                 "--controller-id", f"host-{i}",
+                 "--expected-hosts", "2",
+                 "--coordinator-port", str(coord_port),
+                 "--volume", "mh-fsdp", "--volume-file", str(path),
+                 "--feed-window-bytes", "0",
+                 "--ca", f"{cluster.certs}/ca.crt",
+                 "--key", f"{cluster.certs}/host.host-{i}"],
+                env=child_env(devices=4),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            ))
+        losses = []
+        for i, proc in enumerate(procs):
+            out, _ = proc.communicate(timeout=600)
+            assert proc.returncode == 0, f"rank {i} failed:\n{out[-4000:]}"
+            m = re.findall(r"final_loss: ([0-9.]+)", out)
+            assert m, out[-2000:]
+            losses.append(float(m[-1]))
+        assert losses[0] == losses[1], losses
