@@ -559,6 +559,12 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--remat", action="store_true",
                         help="recompute activations in the backward pass "
                              "(fit bigger models/batches in HBM)")
+    parser.add_argument("--remat-policy", default="",
+                        choices=("", "dots", "dots_with_no_batch_dims",
+                                 "nothing"),
+                        help="what remat may SAVE: 'dots' keeps matmul "
+                             "outputs and recomputes only elementwise work "
+                             "(cheaper bwd than full remat, more memory)")
     parser.add_argument("--accum-steps", type=int, default=1,
                         help="gradient accumulation microbatches per update")
     parser.add_argument("--mesh", default="", help="e.g. data=4,model=2")
@@ -666,6 +672,7 @@ def main(argv: list[str] | None = None) -> int:
         seq_parallel=args.seq_parallel,
         microbatches=args.microbatches,
         remat=args.remat,
+        remat_policy=args.remat_policy,
         accum_steps=args.accum_steps,
         batch_size=args.batch_size,
         seq_len=args.seq_len,

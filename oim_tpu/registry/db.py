@@ -105,6 +105,11 @@ class FileRegistryDB(MemRegistryDB):
         import os
 
         with self._lock:
+            # No-op writes skip the journal: controllers re-register the
+            # SAME address every registry_delay, which would otherwise grow
+            # the journal (and fsync) without bound between restarts.
+            if value == self._data.get(path, ""):
+                return
             if value == "":
                 self._data.pop(path, None)
             else:

@@ -98,6 +98,7 @@ class TrainConfig:
     seq_parallel: str = "ring"  # ring | ulysses (used when mesh seq axis > 1)
     microbatches: int = 4  # GPipe microbatch count (rules == "pipe")
     remat: bool = False  # recompute activations in bwd (fit big configs)
+    remat_policy: str = ""  # "", "dots", "dots_with_no_batch_dims", "nothing"
     accum_steps: int = 1  # gradient accumulation: split the batch, one update
     batch_size: int = 8
     seq_len: int = 128
@@ -128,8 +129,20 @@ class TrainConfig:
             mcfg = resnet.Config(num_classes=self.num_classes)
         else:
             raise ValueError(f"unknown model {self.model!r}")
+        if self.remat_policy and not self.remat:
+            raise ValueError(
+                "remat_policy without remat does nothing — pass remat=True "
+                "(--remat) to enable policy-limited rematerialization"
+            )
         if self.remat:
             mcfg = dataclasses.replace(mcfg, remat=True)
+            if self.remat_policy:
+                if not hasattr(mcfg, "remat_policy"):
+                    raise ValueError(
+                        f"model {self.model!r} does not support remat_policy"
+                    )
+                mcfg = dataclasses.replace(
+                    mcfg, remat_policy=self.remat_policy)
         if self.model_overrides:
             mcfg = dataclasses.replace(mcfg, **self.model_overrides)
         return mcfg

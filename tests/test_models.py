@@ -5,6 +5,7 @@ on the CPU mesh."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 import optax
 
 from oim_tpu.models import llama, resnet
@@ -286,3 +287,38 @@ def test_resnet_s2d_stem_matches_plain():
         training=False)
     np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b),
                                atol=1e-3)
+
+
+@pytest.mark.parametrize("policy", ["dots", "dots_with_no_batch_dims"])
+def test_remat_policy_matches_no_remat(policy):
+    """Policy-limited remat is a pure scheduling choice: loss and grads
+    must equal the no-remat path bit-for-bit-ish."""
+    import dataclasses
+
+    from oim_tpu.models import llama
+
+    cfg = llama.tiny(n_layers=2)
+    rcfg = dataclasses.replace(cfg, remat=True, remat_policy=policy)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0, cfg.vocab)
+
+    loss_a = float(llama.loss_fn(params, tokens, cfg))
+    loss_b = float(llama.loss_fn(params, tokens, rcfg))
+    np.testing.assert_allclose(loss_b, loss_a, rtol=1e-6)
+    g_a = jax.grad(lambda p: llama.loss_fn(p, tokens, cfg))(params)
+    g_b = jax.grad(lambda p: llama.loss_fn(p, tokens, rcfg))(params)
+    np.testing.assert_allclose(
+        np.asarray(g_b["layers"]["wq"]), np.asarray(g_a["layers"]["wq"]),
+        atol=1e-5)
+
+
+def test_remat_policy_unknown_rejected():
+    import dataclasses
+
+    from oim_tpu.models import llama
+
+    cfg = dataclasses.replace(llama.tiny(), remat=True, remat_policy="bogus")
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 9), 0, cfg.vocab)
+    with pytest.raises(ValueError, match="remat_policy"):
+        llama.loss_fn(params, tokens, cfg)

@@ -378,3 +378,22 @@ class TestFileRegistryDB:
         finally:
             server2.force_stop()
             db2.close()
+
+
+def test_file_db_noop_writes_skip_journal(tmp_path):
+    """Re-registration writes the same value every registry_delay; the
+    journal must not grow for no-op sets (fsync-per-heartbeat would also
+    contradict the 'registry writes are rare' premise)."""
+    from oim_tpu.registry.db import FileRegistryDB
+
+    path = str(tmp_path / "reg.journal")
+    db = FileRegistryDB(path)
+    for _ in range(50):
+        db.set("host-0/address", "a:1")  # the re-registration heartbeat
+    db.set("host-0/address", "a:2")
+    db.close()
+    lines = open(path).read().splitlines()
+    assert len(lines) == 2  # first set + the one real change
+    db2 = FileRegistryDB(path)
+    assert db2.get("host-0/address") == "a:2"
+    db2.close()

@@ -377,3 +377,16 @@ def test_shuffle_buffer_permutes_and_preserves_records():
         ids, np.concatenate([b["ids"] for b in again]))
     other = list(shuffle_batches(feed(), buffer_records=16, seed=2))
     assert np.concatenate([b["ids"] for b in other]).tolist() != ids.tolist()
+
+
+def test_remat_policy_requires_remat_and_support():
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="remat_policy without remat"):
+        TrainConfig(model="llama-tiny", remat_policy="dots").model_config()
+    with _pytest.raises(ValueError, match="does not support"):
+        TrainConfig(model="resnet50", remat=True,
+                    remat_policy="dots").model_config()
+    mcfg = TrainConfig(model="llama-tiny", remat=True,
+                       remat_policy="dots").model_config()
+    assert mcfg.remat and mcfg.remat_policy == "dots"
