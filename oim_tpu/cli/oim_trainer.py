@@ -113,6 +113,14 @@ def feeder_batches(args, cfg: TrainConfig, tls):
             yield from _cycle_token_batches(
                 data.reshape(-1), cfg, args.volume, seed)
         else:
+            # Raw byte volumes carry no labels anywhere: this path is a
+            # bandwidth/e2e shape, not supervised training. Say so loudly
+            # instead of letting a zero-label loss masquerade as learning.
+            from_context().warning(
+                "raw image volume has no labels (training against zeros); "
+                "use --volume-tfrecord or --volume-webdataset jpg/cls for "
+                "supervised vision"
+            )
             images = data.astype(np.float32)
             labels = np.zeros((images.shape[0],), np.int32)
             for idx in _cycle_indices(images.shape[0], cfg.batch_size, seed):
@@ -139,6 +147,12 @@ def feeder_batches(args, cfg: TrainConfig, tls):
         else:
             sample = (cfg.image_size, cfg.image_size, 3)
         rec_bytes = int(np.prod(sample)) * dt.itemsize
+        # Same unlabeled-feed caveat as the whole-volume raw path.
+        from_context().warning(
+            "raw image volume has no labels (training against zeros); "
+            "use --volume-tfrecord or --volume-webdataset jpg/cls for "
+            "supervised vision"
+        )
         labels = np.zeros((cfg.batch_size,), np.int32)
 
         def to_batch(raw):
