@@ -17,12 +17,14 @@ from __future__ import annotations
 
 import base64
 import concurrent.futures as cf
+import time
 import urllib.error
 import urllib.request
 
 import numpy as np
 
 from oim_tpu.common import metrics as M
+from oim_tpu.common.logging import from_context
 from oim_tpu.data import staging
 
 
@@ -37,15 +39,43 @@ def basic_auth_headers(user: str = "", secret: str = "") -> dict[str, str]:
     return {"Authorization": f"Basic {token}"}
 
 
+def _transient_urlerror(e: urllib.error.URLError) -> bool:
+    """Connection resets/timeouts are transient; DNS failures and TLS
+    verification errors are configuration problems that retrying only
+    slows down."""
+    import socket
+    import ssl
+
+    return not isinstance(e.reason, (socket.gaierror, ssl.SSLError))
+
+
 def _open(url: str, headers: dict[str, str] | None, method: str = "GET",
-          timeout: float = 60.0):
+          timeout: float = 60.0, retries: int = 3):
+    """urlopen with bounded retry on TRANSIENT failures (connection resets,
+    timeouts, 5xx): one flaky request must not kill a multi-GB parallel
+    stage (the reference gets the same forgiveness from the kernel block
+    layer's retries; objects over HTTP need it in the reader). Permanent
+    failures — 4xx (auth, missing object), DNS, TLS verification — raise
+    immediately."""
     req = urllib.request.Request(url, headers=headers or {}, method=method)
-    try:
-        return urllib.request.urlopen(req, timeout=timeout)
-    except urllib.error.HTTPError as e:
-        raise ObjectStoreError(f"{method} {url}: HTTP {e.code} {e.reason}") from e
-    except urllib.error.URLError as e:
-        raise ObjectStoreError(f"{method} {url}: {e.reason}") from e
+    delay = 0.2
+    for attempt in range(retries + 1):
+        try:
+            return urllib.request.urlopen(req, timeout=timeout)
+        except urllib.error.HTTPError as e:
+            e.close()  # a 5xx burst across parallel parts must not leak fds
+            if e.code < 500 or attempt >= retries:
+                raise ObjectStoreError(
+                    f"{method} {url}: HTTP {e.code} {e.reason}") from e
+        except urllib.error.URLError as e:
+            if attempt >= retries or not _transient_urlerror(e):
+                raise ObjectStoreError(f"{method} {url}: {e.reason}") from e
+        from_context().warning(
+            "retrying object request", url=url.split("?")[0],
+            method=method, attempt=attempt + 1,
+        )
+        time.sleep(delay)
+        delay = min(delay * 2, 2.0)
 
 
 def content_length(url: str, headers: dict[str, str] | None = None) -> int:
@@ -76,7 +106,8 @@ def fetch(url: str, offset: int | None = None, length: int | None = None,
 def _fetch_range(url: str, offset: int | None, length: int | None,
                  headers: dict[str, str] | None) -> tuple[bytes, int | None]:
     """GET bytes plus the object's TOTAL size from Content-Range (None for
-    un-ranged responses) — the free consistency signal ranged reads get."""
+    un-ranged responses) — the free consistency signal ranged reads get.
+    Transient failures retry inside _open."""
     h = dict(headers or {})
     if offset is not None:
         end = "" if length is None else str(offset + length - 1)
@@ -116,8 +147,6 @@ def read_object(
     if url.startswith("http://") and (headers or {}).get("Authorization"):
         # Credentials over plaintext: everything else in this framework is
         # mTLS; an http gateway is acceptable only inside a trusted fabric.
-        from oim_tpu.common.logging import from_context
-
         from_context().warning(
             "sending credentials over plaintext http", url=url.split("?")[0]
         )

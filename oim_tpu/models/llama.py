@@ -382,10 +382,11 @@ def make_pipelined_loss(mesh, cfg: Config, n_microbatches: int,
     return loss_fn
 
 
-def num_params(cfg: Config = LLAMA3_8B) -> int:
+def _param_counts(cfg: Config, experts: int) -> int:
     L, D = cfg.n_layers, cfg.dim
     if cfg.n_experts:
-        ffn = D * cfg.n_experts + 3 * cfg.n_experts * D * cfg.mlp_dim
+        # Router always sees every expert; expert weights count ``experts``.
+        ffn = D * cfg.n_experts + 3 * experts * D * cfg.mlp_dim
     else:
         ffn = 3 * D * cfg.mlp_dim
     per_layer = (
@@ -396,9 +397,24 @@ def num_params(cfg: Config = LLAMA3_8B) -> int:
     return cfg.vocab * D + L * per_layer + D + D * cfg.vocab
 
 
+def num_params(cfg: Config = LLAMA3_8B) -> int:
+    """Total parameters (all experts; the memory number)."""
+    return _param_counts(cfg, cfg.n_experts)
+
+
+def num_active_params(cfg: Config = LLAMA3_8B) -> int:
+    """Parameters a token actually touches (top_k experts; the FLOPs
+    number — an 8-expert top-2 model does top-2's work, not 8x)."""
+    return _param_counts(cfg, min(cfg.moe_top_k, cfg.n_experts))
+
+
 def num_flops_per_token(cfg: Config = LLAMA3_8B, seq_len: int | None = None) -> float:
-    """Training FLOPs/token: 6*N plus the attention quadratic term."""
-    n = num_params(cfg)
+    """Training FLOPs/token: 6*N_active plus the attention quadratic term.
+
+    Using ACTIVE params keeps MoE MFU honest: counting all experts would
+    credit the chip with FLOPs routed tokens never execute.
+    """
+    n = num_active_params(cfg)
     flops = 6.0 * n
     if seq_len:
         # Per layer, per token: 2*T*q_dim for QK^T + 2*T*q_dim for PV

@@ -322,3 +322,21 @@ def test_remat_policy_unknown_rejected():
     tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 9), 0, cfg.vocab)
     with pytest.raises(ValueError, match="remat_policy"):
         llama.loss_fn(params, tokens, cfg)
+
+
+def test_moe_flops_count_active_params_only():
+    """MFU accounting must not credit FLOPs for experts a token never
+    touches: an 8-expert top-2 model does top-2's work."""
+    import dataclasses
+
+    from oim_tpu.models import llama
+
+    dense = llama.tiny()
+    moe = dataclasses.replace(dense, n_experts=8, moe_top_k=2)
+    assert llama.num_params(moe) > llama.num_active_params(moe)
+    # Active FFN ~= a 2-expert model's FFN (+ router).
+    two = dataclasses.replace(dense, n_experts=2, moe_top_k=2)
+    assert llama.num_active_params(moe) == llama.num_params(two) + (
+        moe.n_experts - two.n_experts) * moe.dim * moe.n_layers
+    # Dense models: active == total.
+    assert llama.num_params(dense) == llama.num_active_params(dense)

@@ -305,3 +305,47 @@ class TestRemoteSourceViaMapVolume:
         assert vol.wait(10.0) and vol.state == StageState.READY
         samples = list(webdataset.iter_samples([np.asarray(vol.array)]))
         assert samples == [{"__key__": b"s", "bin": b"DATA"}]
+
+
+class TestTransientRetry:
+    """One flaky part must not kill a parallel stage: 5xx / connection
+    errors retry with backoff; 4xx fail immediately."""
+
+    def test_5xx_retries_then_succeeds(self, gateway):
+        server = gateway
+        base = _endpoint(server)
+        server.objects["/flaky.bin"] = b"z" * 1000
+        fails = {"n": 2}
+        orig = _RangeHandler._object
+
+        def flaky(self):
+            if self.path == "/flaky.bin" and fails["n"] > 0:
+                fails["n"] -= 1
+                self.send_error(503, "try later")
+                return None
+            return orig(self)
+
+        _RangeHandler._object = flaky
+        try:
+            out = objectstore.read_object(f"{base}/flaky.bin")
+            assert bytes(out) == b"z" * 1000
+            assert fails["n"] == 0  # both failures consumed by retries
+        finally:
+            _RangeHandler._object = orig
+
+    def test_404_fails_immediately(self, gateway):
+        base = _endpoint(gateway)
+        attempts = {"n": 0}
+        orig = _RangeHandler._object
+
+        def counting(self):
+            attempts["n"] += 1
+            return orig(self)
+
+        _RangeHandler._object = counting
+        try:
+            with pytest.raises(objectstore.ObjectStoreError, match="404"):
+                objectstore.fetch(f"{base}/gone.bin")
+            assert attempts["n"] == 1  # no retries on a permanent error
+        finally:
+            _RangeHandler._object = orig
