@@ -324,3 +324,161 @@ int64_t oim_stream_file_size(void* stream) {
 void oim_stream_close(void* stream) { delete static_cast<Stream*>(stream); }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Batch JPEG decode (+ bilinear resize): the input-pipeline hot op for the
+// supervised feeds. Pillow (libjpeg-turbo under the GIL-released hood)
+// measured ~290 img/s on the dev host — an order of magnitude short of a
+// v5e ResNet step's ~2.7k img/s appetite — so the decode moves into the
+// data-plane engine: system libjpeg, worker threads, DCT prescaling to the
+// nearest power-of-two above the target, bilinear to the exact size.
+
+extern "C" {
+int64_t oim_decode_jpeg_batch(const uint8_t* blobs, const int64_t* offsets,
+                              const int64_t* lengths, int64_t n, int size,
+                              uint8_t* out, int n_threads);
+}
+
+#include <csetjmp>
+#include <jpeglib.h>
+
+namespace {
+
+struct JpegErr {
+  jpeg_error_mgr pub;
+  jmp_buf jump;
+  char msg[JMSG_LENGTH_MAX];
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  auto* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  (*cinfo->err->format_message)(cinfo, err->msg);
+  longjmp(err->jump, 1);
+}
+
+// Bilinear resize [h, w, 3] u8 -> [size, size, 3] u8.
+void bilinear(const uint8_t* src, int h, int w, uint8_t* dst, int size) {
+  const float sy = static_cast<float>(h) / size;
+  const float sx = static_cast<float>(w) / size;
+  for (int oy = 0; oy < size; ++oy) {
+    float fy = (oy + 0.5f) * sy - 0.5f;
+    if (fy < 0) fy = 0;
+    int y0 = static_cast<int>(fy);
+    int y1 = y0 + 1 < h ? y0 + 1 : h - 1;
+    float wy = fy - y0;
+    for (int ox = 0; ox < size; ++ox) {
+      float fx = (ox + 0.5f) * sx - 0.5f;
+      if (fx < 0) fx = 0;
+      int x0 = static_cast<int>(fx);
+      int x1 = x0 + 1 < w ? x0 + 1 : w - 1;
+      float wx = fx - x0;
+      for (int c = 0; c < 3; ++c) {
+        float a = src[(y0 * w + x0) * 3 + c] * (1 - wx) +
+                  src[(y0 * w + x1) * 3 + c] * wx;
+        float b = src[(y1 * w + x0) * 3 + c] * (1 - wx) +
+                  src[(y1 * w + x1) * 3 + c] * wx;
+        float v = a * (1 - wy) + b * wy;
+        dst[(oy * size + ox) * 3 + c] =
+            static_cast<uint8_t>(v + 0.5f > 255.f ? 255.f : v + 0.5f);
+      }
+    }
+  }
+}
+
+bool decode_one(const uint8_t* blob, size_t len, int size, uint8_t* dst,
+                std::vector<uint8_t>& scratch, std::string& err_out) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jump)) {
+    err_out = jerr.msg;
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, const_cast<uint8_t*>(blob),
+               static_cast<unsigned long>(len));
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;  // grayscale converts; CMYK errors out
+  // DCT prescale: largest 1/2^k keeping both dims >= target (cheap
+  // decode of the detail the bilinear pass would discard anyway).
+  cinfo.scale_num = 1;
+  cinfo.scale_denom = 1;
+  const int iw = static_cast<int>(cinfo.image_width);
+  const int ih = static_cast<int>(cinfo.image_height);
+  int denom = 1;
+  while (denom < 8 && iw / (denom * 2) >= size && ih / (denom * 2) >= size) {
+    denom *= 2;
+  }
+  cinfo.scale_denom = static_cast<unsigned>(denom);
+  jpeg_start_decompress(&cinfo);
+  const int w = cinfo.output_width, h = cinfo.output_height;
+  if (cinfo.output_components != 3) {
+    err_out = "unsupported component count";
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  scratch.resize(static_cast<size_t>(w) * h * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = scratch.data() + static_cast<size_t>(cinfo.output_scanline) * w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  if (w == size && h == size) {
+    memcpy(dst, scratch.data(), static_cast<size_t>(size) * size * 3);
+  } else {
+    bilinear(scratch.data(), h, w, dst, size);
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode n JPEG blobs into out[n, size, size, 3] u8 (bilinear-resized),
+// parallel across n_threads. Returns n on success, -1 on ANY failure (the
+// out buffer contents are then unspecified; oim_last_error names the first
+// failing image's index and the caller falls back to its own decoder).
+int64_t oim_decode_jpeg_batch(const uint8_t* blobs, const int64_t* offsets,
+                              const int64_t* lengths, int64_t n, int size,
+                              uint8_t* out, int n_threads) {
+  if (n <= 0) return 0;
+  if (n_threads < 1) n_threads = 1;
+  std::atomic<int64_t> next{0};
+  std::atomic<int64_t> failed{-1};
+  std::mutex err_mu;
+  std::string err_msg;
+  const size_t px = static_cast<size_t>(size) * size * 3;
+  auto work = [&] {
+    std::vector<uint8_t> scratch;
+    std::string err;
+    for (;;) {
+      int64_t i = next.fetch_add(1);
+      if (i >= n || failed.load() >= 0) return;
+      if (!decode_one(blobs + offsets[i], static_cast<size_t>(lengths[i]),
+                      size, out + static_cast<size_t>(i) * px, scratch, err)) {
+        int64_t expect = -1;
+        if (failed.compare_exchange_strong(expect, i)) {
+          std::lock_guard<std::mutex> lk(err_mu);
+          err_msg = "image " + std::to_string(i) + ": " + err;
+        }
+        return;
+      }
+    }
+  };
+  int workers = static_cast<int>(n < n_threads ? n : n_threads);
+  std::vector<std::thread> threads;
+  threads.reserve(workers);
+  for (int t = 0; t < workers; ++t) threads.emplace_back(work);
+  for (auto& t : threads) t.join();
+  if (failed.load() >= 0) {
+    g_error = err_msg;
+    return -1;
+  }
+  return n;
+}
+
+}  // extern "C"

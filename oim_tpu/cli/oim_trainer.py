@@ -121,7 +121,10 @@ def feeder_batches(args, cfg: TrainConfig, tls):
                 "use --volume-tfrecord or --volume-webdataset jpg/cls for "
                 "supervised vision"
             )
-            images = data.astype(np.float32)
+            # Keep the source dtype: uint8 volumes ride to the device
+            # as uint8 (resnet.apply normalizes on-chip; 1/4 the H2D
+            # bytes); float volumes are assumed pre-normalized.
+            images = np.asarray(data)
             labels = np.zeros((images.shape[0],), np.int32)
             for idx in _cycle_indices(images.shape[0], cfg.batch_size, seed):
                 yield {"images": images[idx], "labels": labels[idx]}
@@ -157,7 +160,7 @@ def feeder_batches(args, cfg: TrainConfig, tls):
 
         def to_batch(raw):
             imgs = raw.view(dt).reshape((cfg.batch_size,) + sample)
-            return {"images": imgs.astype(np.float32), "labels": labels}
+            return {"images": np.ascontiguousarray(imgs), "labels": labels}
 
     need = cfg.batch_size * rec_bytes
     if total < need:
@@ -358,26 +361,51 @@ def _decode_pool():
     return _DECODE_POOL
 
 
+def _decode_images(payloads: list, cfg: TrainConfig):
+    """JPEG payloads -> [image uint8 [S,S,3]] via the C++ engine's batch
+    decoder when available (native threads, DCT prescale), else the Pillow
+    thread pool; order preserved either way. Images stay uint8 all the way
+    to the device — normalization happens on-chip (resnet.apply), so H2D
+    moves 1/4 the bytes and the host never runs a float pass."""
+    from oim_tpu.data import readers, staging
+
+    arr = None
+    try:
+        arr = staging.decode_jpeg_batch(payloads, cfg.image_size)
+    except staging.StagingError as err:
+        from_context().warning(
+            "native jpeg decode failed; falling back to Pillow",
+            error=str(err)[:120],
+        )
+    if arr is not None:
+        return list(arr)
+
+    def one(p):
+        return readers.resize_image(readers.decode_image(p), cfg.image_size)
+
+    return list(_decode_pool().map(one, payloads))
+
+
 def _decode_examples(records, cfg: TrainConfig, volume: str):
-    """Parallel (order-preserving) decode of serialized tf.Examples ->
-    [(image f32, label int)]."""
+    """Serialized tf.Examples -> [(image f32, label int)], decode batched
+    through _decode_images."""
     from oim_tpu.data import readers
 
-    def one(rec):
-        return _example_to_sample(readers.parse_example(rec), cfg, volume)
+    payloads, labels = [], []
+    for rec in records:
+        p, lab = _example_payload(readers.parse_example(rec), volume)
+        payloads.append(p)
+        labels.append(lab)
+    return list(zip(_decode_images(payloads, cfg), labels))
 
-    return list(_decode_pool().map(one, records))
 
-
-def _example_to_sample(ex: dict, cfg: TrainConfig, volume: str):
-    """Parsed tf.Example -> (image [S,S,3] f32 in [0,1], label int32).
+def _example_payload(ex: dict, volume: str):
+    """Parsed tf.Example -> (image bytes, label int).
 
     Keys follow the ImageNet-TFRecord convention: image/encoded (JPEG/PNG
     bytes), image/class/label (int64) — the third-party format the feed
     translates, the role of the reference's emulation personality
     (ceph-csi.go:34-108)."""
-    from oim_tpu.data import readers
-
     img = ex.get("image/encoded")
     if not img:
         raise SystemExit(
@@ -389,8 +417,7 @@ def _example_to_sample(ex: dict, cfg: TrainConfig, volume: str):
         raise SystemExit(
             f"volume {volume!r}: tf.Example has no image/class/label feature"
         )
-    arr = readers.resize_image(readers.decode_image(img[0]), cfg.image_size)
-    return arr.astype(np.float32) / 255.0, int(label[0])
+    return img[0], int(label[0])
 
 
 def _tfrecord_image_batches(args, cfg: TrainConfig, feeder, pub):
@@ -466,10 +493,8 @@ def _tfrecord_image_batches(args, cfg: TrainConfig, feeder, pub):
             offset, carry = 0, carry[:0]
 
 
-def _wds_image_sample(sample: dict, cfg: TrainConfig):
-    """jpg/cls sample -> (image f32, label) or None (no image member)."""
-    from oim_tpu.data import readers
-
+def _wds_image_sample(sample: dict):
+    """jpg/cls sample -> (image bytes, label) or None (no image member)."""
     payload = sample.get("jpg") or sample.get("jpeg") or sample.get("png")
     if payload is None:
         return None
@@ -479,16 +504,16 @@ def _wds_image_sample(sample: dict, cfg: TrainConfig):
             "webdataset image sample has no 'cls' member (label); "
             f"members: {sorted(sample)}"
         )
-    return (readers.resize_image(
-        readers.decode_image(payload), cfg.image_size
-    ).astype(np.float32) / 255.0, int(cls.decode().strip() or 0))
+    return payload, int(cls.decode().strip() or 0)
 
 
 def _decode_wds_samples(samples, cfg: TrainConfig, imgs, labs):
-    for out in _decode_pool().map(lambda s: _wds_image_sample(s, cfg), samples):
-        if out is not None:
-            imgs.append(out[0])
-            labs.append(out[1])
+    pairs = [p for p in (_wds_image_sample(s) for s in samples) if p]
+    if not pairs:
+        return
+    payloads = [p for p, _ in pairs]
+    imgs.extend(_decode_images(payloads, cfg))
+    labs.extend(lab for _, lab in pairs)
 
 
 def _webdataset_image_batches(args, cfg: TrainConfig, feeder, pub, urls):

@@ -49,19 +49,24 @@ def _transient_urlerror(e: urllib.error.URLError) -> bool:
     return not isinstance(e.reason, (socket.gaierror, ssl.SSLError))
 
 
-def _open(url: str, headers: dict[str, str] | None, method: str = "GET",
-          timeout: float = 60.0, retries: int = 3):
-    """urlopen with bounded retry on TRANSIENT failures (connection resets,
-    timeouts, 5xx): one flaky request must not kill a multi-GB parallel
-    stage (the reference gets the same forgiveness from the kernel block
-    layer's retries; objects over HTTP need it in the reader). Permanent
-    failures — 4xx (auth, missing object), DNS, TLS verification — raise
-    immediately."""
+def _request(url: str, headers: dict[str, str] | None, method: str = "GET",
+             timeout: float = 60.0, retries: int = 3, read_body: bool = True):
+    """One HTTP request with bounded retry on TRANSIENT failures — covering
+    BOTH connect and the body read, where nearly all transfer time lives
+    (connection resets, timeouts, 5xx; one flaky request must not kill a
+    multi-GB parallel stage — the forgiveness the reference inherits from
+    the kernel block layer's retries). Permanent failures — 4xx (auth,
+    missing object), DNS, TLS verification — raise immediately.
+
+    Returns (body bytes or None, response headers).
+    """
     req = urllib.request.Request(url, headers=headers or {}, method=method)
     delay = 0.2
     for attempt in range(retries + 1):
         try:
-            return urllib.request.urlopen(req, timeout=timeout)
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                body = resp.read() if read_body else None
+                return body, resp.headers
         except urllib.error.HTTPError as e:
             e.close()  # a 5xx burst across parallel parts must not leak fds
             if e.code < 500 or attempt >= retries:
@@ -70,6 +75,10 @@ def _open(url: str, headers: dict[str, str] | None, method: str = "GET",
         except urllib.error.URLError as e:
             if attempt >= retries or not _transient_urlerror(e):
                 raise ObjectStoreError(f"{method} {url}: {e.reason}") from e
+        except (ConnectionError, TimeoutError, OSError) as e:
+            # Dropped mid-read (after a successful connect).
+            if attempt >= retries:
+                raise ObjectStoreError(f"{method} {url}: {e}") from e
         from_context().warning(
             "retrying object request", url=url.split("?")[0],
             method=method, attempt=attempt + 1,
@@ -82,18 +91,18 @@ def content_length(url: str, headers: dict[str, str] | None = None) -> int:
     """Object size via HEAD (falls back to a 1-byte range GET for servers
     that reject HEAD)."""
     try:
-        with _open(url, headers, method="HEAD") as resp:
-            size = resp.headers.get("Content-Length")
-            if size is not None:
-                return int(size)
+        _, hdrs = _request(url, headers, method="HEAD", read_body=False)
+        size = hdrs.get("Content-Length")
+        if size is not None:
+            return int(size)
     except ObjectStoreError:
         pass
     h = dict(headers or {})
     h["Range"] = "bytes=0-0"
-    with _open(url, h) as resp:
-        rng = resp.headers.get("Content-Range", "")
-        if "/" in rng:
-            return int(rng.rsplit("/", 1)[1])
+    _, hdrs = _request(url, h)
+    rng = hdrs.get("Content-Range", "")
+    if "/" in rng:
+        return int(rng.rsplit("/", 1)[1])
     raise ObjectStoreError(f"cannot determine size of {url}")
 
 
@@ -107,14 +116,13 @@ def _fetch_range(url: str, offset: int | None, length: int | None,
                  headers: dict[str, str] | None) -> tuple[bytes, int | None]:
     """GET bytes plus the object's TOTAL size from Content-Range (None for
     un-ranged responses) — the free consistency signal ranged reads get.
-    Transient failures retry inside _open."""
+    Transient failures (connect AND mid-read) retry inside _request."""
     h = dict(headers or {})
     if offset is not None:
         end = "" if length is None else str(offset + length - 1)
         h["Range"] = f"bytes={offset}-{end}"
-    with _open(url, h) as resp:
-        data = resp.read()
-        rng = resp.headers.get("Content-Range", "")
+    data, hdrs = _request(url, h)
+    rng = hdrs.get("Content-Range", "")
     total = None
     if "/" in rng:
         tail = rng.rsplit("/", 1)[1]

@@ -65,6 +65,13 @@ def _bind(lib) -> None:
     lib.oim_stream_file_size.restype = ctypes.c_int64
     lib.oim_stream_file_size.argtypes = [ctypes.c_void_p]
     lib.oim_stream_close.argtypes = [ctypes.c_void_p]
+    if hasattr(lib, "oim_decode_jpeg_batch"):  # absent in pre-r3 builds
+        lib.oim_decode_jpeg_batch.restype = ctypes.c_int64
+        lib.oim_decode_jpeg_batch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_int,
+        ]
 
 
 def build(force: bool = False) -> bool:
@@ -217,6 +224,40 @@ def stream(
     finally:
         M.STAGE_GBPS.set(lib.oim_stream_gbps(handle))
         lib.oim_stream_close(handle)
+
+
+def decode_jpeg_batch(payloads: list[bytes], size: int,
+                      n_threads: int = 8):
+    """Batch JPEG decode + bilinear resize in the C++ engine: returns
+    [n, size, size, 3] uint8, or None when the native path can't serve the
+    batch (engine not built, old ABI, or non-JPEG payloads — callers fall
+    back to the Pillow path). A corrupt image raises StagingError naming
+    its index.
+
+    This is the input-pipeline hot op moved onto the data plane: Pillow
+    decode measured ~10x short of a v5e ResNet step's image appetite.
+    """
+    lib = native_lib()
+    if lib is None or not hasattr(lib, "oim_decode_jpeg_batch") or not payloads:
+        return None
+    if any(not p.startswith(b"\xff\xd8") for p in payloads):
+        return None  # PNG/other: Pillow handles those
+    blob = b"".join(payloads)
+    offsets = (ctypes.c_int64 * len(payloads))()
+    lengths = (ctypes.c_int64 * len(payloads))()
+    pos = 0
+    for i, p in enumerate(payloads):
+        offsets[i] = pos
+        lengths[i] = len(p)
+        pos += len(p)
+    out = np.empty((len(payloads), size, size, 3), np.uint8)
+    got = lib.oim_decode_jpeg_batch(
+        blob, offsets, lengths, len(payloads), size,
+        out.ctypes.data_as(ctypes.c_void_p), n_threads,
+    )
+    if got != len(payloads):
+        _raise_last(lib, f"jpeg decode batch of {len(payloads)}")
+    return out
 
 
 def stage_file_to_device(

@@ -191,8 +191,14 @@ def _fold_stem_kernel(k):
 
 
 def apply(params, state, images, cfg: Config = Config(), training: bool = False):
-    """images: [N, H, W, 3] (any float dtype). Returns (logits_f32, new_state)."""
-    x = images.astype(cfg.dtype)
+    """images: [N, H, W, 3] — float in [0, 1], or uint8 (normalized here,
+    ON DEVICE: feeding uint8 keeps host->HBM traffic at 1/4 of f32 and
+    spares the input pipeline a per-image conversion pass).
+    Returns (logits_f32, new_state)."""
+    if images.dtype == jnp.uint8:
+        x = images.astype(cfg.dtype) / 255.0
+    else:
+        x = images.astype(cfg.dtype)
     new_state: dict = {}
     if cfg.stem_s2d:
         x = jax.lax.conv_general_dilated(

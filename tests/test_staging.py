@@ -314,3 +314,72 @@ class TestPrefetch:
             _time.sleep(0.02)
         wall = _time.monotonic() - t0
         assert wall < 0.34, f"no overlap: wall={wall:.3f}s (serial ~0.4s)"
+
+
+class TestNativeJpegDecode:
+    """Batch JPEG decode in the C++ engine (the input-pipeline hot op on
+    the data plane): pixel agreement with Pillow, resize, order, errors."""
+
+    def _jpegs(self, n=12, hw=(48, 40), quality=92, seed=0):
+        from oim_tpu.data import readers
+
+        rng = np.random.RandomState(seed)
+        imgs = [rng.randint(0, 256, (*hw, 3), dtype=np.uint8)
+                for _ in range(n)]
+        return imgs, [readers.encode_jpeg(im, quality=quality) for im in imgs]
+
+    def test_matches_pillow_no_resize(self, native):
+        from oim_tpu.data import readers, staging
+
+        imgs, payloads = self._jpegs(hw=(32, 32))
+        out = staging.decode_jpeg_batch(payloads, 32)
+        assert out is not None and out.shape == (12, 32, 32, 3)
+        for i, p in enumerate(payloads):
+            pil = readers.decode_image(p)
+            # Different IDCT implementations may differ by a couple LSBs.
+            diff = np.abs(out[i].astype(int) - pil.astype(int))
+            assert diff.max() <= 3, f"image {i}: max diff {diff.max()}"
+
+    def test_resize_and_order(self, native):
+        from oim_tpu.data import staging
+
+        imgs, payloads = self._jpegs(n=8, hw=(64, 80))
+        out = staging.decode_jpeg_batch(payloads, 32)
+        assert out.shape == (8, 32, 32, 3)
+        # Order: per-image mean brightness tracks the source order.
+        for i in range(8):
+            assert abs(float(out[i].mean()) - float(imgs[i].mean())) < 12
+
+    def test_corrupt_image_names_index(self, native):
+        from oim_tpu.data import staging
+
+        _, payloads = self._jpegs(n=4)
+        payloads[2] = payloads[2][:40]  # truncated mid-stream
+        with pytest.raises(staging.StagingError, match="image 2"):
+            staging.decode_jpeg_batch(payloads, 16)
+
+    def test_non_jpeg_falls_back(self, native):
+        from oim_tpu.data import staging
+
+        assert staging.decode_jpeg_batch([b"\x89PNG...."], 16) is None
+        assert staging.decode_jpeg_batch([], 16) is None
+
+    def test_feed_uses_native_and_matches_pillow_tolerance(self, native):
+        """_decode_images: native path output within JPEG-decoder tolerance
+        of the Pillow path at the same (non-resized) size."""
+        from oim_tpu.cli.oim_trainer import _decode_images
+        from oim_tpu.data import staging as staging_mod
+        from oim_tpu.train import TrainConfig
+
+        _, payloads = self._jpegs(n=6, hw=(16, 16))
+        cfg = TrainConfig(model="resnet50", image_size=16)
+        native_out = _decode_images(payloads, cfg)
+
+        real = staging_mod.decode_jpeg_batch
+        try:
+            staging_mod.decode_jpeg_batch = lambda *a, **k: None
+            pil_out = _decode_images(payloads, cfg)
+        finally:
+            staging_mod.decode_jpeg_batch = real
+        for a, b in zip(native_out, pil_out):
+            assert np.abs(a.astype(int) - b.astype(int)).max() <= 3

@@ -137,13 +137,14 @@ class TestLabeledFeeds:
             cfg, None)
         b = next(feed)
         assert b["images"].shape == (8, 16, 16, 3)
-        assert b["images"].dtype == np.float32
-        assert 0.0 <= b["images"].min() and b["images"].max() <= 1.0
+        # uint8 to the device: normalization happens on-chip (resnet.apply)
+        # so H2D moves 1/4 the bytes of an f32 feed.
+        assert b["images"].dtype == np.uint8
         assert b["labels"].tolist() == labels[:8]
         # Bright class must actually be brighter: pixels carry the signal.
         bright = b["images"][np.asarray(labels[:8]) == 1].mean()
         dark = b["images"][np.asarray(labels[:8]) == 0].mean()
-        assert bright > dark + 0.3
+        assert bright > dark + 75
 
     def test_tfrecord_windowed_matches_whole_volume(self, cluster, tmp_path):
         from oim_tpu.cli.oim_trainer import feeder_batches
