@@ -332,6 +332,22 @@ void oim_stream_close(void* stream) { delete static_cast<Stream*>(stream); }
 // v5e ResNet step's ~2.7k img/s appetite — so the decode moves into the
 // data-plane engine: system libjpeg, worker threads, DCT prescaling to the
 // nearest power-of-two above the target, bilinear to the exact size.
+//
+// The decoder is optional: on hosts without libjpeg dev files the rest of
+// the engine (pinned buffers, parallel preads, read-ahead streams) still
+// builds, and the oim_decode_jpeg_batch symbol is simply absent — the
+// Python side probes hasattr() and falls back to Pillow. Override the
+// autodetect with `make OIM_WITH_JPEG=0` (or =1).
+
+#ifndef OIM_WITH_JPEG
+#if defined(__has_include) && __has_include(<jpeglib.h>)
+#define OIM_WITH_JPEG 1
+#else
+#define OIM_WITH_JPEG 0
+#endif
+#endif
+
+#if OIM_WITH_JPEG
 
 extern "C" {
 int64_t oim_decode_jpeg_batch(const uint8_t* blobs, const int64_t* offsets,
@@ -482,3 +498,5 @@ int64_t oim_decode_jpeg_batch(const uint8_t* blobs, const int64_t* offsets,
 }
 
 }  // extern "C"
+
+#endif  // OIM_WITH_JPEG

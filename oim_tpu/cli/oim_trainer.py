@@ -393,19 +393,39 @@ def _decode_examples(records, cfg: TrainConfig, volume: str):
 
     payloads, labels = [], []
     for rec in records:
-        p, lab = _example_payload(readers.parse_example(rec), volume)
+        p, lab = _example_payload(readers.parse_example(rec), volume, cfg)
         payloads.append(p)
         labels.append(lab)
     return list(zip(_decode_images(payloads, cfg), labels))
 
 
-def _example_payload(ex: dict, volume: str):
+def _check_label(label: int, cfg: TrainConfig, origin: str) -> int:
+    """Apply --label-offset and validate against --num-classes, loudly.
+
+    One-hot silently zeroes an out-of-range class, corrupting loss and
+    accuracy with no error — the classic trap is the ImageNet-TFRecord
+    convention, whose labels are 1-based (1..1000): either pass
+    --num-classes 1001 or --label-offset -1.
+    """
+    label += cfg.label_offset
+    if not 0 <= label < cfg.num_classes:
+        raise SystemExit(
+            f"{origin}: label {label} (after --label-offset "
+            f"{cfg.label_offset}) outside [0, {cfg.num_classes}); "
+            "ImageNet-convention records are 1-based — use "
+            "--num-classes 1001 or --label-offset -1"
+        )
+    return label
+
+
+def _example_payload(ex: dict, volume: str, cfg: TrainConfig):
     """Parsed tf.Example -> (image bytes, label int).
 
     Keys follow the ImageNet-TFRecord convention: image/encoded (JPEG/PNG
     bytes), image/class/label (int64) — the third-party format the feed
     translates, the role of the reference's emulation personality
-    (ceph-csi.go:34-108)."""
+    (ceph-csi.go:34-108). NOTE the convention's labels are 1-based; see
+    _check_label."""
     img = ex.get("image/encoded")
     if not img:
         raise SystemExit(
@@ -417,7 +437,7 @@ def _example_payload(ex: dict, volume: str):
         raise SystemExit(
             f"volume {volume!r}: tf.Example has no image/class/label feature"
         )
-    return img[0], int(label[0])
+    return img[0], _check_label(int(label[0]), cfg, f"volume {volume!r}")
 
 
 def _tfrecord_image_batches(args, cfg: TrainConfig, feeder, pub):
@@ -493,7 +513,7 @@ def _tfrecord_image_batches(args, cfg: TrainConfig, feeder, pub):
             offset, carry = 0, carry[:0]
 
 
-def _wds_image_sample(sample: dict):
+def _wds_image_sample(sample: dict, cfg: TrainConfig):
     """jpg/cls sample -> (image bytes, label) or None (no image member)."""
     payload = sample.get("jpg") or sample.get("jpeg") or sample.get("png")
     if payload is None:
@@ -504,11 +524,15 @@ def _wds_image_sample(sample: dict):
             "webdataset image sample has no 'cls' member (label); "
             f"members: {sorted(sample)}"
         )
-    return payload, int(cls.decode().strip() or 0)
+    label = _check_label(
+        int(cls.decode().strip() or 0), cfg,
+        f"webdataset sample {sample.get('__key__', b'?').decode()!r}",
+    )
+    return payload, label
 
 
 def _decode_wds_samples(samples, cfg: TrainConfig, imgs, labs):
-    pairs = [p for p in (_wds_image_sample(s) for s in samples) if p]
+    pairs = [p for p in (_wds_image_sample(s, cfg) for s in samples) if p]
     if not pairs:
         return
     payloads = [p for p, _ in pairs]
@@ -612,6 +636,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--seq-len", type=int, default=128)
     parser.add_argument("--image-size", type=int, default=224)
     parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--label-offset", type=int, default=0,
+                        help="added to every fed label before the range "
+                             "check (ImageNet-convention tf.Examples are "
+                             "1-based: use -1, or --num-classes 1001)")
     parser.add_argument("--lr", type=float, default=3e-4)
     parser.add_argument("--warmup-steps", type=int, default=100)
     parser.add_argument("--log-every", type=int, default=10)
@@ -717,6 +745,7 @@ def main(argv: list[str] | None = None) -> int:
         seq_len=args.seq_len,
         image_size=args.image_size,
         num_classes=args.num_classes,
+        label_offset=args.label_offset,
         lr=args.lr,
         warmup_steps=args.warmup_steps,
         total_steps=args.steps,

@@ -24,29 +24,6 @@ def read_npy(path: str | Path) -> np.ndarray:
     return np.load(str(path), allow_pickle=False)
 
 
-def iter_tfrecords(path: str | Path) -> Iterator[bytes]:
-    """Iterate records in a TFRecord file.
-
-    Framing: uint64 length, uint32 masked-crc(length), payload, uint32
-    masked-crc(payload). CRCs are not verified on the hot path (integrity is
-    the storage system's job, matching the reference's stance of trusting the
-    block layer).
-    """
-    with open(path, "rb") as f:
-        while True:
-            header = f.read(12)
-            if not header:
-                return
-            if len(header) < 12:
-                raise IOError(f"truncated TFRecord header in {path}")
-            (length,) = struct.unpack("<Q", header[:8])
-            payload = f.read(length)
-            if len(payload) < length:
-                raise IOError(f"truncated TFRecord payload in {path}")
-            f.read(4)  # payload crc
-            yield payload
-
-
 def write_tfrecords(path: str | Path, records: list[bytes]) -> None:
     """Write a TFRecord file (tests + benchmarks); masked crc32c of the
     spec is filled with zeros, which readers here do not verify."""
@@ -61,30 +38,36 @@ def write_tfrecords(path: str | Path, records: list[bytes]) -> None:
 def read_tfrecord_batch(paths: list[str], record_bytes: int | None = None) -> np.ndarray:
     """Stage TFRecord files as their raw bytes with the FRAMING INTACT.
 
-    The framing must survive staging unconditionally: consumers recover
-    record boundaries from the staged volume itself (iter_tfrecord_bytes +
+    NOTE (format change since round 2): this returns the concatenated raw
+    FRAMED bytes of the files, not parsed [n, record_bytes] payloads. The
+    framing must survive staging unconditionally: consumers recover record
+    boundaries from the staged volume itself (iter_tfrecord_bytes +
     parse_example in the feed), including across ranged ReadVolume windows
     — a shape-based heuristic here would silently drop framing whenever
     records happen to be uniform-size. ``record_bytes``, when given, is a
-    validation hint: every record must have that payload size.
+    validation hint: every record must have that payload size — validated
+    by walking the framing of the bytes already in memory, one read per
+    file (never a separate validation read of multi-GB volumes).
     """
+    blobs = [Path(p).read_bytes() for p in paths]
     if record_bytes is not None:
-        for p in paths:
-            for rec in iter_tfrecords(p):
+        for p, blob in zip(paths, blobs):
+            for rec in iter_tfrecord_bytes(blob):
                 if len(rec) != record_bytes:
                     raise ValueError(
                         f"{p}: record of {len(rec)} bytes != declared "
                         f"record_bytes {record_bytes}"
                     )
-    raw = b"".join(Path(p).read_bytes() for p in paths)
-    return np.frombuffer(raw, dtype=np.uint8)
+    return np.frombuffer(b"".join(blobs), dtype=np.uint8)
 
 
 def iter_tfrecord_bytes(data: bytes | np.ndarray) -> Iterator[bytes]:
     """Iterate records of TFRecord-framed bytes already in memory (a staged
-    volume). Same framing rules as iter_tfrecords; a trailing partial record
-    raises (a partial WINDOW should be carried by the caller, not silently
-    dropped here)."""
+    volume). Framing: uint64 length, uint32 masked-crc(length), payload,
+    uint32 masked-crc(payload); CRCs are not verified on the hot path
+    (integrity is the storage system's job — the reference's stance of
+    trusting the block layer). A trailing partial record raises (a partial
+    WINDOW should be carried by the caller, not silently dropped here)."""
     buf = data.tobytes() if isinstance(data, np.ndarray) else bytes(data)
     pos, n = 0, len(buf)
     while pos < n:

@@ -38,7 +38,15 @@ from oim_tpu.spec import ControllerStub, RegistryStub, pb
 
 
 class PublishError(Exception):
-    pass
+    """Publish/window failure. ``code`` carries the gRPC status name
+    ("UNAVAILABLE", "NOT_FOUND", ...) where one exists — recovery logic
+    (fetch_window heal) branches on it, never on message text, so a
+    reworded error can't silently disable healing and an unrelated error
+    whose text mentions a status name can't trigger it."""
+
+    def __init__(self, message: str, code: str = ""):
+        super().__init__(message)
+        self.code = code
 
 
 class DeadlineExceeded(PublishError):
@@ -107,7 +115,7 @@ class Feeder:
         """Adapts grpc abort() to exceptions for in-process calls."""
 
         def abort(self, code, details):
-            raise PublishError(f"{code.name}: {details}")
+            raise PublishError(f"{code.name}: {details}", code=code.name)
 
     # -- the NodePublishVolume analog --------------------------------------
 
@@ -262,7 +270,7 @@ class Feeder:
         if self.controller is not None:
             volume = self.controller.get_volume(volume_id)
             if volume is None:
-                raise PublishError(f"no volume {volume_id!r}")
+                raise PublishError(f"no volume {volume_id!r}", code="NOT_FOUND")
             return np.asarray(volume.array)
         channel = self._registry_channel()
         try:
@@ -279,7 +287,10 @@ class Feeder:
                         spec = chunk.spec
                     parts.append(chunk.data)
             except grpc.RpcError as err:
-                raise PublishError(f"{err.code().name}: {err.details()}") from err
+                raise PublishError(
+                    f"{err.code().name}: {err.details()}",
+                    code=err.code().name,
+                ) from err
             raw = np.frombuffer(b"".join(parts), dtype=np.uint8)
             if spec is None:
                 return raw
@@ -289,7 +300,9 @@ class Feeder:
         finally:
             channel.close()
 
-    RECOVERABLE = ("UNAVAILABLE", "NOT_FOUND", "no volume")
+    # gRPC status codes (PublishError.code — never message text) that heal
+    # treats as control-plane transients worth retrying or restaging.
+    RECOVERABLE = ("UNAVAILABLE", "NOT_FOUND")
 
     def fetch_window(self, volume_id: str, offset: int = 0, length: int = 0,
                      timeout: float = 120.0, heal: bool = False):
@@ -325,10 +338,9 @@ class Feeder:
             except DeadlineExceeded:
                 raise
             except PublishError as err:
-                msg = str(err)
-                if not any(tag in msg for tag in self.RECOVERABLE):
+                if err.code not in self.RECOVERABLE:
                     raise
-                if "NOT_FOUND" in msg or "no volume" in msg:
+                if err.code == "NOT_FOUND":
                     # The controller restarted and lost its soft state:
                     # restage from the recorded request (idempotent).
                     with self._lock:
@@ -363,7 +375,7 @@ class Feeder:
         if self.controller is not None:
             volume = self.controller.get_volume(volume_id)
             if volume is None:
-                raise PublishError(f"no volume {volume_id!r}")
+                raise PublishError(f"no volume {volume_id!r}", code="NOT_FOUND")
             arr = volume.array
             itemsize = arr.dtype.itemsize
             total = arr.size * itemsize
@@ -395,7 +407,10 @@ class Feeder:
                         total = chunk.total_bytes
                     parts.append(chunk.data)
             except grpc.RpcError as err:
-                raise PublishError(f"{err.code().name}: {err.details()}") from err
+                raise PublishError(
+                    f"{err.code().name}: {err.details()}",
+                    code=err.code().name,
+                ) from err
             raw = np.frombuffer(b"".join(parts), dtype=np.uint8)
             return raw, total, spec
         finally:
@@ -423,6 +438,9 @@ class Feeder:
                     timeout=30.0,
                 )
             except grpc.RpcError as err:
-                raise PublishError(f"{err.code().name}: {err.details()}") from err
+                raise PublishError(
+                    f"{err.code().name}: {err.details()}",
+                    code=err.code().name,
+                ) from err
             finally:
                 channel.close()

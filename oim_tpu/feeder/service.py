@@ -116,7 +116,7 @@ class FeederDaemon(FeederServicer):
         except PublishError as err:
             code = (
                 grpc.StatusCode.NOT_FOUND
-                if "NOT_FOUND" in str(err) or "no volume" in str(err)
+                if err.code == "NOT_FOUND"
                 else grpc.StatusCode.FAILED_PRECONDITION
             )
             context.abort(code, str(err))

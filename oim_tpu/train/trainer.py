@@ -104,6 +104,7 @@ class TrainConfig:
     seq_len: int = 128
     image_size: int = 224
     num_classes: int = 1000
+    label_offset: int = 0  # added to every fed label before range-check
     lr: float = 3e-4
     warmup_steps: int = 100
     total_steps: int = 1000

@@ -197,3 +197,98 @@ class TestProfiling:
 
         with profile_trace(""):
             pass
+
+
+class TestDependencyManifest:
+    """pyproject.toml is the bill of materials — the reference's
+    Gopkg.lock + vendor-bom.csv discipline, where CI fails on drift
+    (reference test/test.make:118-149). Two invariants:
+
+    1. every third-party module imported anywhere in oim_tpu/ (plus
+       bench.py and __graft_entry__.py) is declared in the manifest;
+    2. every pinned version matches the installed one — the manifest
+       names the exact environment the green suite and the BASELINE.md
+       perf rows were produced on.
+    """
+
+    # import name -> distribution name where they differ
+    _DIST = {"PIL": "pillow", "google": "protobuf", "grpc": "grpcio",
+             "orbax": "orbax-checkpoint", "jax": "jax"}
+    # imported only under `if TYPE_CHECKING` / optional probes, or
+    # first-party: never required in the manifest
+    _IGNORE = {"oim_tpu", "scripts", "tests", "conftest"}
+
+    @staticmethod
+    def _manifest():
+        """(required pins, optional pins). Optional extras (tpu/test) are
+        NOT required to be installed — a CPU-only host without libtpu must
+        still run the suite — but when one IS installed its version must
+        match the pin."""
+        import tomllib
+        from pathlib import Path
+
+        root = Path(__file__).resolve().parent.parent
+        with open(root / "pyproject.toml", "rb") as f:
+            data = tomllib.load(f)
+
+        def pin_map(deps):
+            pins = {}
+            for dep in deps:
+                name, _, version = dep.partition("==")
+                pins[name.strip().lower().replace("_", "-")] = version.strip()
+            return pins
+
+        optional = []
+        for extra in data["project"].get("optional-dependencies", {}).values():
+            optional += extra
+        return pin_map(data["project"]["dependencies"]), pin_map(optional)
+
+    @staticmethod
+    def _imports():
+        import ast
+        import sys
+        from pathlib import Path
+
+        root = Path(__file__).resolve().parent.parent
+        files = list((root / "oim_tpu").rglob("*.py"))
+        files += [root / "bench.py", root / "__graft_entry__.py"]
+        mods: set[str] = set()
+        for p in files:
+            for node in ast.walk(ast.parse(p.read_text())):
+                if isinstance(node, ast.Import):
+                    mods.update(a.name.split(".")[0] for a in node.names)
+                elif (isinstance(node, ast.ImportFrom)
+                      and node.module and node.level == 0):
+                    mods.add(node.module.split(".")[0])
+        return {m for m in mods if m not in sys.stdlib_module_names}
+
+    def test_every_import_is_declared(self):
+        required, _ = self._manifest()
+        missing = []
+        for mod in sorted(self._imports() - self._IGNORE):
+            dist = self._DIST.get(mod, mod).lower().replace("_", "-")
+            if dist not in required:
+                missing.append(f"{mod} (distribution {dist})")
+        assert not missing, (
+            "imports with no pyproject.toml pin (add them — the manifest "
+            f"is the BOM): {missing}"
+        )
+
+    def test_pins_match_installed_versions(self):
+        import importlib.metadata as im
+
+        required, optional = self._manifest()
+        drift = []
+        for dist, pinned in {**required, **optional}.items():
+            try:
+                installed = im.version(dist)
+            except im.PackageNotFoundError:
+                if dist in required:
+                    drift.append(f"{dist}: pinned {pinned} but not installed")
+                continue  # optional extra absent on this host: fine
+            if installed != pinned:
+                drift.append(f"{dist}: pinned {pinned}, installed {installed}")
+        assert not drift, (
+            "pyproject.toml pins drifted from the running environment "
+            f"(update the manifest to the verified set): {drift}"
+        )
