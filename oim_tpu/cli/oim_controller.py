@@ -9,6 +9,17 @@ from oim_tpu.common.meshcoord import MeshCoord
 from oim_tpu.controller import Controller, MallocBackend, TPUBackend, controller_server
 
 
+def _device_mesh(spec: str):
+    """--device-mesh string -> jax Mesh (None when unset)."""
+    from oim_tpu.parallel.mesh import build_mesh, parse_axes
+
+    try:
+        axes = parse_axes(spec)
+    except ValueError as e:
+        raise SystemExit(f"--device-mesh: {e}") from e
+    return build_mesh(axes) if axes else None
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser("oim-controller")
     parser.add_argument("--endpoint", default="tcp://0.0.0.0:8998")
@@ -34,11 +45,21 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--mesh-coord", default="", help="this host's ICI coordinate x,y,z[,core]"
     )
+    parser.add_argument(
+        "--device-mesh", default="",
+        help="device mesh for NamedSharding placements, e.g. data=4,model=2 "
+             "(without it, MapVolume requests with sharding_axes are "
+             "rejected — a scatter must never silently collapse onto one "
+             "chip)",
+    )
     add_common_flags(parser)
     args = parser.parse_args(argv)
     setup_logging(args)
     tls = load_tls_flags(args)
-    backend = TPUBackend() if args.backend == "tpu" else MallocBackend()
+    backend = (
+        TPUBackend(mesh=_device_mesh(args.device_mesh))
+        if args.backend == "tpu" else MallocBackend()
+    )
     coord = MeshCoord.parse(args.mesh_coord) if args.mesh_coord else None
     controller = Controller(
         controller_id=args.controller_id,

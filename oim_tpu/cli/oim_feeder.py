@@ -33,6 +33,9 @@ def main(argv: list[str] | None = None) -> int:
                         help="remote mode: registry address")
     parser.add_argument("--controller-id", default="",
                         help="remote mode: target controller")
+    parser.add_argument("--device-mesh", default="",
+                        help="local tpu mode: device mesh for NamedSharding "
+                             "placements, e.g. data=4,model=2")
     parser.add_argument("--publish-timeout", type=float, default=60.0)
     add_common_flags(parser)
     args = parser.parse_args(argv)
@@ -51,9 +54,10 @@ def main(argv: list[str] | None = None) -> int:
         from oim_tpu.controller.controller import ControllerService
 
         if args.backend == "tpu":
+            from oim_tpu.cli.oim_controller import _device_mesh
             from oim_tpu.controller.tpu_backend import TPUBackend
 
-            backend = TPUBackend()
+            backend = TPUBackend(mesh=_device_mesh(args.device_mesh))
         else:
             from oim_tpu.controller import MallocBackend
 

@@ -25,15 +25,12 @@ from oim_tpu.train import TrainConfig, Trainer
 
 def parse_mesh(spec: str):
     """'data=4,model=2' -> [("data", 4), ("model", 2)]."""
-    if not spec:
-        return None
-    axes = []
-    for part in spec.split(","):
-        name, _, size = part.partition("=")
-        if not size:
-            raise SystemExit(f"bad --mesh component {part!r} (want name=size)")
-        axes.append((name.strip(), int(size)))
-    return axes
+    from oim_tpu.parallel.mesh import parse_axes
+
+    try:
+        return parse_axes(spec)
+    except ValueError as e:
+        raise SystemExit(f"--mesh: {e}") from e
 
 
 def feeder_batches(args, cfg: TrainConfig, tls):

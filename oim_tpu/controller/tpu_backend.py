@@ -74,57 +74,70 @@ class TPUBackend(MallocBackend):
 
         return SingleDeviceSharding(self._pick_device())
 
-    def _chunkable_path(self, volume: StagedVolume, params_kind: str, params: Any):
-        """The single local file behind this request when the overlapped
-        chunked path applies: an unsharded raw file volume (or a one-shard
-        local webdataset). Sharded placements and composite sources keep the
-        whole-read path — a NamedSharding scatter needs the global array."""
-        if any(a for a in volume.spec.sharding_axes):
-            return None
-        if params_kind == "file" and (params.format or "raw") == "raw":
-            return params.path
-        if params_kind == "webdataset":
-            urls = list(params.shard_urls)
-            if len(urls) == 1 and "://" not in urls[0]:
-                return urls[0]
-        return None
-
     def stage(self, volume: StagedVolume, params_kind: str, params: Any) -> None:
-        def work_chunked(path: str) -> None:
-            """Disk read-ahead (C++ engine) overlapped with host->HBM DMA:
-            chunk N rides device_put while the filler preads chunk N+1 —
-            staging wall ~= max(disk, DMA), the data-plane-off-the-control-
-            path rule the reference builds SPDK around (README.md:153-170)."""
-            from oim_tpu.data import staging
+        def work_plane(src) -> None:
+            """The uniform data plane (data/plane.py): chunked read-ahead
+            overlapped with per-chunk DMA into preallocated donated device
+            buffers, for EVERY extent-lowerable source (raw/npy files,
+            TFRecord path lists, multi-shard webdatasets, object stores)
+            under EVERY placement (single device, NamedSharding scatter,
+            replication) — every backend behind the same data plane, off
+            the control path (reference README.md:153-170, SURVEY §2.8)."""
+            from oim_tpu.data import plane
 
             spec = volume.spec
-            dtype = str(spec_dtype(spec)) if spec.dtype else "uint8"
-            shape = tuple(int(d) for d in spec.shape) or None
-            device = self._pick_device()
+            dtype = spec_dtype(spec) if spec.dtype else (
+                src.src_dtype or np.dtype(np.uint8))
+            component = dtype.itemsize // 2 if dtype.kind == "c" else dtype.itemsize
+            if component == 8 and not self._jax.config.jax_enable_x64:
+                # The plane stages raw bytes and BITCASTS on device; with
+                # x64 off a 64-bit-component view would truncate bit
+                # patterns, not convert values. The whole-read path
+                # device_puts the host array and gets jax's value
+                # conversion (f64 -> f32). complex64 (8-byte itemsize but
+                # 32-bit components) is bitcast-safe and stays on the
+                # plane.
+                raise plane.PlacementNotLowerable(
+                    f"{dtype} needs value conversion under x64=off")
+            if src.total_bytes % dtype.itemsize:
+                raise ValueError(
+                    f"{src.total_bytes} bytes not a multiple of "
+                    f"{dtype} itemsize"
+                )
+            # Source-discovered shape survives only when the dtype does too
+            # (reshape_to_spec semantics: a dtype override reinterprets the
+            # bytes, so the source's element geometry is meaningless).
+            src_shape = src.src_shape if (
+                not spec.dtype or src.src_dtype == dtype) else None
+            shape = plane.resolve_shape(
+                tuple(int(d) for d in spec.shape) or src_shape,
+                src.total_bytes // dtype.itemsize,
+            )
+            sharding = self._sharding_for(spec)
             with volume.cond:
-                try:
-                    import os
-
-                    volume.total_bytes = os.path.getsize(path)
-                except OSError:
-                    pass
+                volume.total_bytes = plane.placement_bytes(
+                    shape, dtype, sharding)
 
             def progress(done: int) -> bool:
                 with volume.cond:
                     volume.bytes_staged = done
                     return not volume.cancelled
 
-            arr = staging.stage_file_to_device(
-                path, device, dtype=dtype, shape=shape,
+            arr = plane.stage_source(
+                src, dtype=dtype, shape=shape, sharding=sharding,
                 chunk_bytes=self.chunk_bytes, progress=progress,
             )
-            if arr is None:  # unmapped mid-stage; parts already freed
+            if arr is None:  # unmapped mid-stage; buffers already freed
                 volume.mark_failed("unmapped during staging")
                 return
-            if not volume.mark_ready(arr, arr.nbytes, device_id=device.id):
+            dev_ids = sorted(d.id for d in arr.sharding.device_set)
+            if not volume.mark_ready(arr, arr.nbytes, device_id=dev_ids[0]):
                 arr.delete()
 
         def work_whole() -> None:
+            """Host-materializing fallback: malloc buffers (already in
+            host RAM) and sources the extent map can't express (fortran
+            .npy, unknown formats)."""
             if params_kind == "malloc":
                 host = self.buffer(volume.volume_id)
             else:
@@ -139,14 +152,22 @@ class TPUBackend(MallocBackend):
             if not volume.mark_ready(arr, arr.nbytes, device_id=dev_ids[0]):
                 arr.delete()  # unmapped while we were staging
 
-        chunk_path = self._chunkable_path(volume, params_kind, params)
-
         def work() -> None:
             try:
-                if chunk_path is not None:
-                    work_chunked(chunk_path)
-                else:
-                    work_whole()
+                from oim_tpu.data import plane
+
+                src = None
+                if params_kind != "malloc":
+                    src = plane.lower_source(params_kind, params)
+                if src is not None:
+                    try:
+                        work_plane(src)
+                        return
+                    except plane.PlacementNotLowerable:
+                        # Pathological run explosion: the whole-read path
+                        # still serves it.
+                        pass
+                work_whole()
             except Exception as exc:  # noqa: BLE001 - reported via StageStatus
                 volume.mark_failed(str(exc))
 

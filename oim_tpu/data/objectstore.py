@@ -197,6 +197,51 @@ def read_object(
     return out
 
 
+def read_range(
+    url: str,
+    offset: int,
+    length: int,
+    out: np.ndarray,
+    headers: dict[str, str] | None = None,
+    part_bytes: int = 8 << 20,
+    n_threads: int = 8,
+    expected_total: int | None = None,
+) -> None:
+    """Object bytes [offset, offset+length) into ``out`` via parallel
+    range GETs — the ranged twin of read_object, used by the uniform data
+    plane (data/plane.py) to feed object extents through the same chunked
+    pipeline as local files. No Content-Length round-trip: the caller's
+    extent map already sized the object; pass that size as
+    ``expected_total`` and any Content-Range total that disagrees fails
+    the read loudly (the read_object changed-mid-stage check, kept on the
+    ranged path)."""
+    if length == 0:
+        return
+    parts = [
+        (off, min(part_bytes, length - off))
+        for off in range(0, length, part_bytes)
+    ]
+
+    def pull(part):
+        po, n = part
+        data, total = _fetch_range(url, offset + po, n, headers)
+        if (expected_total is not None and total is not None
+                and total != expected_total):
+            raise ObjectStoreError(
+                f"{url}: object is {total} bytes but the extent map sized "
+                f"it at {expected_total} (changed mid-stage?)"
+            )
+        out[po:po + n] = np.frombuffer(data, dtype=np.uint8)
+
+    if len(parts) == 1:
+        pull(parts[0])
+    else:
+        with cf.ThreadPoolExecutor(max_workers=n_threads) as pool:
+            for _ in pool.map(pull, parts):
+                pass
+    M.STAGED_BYTES.inc(length)
+
+
 def is_url(path: str) -> bool:
     return path.startswith(("http://", "https://"))
 

@@ -1,0 +1,565 @@
+"""The uniform data plane: every source kind, every placement, one
+chunked read-ahead -> DMA pipeline.
+
+The reference's defining property is that *every* bdev backend — malloc,
+RBD, NBD — sits behind the same SPDK polling data plane, off the control
+path (reference README.md:153-170; vendored spdk/lib/bdev). Round 3's
+overlap engine served exactly one corner of that matrix (an unsharded
+single local raw file); this module is the generalisation:
+
+- **Sources lower to extents.** A source is a list of byte ``Extent``s in
+  local files or remote objects (``lower_source``): a raw file is one
+  extent; a TFRecord path list or a multi-shard webdataset is one extent
+  per file/shard laid back to back (framing/tar bytes stay intact — the
+  staged-volume contract of readers.py/webdataset.py); an object-store
+  volume is one ranged-read extent; .npy is its payload extent with
+  dtype/shape lifted from the header.
+
+- **Placements lower to runs.** A device's slice of the global array
+  (``NamedSharding.addressable_devices_indices_map``) is a list of
+  contiguous byte runs in the global row-major layout (``slice_runs``).
+  Unsharded staging is the trivial single run.
+
+- **One pipeline.** ``iter_view_chunks`` streams any run list through
+  pinned buffers with a read-ahead filler thread (chunk N+1 preads/range-
+  GETs while chunk N rides ``device_put``), and ``stage_source`` lands
+  chunks in a **preallocated donated device buffer** via
+  ``lax.dynamic_update_slice`` — peak HBM per device is shard + chunk,
+  never the 2x-volume of the old on-device ``jnp.concatenate`` finish
+  (round-3 weak #1: a 9 GB volume on a 16 GB chip must stage). Sharded
+  placements assemble per-device shards with
+  ``jax.make_array_from_single_device_arrays`` — which is also the
+  multi-host-correct API: each process stages only its addressable
+  shards.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import functools
+import os
+import queue
+import threading
+from typing import Callable, Iterator
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Extent:
+    """``length`` bytes at ``offset`` inside a local file ("file") or a
+    ranged-read HTTP object ("object"). Tests may register extra kinds in
+    ``READERS`` (e.g. throttled readers for overlap-timing assertions).
+
+    ``object_size``, when known (set at lower time from Content-Length),
+    lets ranged reads detect the object changing between sizing and
+    staging — the fail-loudly-on-mixed-versions check read_object does
+    for whole-object reads."""
+
+    kind: str
+    locator: str
+    offset: int
+    length: int
+    object_size: int | None = None
+
+
+@dataclasses.dataclass
+class ExtentSource:
+    """A volume's bytes as ordered extents, plus dtype/shape discovered
+    from the source itself (.npy headers) for specs that leave them
+    empty."""
+
+    extents: list[Extent]
+    headers: dict[str, str] | None = None  # object-store auth
+    src_dtype: np.dtype | None = None
+    src_shape: tuple[int, ...] | None = None
+
+    def __post_init__(self):
+        self.extents = [e for e in self.extents if e.length > 0]
+        starts = []
+        pos = 0
+        for e in self.extents:
+            starts.append(pos)
+            pos += e.length
+        self._starts = starts
+        self.total_bytes = pos
+
+
+# kind -> fn(locator, offset, length, dst_uint8_view, headers)
+READERS: dict[str, Callable] = {}
+
+
+def _read_file_extent(locator, offset, length, dst, headers):
+    from oim_tpu.data import staging
+
+    staging.read_into(locator, dst[:length], offset=offset)
+
+
+def _read_object_extent(locator, offset, length, dst, headers,
+                        object_size=None):
+    from oim_tpu.data import objectstore
+
+    objectstore.read_range(locator, offset, length, dst[:length], headers,
+                           expected_total=object_size)
+
+
+READERS["file"] = _read_file_extent
+READERS["object"] = _read_object_extent
+
+
+def read_range(src: ExtentSource, vol_offset: int, dst: np.ndarray) -> None:
+    """Fill ``dst`` with volume bytes [vol_offset, vol_offset+len(dst))
+    by dispatching the overlapping extents to their readers."""
+    need = dst.size
+    if vol_offset < 0 or vol_offset + need > src.total_bytes:
+        raise ValueError(
+            f"range [{vol_offset}, +{need}) outside volume of "
+            f"{src.total_bytes} bytes"
+        )
+    if need == 0:
+        return
+    i = bisect.bisect_right(src._starts, vol_offset) - 1
+    filled = 0
+    while filled < need:
+        ext = src.extents[i]
+        inner = vol_offset + filled - src._starts[i]
+        n = min(ext.length - inner, need - filled)
+        kwargs = {}
+        if ext.object_size is not None:
+            kwargs["object_size"] = ext.object_size
+        READERS[ext.kind](
+            ext.locator, ext.offset + inner, n,
+            dst[filled:filled + n], src.headers, **kwargs,
+        )
+        filled += n
+        i += 1
+
+
+# --------------------------------------------------------- source lowering --
+
+
+def _file_extent(path: str) -> Extent:
+    return Extent("file", str(path), 0, os.path.getsize(path))
+
+
+def _object_extent(url: str, headers=None) -> Extent:
+    from oim_tpu.data import objectstore
+
+    size = objectstore.content_length(url, headers)
+    return Extent("object", url, 0, size, object_size=size)
+
+
+def _lower_npy(path: str) -> ExtentSource | None:
+    """Payload extent + dtype/shape from the .npy header. Fortran-order
+    and object arrays fall back to the whole-read path (np.load)."""
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        try:
+            version = np.lib.format.read_magic(f)
+            if version == (1, 0):
+                shape, fortran, dtype = np.lib.format.read_array_header_1_0(f)
+            else:
+                shape, fortran, dtype = np.lib.format.read_array_header_2_0(f)
+        except ValueError:
+            return None
+        payload = f.tell()
+    if fortran or dtype.hasobject:
+        return None
+    if size - payload != int(np.prod(shape)) * dtype.itemsize:
+        return None  # truncated/padded: let np.load produce the real error
+    return ExtentSource(
+        [Extent("file", str(path), payload, size - payload)],
+        src_dtype=dtype, src_shape=tuple(int(d) for d in shape),
+    )
+
+
+def lower_source(params_kind: str, params) -> ExtentSource | None:
+    """MapVolume params -> ExtentSource, or None when the source is not
+    extent-lowerable (malloc host buffers, exotic formats) and the caller
+    must keep the whole-materialization path.
+
+    Runs on the async staging thread: sizing I/O (stat / HEAD) and
+    missing-file errors surface through StageStatus, never a MapVolume
+    stall (data plane off the control path).
+    """
+    from oim_tpu.data import objectstore
+
+    if params_kind == "file":
+        fmt = params.format or "raw"
+        if fmt == "raw":
+            return ExtentSource([_file_extent(params.path)])
+        if fmt == "npy":
+            return _lower_npy(params.path)
+        return None
+    if params_kind == "tfrecord":
+        return ExtentSource([_file_extent(p) for p in params.paths])
+    if params_kind == "webdataset":
+        return ExtentSource([
+            _object_extent(u) if objectstore.is_url(u) else _file_extent(u)
+            for u in params.shard_urls
+        ])
+    if params_kind == "ceph":
+        if not params.monitors:
+            raise ValueError(
+                "ceph source requires monitors=<object-gateway endpoint>"
+            )
+        url = objectstore.object_url(params.monitors, params.pool, params.image)
+        headers = objectstore.basic_auth_headers(params.user, params.secret)
+        return ExtentSource(
+            [_object_extent(url, headers)], headers=headers or None
+        )
+    return None
+
+
+def resolve_shape(
+    shape: tuple[int, ...] | None, n_elems: int
+) -> tuple[int, ...]:
+    """Concrete shape for ``n_elems`` elements: None -> flat; a single -1
+    dim inferred (numpy reshape semantics, which the whole-read path gets
+    for free and the plane must match)."""
+    if shape is None or not tuple(shape):
+        return (n_elems,)
+    shape = tuple(int(d) for d in shape)
+    if -1 in shape:
+        known = 1
+        for d in shape:
+            if d != -1:
+                known *= d
+        if known == 0 or n_elems % known:
+            raise ValueError(f"cannot reshape {n_elems} elements to {shape}")
+        shape = tuple(n_elems // known if d == -1 else d for d in shape)
+    if int(np.prod(shape, dtype=np.int64)) != n_elems:
+        raise ValueError(f"cannot reshape {n_elems} elements to {shape}")
+    return shape
+
+
+# ------------------------------------------------------- placement lowering --
+
+# A slice whose leading dims explode into more runs than this falls back
+# to whole-array staging (each run is a separate pread/range-GET; millions
+# of tiny runs would defeat the read-ahead).
+MAX_RUNS = 65536
+
+
+def slice_runs(
+    shape: tuple[int, ...], index: tuple, itemsize: int
+) -> tuple[list[tuple[int, int]], tuple[int, ...]] | None:
+    """(byte runs, slice shape) of ``index`` (a per-dim slice tuple from
+    ``addressable_devices_indices_map``) inside the row-major global
+    array; runs are emitted in the slice's own row-major order so their
+    concatenation IS the slice's buffer. None when the layout would
+    exceed MAX_RUNS."""
+    dims = len(shape)
+    starts, stops = [], []
+    for d in range(dims):
+        s = index[d] if d < len(index) else slice(None)
+        starts.append(int(s.start) if s.start is not None else 0)
+        stops.append(int(s.stop) if s.stop is not None else int(shape[d]))
+    slice_shape = tuple(stops[d] - starts[d] for d in range(dims))
+    # Trailing dims fully covered merge into one contiguous run.
+    t = dims
+    while t > 0 and starts[t - 1] == 0 and stops[t - 1] == shape[t - 1]:
+        t -= 1
+    strides = [1] * dims  # element strides, row-major
+    for d in range(dims - 2, -1, -1):
+        strides[d] = strides[d + 1] * int(shape[d + 1])
+    if t == 0:
+        total = int(np.prod(shape, dtype=np.int64)) if dims else 1
+        return [(0, total * itemsize)], slice_shape
+    run_elems = (stops[t - 1] - starts[t - 1]) * strides[t - 1]
+    outer = [range(starts[d], stops[d]) for d in range(t - 1)]
+    n_runs = 1
+    for r in outer:
+        n_runs *= len(r)
+    if n_runs > MAX_RUNS:
+        return None
+    runs = []
+    import itertools
+
+    base0 = starts[t - 1] * strides[t - 1]
+    for coords in itertools.product(*outer):
+        base = base0 + sum(c * strides[d] for d, c in enumerate(coords))
+        runs.append((base * itemsize, run_elems * itemsize))
+    return runs, slice_shape
+
+
+# ------------------------------------------------------ chunked read-ahead --
+
+
+class PlacementNotLowerable(ValueError):
+    """The placement's slices exceed MAX_RUNS runs; callers fall back to
+    whole-array staging."""
+
+
+class _Cancelled(Exception):
+    pass
+
+
+def _q_get(q: queue.Queue, stop: threading.Event):
+    while True:
+        try:
+            return q.get(timeout=0.1)
+        except queue.Empty:
+            if stop.is_set():
+                raise _Cancelled()
+
+
+def iter_view_chunks(
+    src: ExtentSource,
+    runs: list[tuple[int, int]],
+    chunk_bytes: int = 64 << 20,
+    n_buffers: int = 3,
+) -> Iterator[tuple[int, np.ndarray]]:
+    """Stream the concatenation of ``runs`` (the "view": a device slice,
+    or the whole volume) as (view_offset, uint8 chunk) pairs.
+
+    A filler thread reads ahead through a pool of pinned buffers
+    (parallel preads / ranged GETs land in buffer N+1 while the consumer
+    DMAs buffer N), so staging wall ~= max(read, copy) — the
+    SPDK-data-plane property, asserted by the overlap-timing test in
+    tests/test_staging.py. Each yielded view is valid until the next
+    iteration (its buffer is then recycled to the filler).
+    """
+    from oim_tpu.data import staging
+
+    total = sum(n for _, n in runs)
+    if total == 0:
+        return
+    chunk_bytes = min(chunk_bytes, total)
+    stop = threading.Event()
+    free_q: queue.Queue = queue.Queue()
+    for _ in range(n_buffers):
+        free_q.put(staging.alloc_pinned(chunk_bytes))
+    ready_q: queue.Queue = queue.Queue()
+
+    def fill():
+        try:
+            view_off = 0
+            buf = None
+            used = 0
+            for vol_off, nbytes in runs:
+                pos = 0
+                while pos < nbytes:
+                    if buf is None:
+                        buf = _q_get(free_q, stop)
+                        used = 0
+                    n = min(chunk_bytes - used, nbytes - pos)
+                    read_range(src, vol_off + pos, buf[used:used + n])
+                    pos += n
+                    used += n
+                    if used == chunk_bytes:
+                        ready_q.put(("chunk", buf, used, view_off))
+                        view_off += used
+                        buf = None
+            if buf is not None and used:
+                ready_q.put(("chunk", buf, used, view_off))
+            ready_q.put(("done",))
+        except _Cancelled:
+            pass
+        except Exception as exc:  # noqa: BLE001 - re-raised on the consumer
+            ready_q.put(("error", exc))
+
+    filler = threading.Thread(target=fill, daemon=True, name="oim-plane-fill")
+    filler.start()
+    try:
+        while True:
+            item = _q_get(ready_q, stop)
+            if item[0] == "done":
+                return
+            if item[0] == "error":
+                raise item[1]
+            _, buf, used, view_off = item
+            # STAGED_BYTES is incremented by the per-kind readers (file:
+            # staging.read_into; object: objectstore.read_range) — never
+            # here, which would double-count.
+            try:
+                yield view_off, buf[:used]
+            finally:
+                free_q.put(buf)
+    finally:
+        stop.set()
+        filler.join(timeout=30)
+
+
+# ------------------------------------------------------------- device land --
+
+# Transient device-byte accounting for the most recent stage_source call:
+# the peak this model claims (preallocated buffers + in-flight chunk) is
+# what the memory-bound CPU test asserts, and the ring-2 TPU test checks
+# the same bound against device.memory_stats() for real.
+LAST_STAGE_PEAK = 0
+# Total stage_source invocations — tests assert the plane (not the
+# whole-read fallback) served a given MapVolume.
+STAGE_CALLS = 0
+
+
+# Buffers beyond int32 indexing land chunks under a scoped enable_x64 so
+# the dynamic_update_slice offset can be int64 (a >2 GiB shard is exactly
+# the case the donated-buffer design exists for). Patchable for tests.
+_X64_THRESHOLD = (1 << 31) - 1
+
+
+@functools.cache
+def _updater(x64: bool):
+    import jax
+    from jax import lax
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def upd(buf, chunk, off):
+        return lax.dynamic_update_slice(buf, chunk, (off,))
+
+    return upd
+
+
+def _land_chunk(buf, chunk_np, off, device, on_cpu):
+    """One chunk into the donated device buffer at byte offset ``off``."""
+    import jax
+
+    if on_cpu:
+        # CPU jax may alias the pinned host buffer zero-copy and dispatch
+        # asynchronously; the buffer is recycled right after this call, so
+        # hand jax a real copy.
+        dchunk = jax.device_put(np.array(chunk_np), device)
+    else:
+        dchunk = jax.device_put(chunk_np, device)
+        dchunk.block_until_ready()
+        # Remote-execution backends can return from block_until_ready
+        # before the copy consumed the host buffer (BASELINE.md caveat);
+        # fetching a byte is the only portable completion fence.
+        np.asarray(dchunk[:1])
+    if buf.size > _X64_THRESHOLD:
+        with jax.enable_x64(True):
+            return _updater(True)(buf, dchunk, np.int64(off))
+    return _updater(False)(buf, dchunk, np.int32(off))
+
+
+def _device_empty(nbytes: int, device):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import SingleDeviceSharding
+
+    return jax.jit(
+        lambda: jnp.zeros((nbytes,), jnp.uint8),
+        out_shardings=SingleDeviceSharding(device),
+    )()
+
+
+def _stage_view(
+    src, runs, devices, chunk_bytes, progress, done_offset, peak
+):
+    """Stage one view (run list) onto every device in ``devices`` (they
+    hold identical slices — replication reads the host bytes once).
+    Returns ({device: uint8 buffer}, bytes landed) or (None, bytes) on
+    abort."""
+    total = sum(n for _, n in runs)
+    bufs = {d: _device_empty(total, d) for d in devices}
+    peak[0] += total * len(devices)
+    on_cpu = all(d.platform == "cpu" for d in devices)
+    done = 0
+    for view_off, chunk in iter_view_chunks(src, runs, chunk_bytes):
+        peak[1] = max(peak[1], peak[0] + chunk.size)
+        for d in devices:
+            bufs[d] = _land_chunk(bufs[d], chunk, view_off, d, on_cpu)
+            done += chunk.size
+            if progress is not None and progress(done_offset + done) is False:
+                for b in bufs.values():
+                    if hasattr(b, "delete"):
+                        b.delete()
+                return None, done
+    return bufs, done
+
+
+def _as_typed(buf, dtype, shape):
+    out = buf
+    if np.dtype(dtype) != np.uint8:
+        out = out.view(dtype)  # on-device bitcast, zero-copy
+    return out.reshape(shape)
+
+
+def placement_bytes(shape, dtype, sharding) -> int:
+    """Physical bytes the placement stages (sum of per-device slices —
+    replicated dims count once per holder), for StageStatus totals."""
+    import math
+
+    itemsize = np.dtype(dtype).itemsize
+    imap = sharding.addressable_devices_indices_map(tuple(shape))
+    total = 0
+    for index in imap.values():
+        r = slice_runs(tuple(shape), index or (), itemsize)
+        if r is None:
+            return math.prod(shape) * itemsize
+        total += sum(n for _, n in r[0])
+    return total
+
+
+def stage_source(
+    src: ExtentSource,
+    *,
+    dtype,
+    shape: tuple[int, ...],
+    sharding,
+    chunk_bytes: int = 64 << 20,
+    progress=None,
+):
+    """Stage an extent source into a device-resident jax.Array under any
+    sharding (SingleDeviceSharding or NamedSharding — sharded, replicated,
+    or both, uneven shards included).
+
+    ``progress(bytes_landed)`` returning False aborts (partial buffers
+    freed, returns None) — the StageStatus / unmap-during-staging hook.
+    Raises ValueError when the placement is not run-lowerable (caller
+    falls back to whole-array staging).
+    """
+    global LAST_STAGE_PEAK, STAGE_CALLS
+    import jax
+
+    STAGE_CALLS += 1
+    dtype = np.dtype(dtype)
+    shape = tuple(int(d) for d in shape)
+    imap = sharding.addressable_devices_indices_map(shape)
+    # Group devices holding identical slices: read each distinct slice's
+    # bytes once, land them on every replica holder.
+    groups: dict[tuple, list] = {}
+    for dev, index in imap.items():
+        key = tuple(
+            (int(s.start) if s.start is not None else 0,
+             int(s.stop) if s.stop is not None else -1)
+            for s in (index or ())
+        )
+        groups.setdefault(key, ([], index))[0].append(dev)
+    peak = [0, 0]  # [live transient bytes, peak]
+    done_offset = 0
+    shards = []
+    staged_groups = []
+    try:
+        for devs, index in groups.values():
+            lowered = slice_runs(shape, index or (), dtype.itemsize)
+            if lowered is None:
+                raise PlacementNotLowerable(
+                    f"placement of {shape} over {sharding} exceeds "
+                    f"{MAX_RUNS} runs per slice"
+                )
+            runs, slice_shape = lowered
+            bufs, done = _stage_view(
+                src, runs, devs, chunk_bytes, progress, done_offset, peak
+            )
+            done_offset += done
+            if bufs is None:  # aborted
+                for group in staged_groups:
+                    for b in group.values():
+                        if hasattr(b, "delete"):
+                            b.delete()
+                return None
+            staged_groups.append(bufs)
+            for d, b in bufs.items():
+                shards.append((d, _as_typed(b, dtype, slice_shape)))
+    finally:
+        LAST_STAGE_PEAK = peak[1]
+    from jax.sharding import SingleDeviceSharding
+
+    if isinstance(sharding, SingleDeviceSharding) and len(shards) == 1:
+        return shards[0][1]
+    return jax.make_array_from_single_device_arrays(
+        shape, sharding, [a for _, a in shards]
+    )

@@ -26,6 +26,22 @@ from oim_tpu.common.pathutil import REGISTRY_MESH
 MeshAxes = Sequence[tuple[str, int]]
 
 
+def parse_axes(spec: str) -> list[tuple[str, int]] | None:
+    """'data=4,model=2' -> [("data", 4), ("model", 2)]; '' -> None.
+
+    The one mesh-spec grammar shared by every CLI (--mesh on the trainer,
+    --device-mesh on the controller/feeder daemons)."""
+    if not spec:
+        return None
+    axes = []
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        if not size:
+            raise ValueError(f"bad mesh component {part!r} (want name=size)")
+        axes.append((name.strip(), int(size)))
+    return axes
+
+
 def _check_sizes(axes: MeshAxes, n_devices: int) -> list[tuple[str, int]]:
     axes = [(str(name), int(size)) for name, size in axes]
     total = int(np.prod([s for _, s in axes])) if axes else 1

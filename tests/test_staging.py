@@ -240,16 +240,15 @@ class TestTPUBackendChunkedStaging:
         callback sees it and aborts without stranding device memory."""
         import time as _time
 
-        from oim_tpu.data import staging as staging_mod
+        from oim_tpu.data import plane
 
-        real_stream = staging_mod.stream
+        real_reader = plane.READERS["file"]
 
-        def slow_stream(*a, **kw):
-            for chunk in real_stream(*a, **kw):
-                _time.sleep(0.05)
-                yield chunk
+        def slow_reader(*a, **kw):
+            _time.sleep(0.05)
+            return real_reader(*a, **kw)
 
-        monkeypatch.setattr(staging_mod, "stream", slow_stream)
+        monkeypatch.setitem(plane.READERS, "file", slow_reader)
         data = np.random.RandomState(8).bytes(2 << 20)
         backend, vol, _ = self._stage(tmp_path, data, chunk=1 << 18)
         _time.sleep(0.08)  # let a chunk or two land
@@ -260,18 +259,34 @@ class TestTPUBackendChunkedStaging:
         assert vol.state == StageState.FAILED
         assert "unmapped" in vol.error
 
-    def test_sharded_spec_keeps_whole_read(self, tmp_path):
-        """NamedSharding scatter needs the global array: sharded specs must
-        NOT take the single-device chunked path."""
+    def test_sharded_spec_rides_the_plane(self, tmp_path):
+        """NamedSharding scatter is served by the uniform data plane (the
+        round-3 gap: sharded placements used to fall back to whole-read +
+        one blocking device_put)."""
+        import jax
+        from jax.sharding import Mesh
+
+        from oim_tpu.controller.backend import StagedVolume, StageState
         from oim_tpu.controller.tpu_backend import TPUBackend
+        from oim_tpu.data import plane
         from oim_tpu.spec import pb
 
-        backend = TPUBackend()
-        spec = pb.ArraySpec(shape=[8, 4], dtype="float32",
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("data",))
+        data = np.arange(64 * 4, dtype=np.float32)
+        path = tmp_path / "sharded.bin"
+        path.write_bytes(data.tobytes())
+        backend = TPUBackend(mesh=mesh, chunk_bytes=100)
+        spec = pb.ArraySpec(shape=[64, 4], dtype="float32",
                             sharding_axes=["data", ""])
-        assert backend._chunkable_path(
-            type("V", (), {"spec": spec})(), "file",
-            pb.FileParams(path="x", format="raw")) is None
+        vol = StagedVolume(volume_id="v", params_key=b"", spec=spec)
+        before = plane.STAGE_CALLS
+        backend.stage(vol, "file", pb.FileParams(path=str(path), format="raw"))
+        assert vol.wait(timeout=60)
+        assert vol.state == StageState.READY, vol.error
+        assert plane.STAGE_CALLS == before + 1  # the plane, not whole-read
+        assert len(vol.array.sharding.device_set) == 4
+        np.testing.assert_array_equal(
+            np.asarray(vol.array), data.reshape(64, 4))
 
 
 class TestPrefetch:
