@@ -613,7 +613,9 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--rules", default="dp",
                         choices=("dp", "fsdp", "tp_sp", "pipe"))
     parser.add_argument("--seq-parallel", default="ring",
-                        choices=("ring", "ulysses"))
+                        choices=("ring", "zigzag", "ulysses"),
+                        help="zigzag = load-balanced causal ring "
+                             "(rules=tp_sp only)")
     parser.add_argument("--microbatches", type=int, default=4,
                         help="GPipe microbatch count (--rules pipe)")
     parser.add_argument("--remat", action="store_true",

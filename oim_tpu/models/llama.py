@@ -312,6 +312,18 @@ def make_pipelined_loss(mesh, cfg: Config, n_microbatches: int,
             )
         from oim_tpu.parallel.ring import ring_attention, ulysses_attention
 
+        if seq_parallel not in ("ring", "ulysses"):
+            # Zigzag re-lays-out the global sequence; inside the pipeline
+            # the activations are already contiguous shards and RoPE
+            # positions are derived from axis_index, so the permutation
+            # would silently mis-position tokens. Use rules=tp_sp for
+            # zigzag, or ring here (same kernels, contiguous layout).
+            # Anything else is a typo — never silently train Ulysses.
+            raise ValueError(
+                f"seq_parallel {seq_parallel!r} not supported inside the "
+                "pipelined loss (valid: 'ring', 'ulysses'; for 'zigzag' "
+                "use rules='tp_sp')"
+            )
         inner = ring_attention if seq_parallel == "ring" else ulysses_attention
 
         def sp_attn(q, k, v, causal=True):

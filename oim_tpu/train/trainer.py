@@ -95,7 +95,8 @@ def peak_hbm_bw_per_device() -> float:
 class TrainConfig:
     model: str = "llama-tiny"  # llama-tiny | llama3-8b | resnet50
     rules: str = "dp"  # dp | fsdp | tp_sp | pipe
-    seq_parallel: str = "ring"  # ring | ulysses (used when mesh seq axis > 1)
+    seq_parallel: str = "ring"  # ring | zigzag | ulysses (mesh seq axis > 1;
+    # zigzag = load-balanced causal ring: equal per-step work on every chip)
     microbatches: int = 4  # GPipe microbatch count (rules == "pipe")
     remat: bool = False  # recompute activations in bwd (fit big configs)
     remat_policy: str = ""  # "", "dots", "dots_with_no_batch_dims", "nothing"

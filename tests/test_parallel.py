@@ -126,7 +126,7 @@ def test_ring_attention_long_context_gradients():
     np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref), atol=1e-4)
 
 
-@pytest.mark.parametrize("kind", ["ring", "ulysses"])
+@pytest.mark.parametrize("kind", ["ring", "ulysses", "zigzag"])
 @pytest.mark.parametrize("hkv", [2, 1])
 def test_sequence_parallel_attention_gqa(kind, hkv):
     """GQA rides sequence parallelism without K/V head expansion: ring keeps
@@ -168,3 +168,87 @@ def test_ulysses_gqa_native_width():
     out = jax.jit(fn)(q, k, v)
     ref = mha_reference(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+class TestZigzag:
+    """Load-balanced causal ring attention (VERDICT r3 weak #2)."""
+
+    def test_schedule_is_exactly_the_causal_set(self):
+        """Union over chips x steps == the causal half-slice set: nothing
+        missing, nothing computed twice."""
+        from oim_tpu.parallel.ring import zigzag_schedule
+
+        for n in (2, 4, 8):
+            sched = zigzag_schedule(n)
+            all_pairs = [p for pairs in sched.values() for p in pairs]
+            want = {
+                (qs, ks, "diag" if qs == ks else "full")
+                for qs in range(2 * n) for ks in range(qs + 1)
+            }
+            assert len(all_pairs) == len(set(all_pairs)), "double-computed"
+            assert set(all_pairs) == want, "mask coverage broken"
+
+    def test_schedule_balanced_per_step(self):
+        """Per-chip computed-half-block counts equal (+-1) at EVERY ring
+        step — the property the contiguous layout lacks (its worst chip
+        does 2x the average and every step waits on it)."""
+        from oim_tpu.parallel.ring import zigzag_schedule
+
+        for n in (2, 4, 8):
+            sched = zigzag_schedule(n)
+            for step in range(n):
+                counts = [len(sched[(chip, step)]) for chip in range(n)]
+                assert max(counts) - min(counts) <= 1, (n, step, counts)
+
+    def test_permutation_round_trips(self):
+        from oim_tpu.parallel.ring import zigzag_permutation
+
+        perm = zigzag_permutation(32, 4)
+        assert sorted(perm.tolist()) == list(range(32))
+        # chip 0's shard = slices 0 and 7
+        assert perm[:4].tolist() == [0, 1, 2, 3]
+        assert perm[4:8].tolist() == [28, 29, 30, 31]
+
+    def test_gradients_match_dense(self):
+        mesh = build_mesh([("data", 1), ("seq", 4)])
+        rng = np.random.RandomState(7)
+        q = jnp.asarray(rng.randn(1, 64, 4, 8), jnp.float32)
+        k = jnp.asarray(rng.randn(1, 64, 2, 8), jnp.float32)
+        v = jnp.asarray(rng.randn(1, 64, 2, 8), jnp.float32)
+        zz = make_sequence_parallel_attention(mesh, kind="zigzag", causal=True)
+        for arg in range(3):
+            g = jax.grad(
+                lambda *a: jnp.sum(zz(*a) ** 2), argnums=arg)(q, k, v)
+            g_ref = jax.grad(
+                lambda *a: jnp.sum(mha_reference(*a, True) ** 2),
+                argnums=arg)(q, k, v)
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(g_ref), atol=1e-4)
+
+    def test_long_context_eight_way(self):
+        mesh = build_mesh([("data", 1), ("seq", 8)])
+        rng = np.random.RandomState(8)
+        q = jnp.asarray(rng.randn(1, 256, 2, 8), jnp.float32)
+        k = jnp.asarray(rng.randn(1, 256, 2, 8), jnp.float32)
+        v = jnp.asarray(rng.randn(1, 256, 2, 8), jnp.float32)
+        zz = make_sequence_parallel_attention(mesh, kind="zigzag", causal=True)
+        out = jax.jit(zz)(q, k, v)
+        ref = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_trainer_opt_in(self):
+        """rules=tp_sp + seq_parallel=zigzag trains end to end."""
+        from oim_tpu.train import TrainConfig, Trainer
+
+        cfg = TrainConfig(
+            model="llama-tiny", rules="tp_sp", seq_parallel="zigzag",
+            batch_size=2, seq_len=64, total_steps=2, warmup_steps=1,
+            log_every=1,
+            model_overrides={"n_layers": 2},
+        )
+        trainer = Trainer(
+            cfg,
+            axes=[("data", 1), ("fsdp", 1), ("seq", 4), ("model", 2)],
+        )
+        loss = trainer.run(steps=2)
+        assert np.isfinite(loss)
