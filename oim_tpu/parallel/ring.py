@@ -33,6 +33,17 @@ from jax import lax
 NEG_INF = -1e30
 
 
+def _merge(o, lse, o_blk, lse_blk):
+    """Blockwise-softmax accumulator merge: combine a block's (out, lse)
+    into the running pair through their logsumexps. NEG_INF is finite
+    (-1e30), so an all-masked neutral element stays NaN-free. The one
+    numerically delicate core, shared by every ring variant."""
+    lse_new = jnp.logaddexp(lse, lse_blk)
+    o = (o * jnp.exp(lse - lse_new)[..., None]
+         + o_blk * jnp.exp(lse_blk - lse_new)[..., None])
+    return o, lse_new
+
+
 def ring_attention(q, k, v, axis_name: str, causal: bool = True):
     """Ring attention over the ``axis_name`` mesh axis.
 
@@ -83,12 +94,8 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True):
             o_blk, lse_blk = lax.switch(branch, [skip, diag, full], q, k_cur, v_cur)
         else:
             o_blk, lse_blk = full(q, k_cur, v_cur)
-        # Merge normalized block outputs through their logsumexps. NEG_INF is
-        # finite (-1e30), so the all-masked neutral element stays NaN-free.
-        lse_new = jnp.logaddexp(lse, lse_blk)
-        o = (o * jnp.exp(lse - lse_new)[..., None]
-             + o_blk * jnp.exp(lse_blk - lse_new)[..., None])
-        return (o, lse_new, k_next, v_next), None
+        o, lse = _merge(o, lse, o_blk, lse_blk)
+        return (o, lse, k_next, v_next), None
 
     (o, _, _, _), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(size))
     return o.astype(q.dtype)
@@ -167,14 +174,13 @@ def zigzag_ring_attention(q, k, v, axis_name: str, causal: bool = True):
     my = lax.axis_index(axis_name)
     scale = q.shape[-1] ** -0.5
     b, t_local, h, _ = q.shape
+    if t_local % 2:
+        raise ValueError(
+            f"zigzag shards hold two equal half-slices; local seq length "
+            f"{t_local} is odd (global seq must divide 2*axis_size)"
+        )
     t2 = t_local // 2
     q_hi = q[:, t2:]
-
-    def merge(o, lse, o_blk, lse_blk):
-        lse_new = jnp.logaddexp(lse, lse_blk)
-        o = (o * jnp.exp(lse - lse_new)[..., None]
-             + o_blk * jnp.exp(lse_blk - lse_new)[..., None])
-        return o, lse_new
 
     def diag(k_cur, v_cur):
         # Concatenated-halves local causal: positions in the high half are
@@ -209,7 +215,7 @@ def zigzag_ring_attention(q, k, v, axis_name: str, causal: bool = True):
         src = (my - i) % size
         branch = jnp.where(src == my, 0, jnp.where(src < my, 1, 2))
         o_blk, lse_blk = lax.switch(branch, [diag, low, high], k_cur, v_cur)
-        o, lse = merge(o, lse, o_blk, lse_blk)
+        o, lse = _merge(o, lse, o_blk, lse_blk)
         return (o, lse, k_next, v_next), None
 
     (o, _, _, _), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(size))

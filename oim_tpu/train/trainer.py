@@ -539,7 +539,32 @@ class Trainer:
                 eval_data = synthetic_batches(
                     dataclasses.replace(cfg, seed=cfg.seed + 10_000)
                 )
-        start_step = self.init_or_resume() if self.state is None else int(self.state.step)
+        restored = False
+        if self.state is None:
+            start_step = self.init_or_resume()
+            restored = start_step > 0
+        else:
+            start_step = int(self.state.step)
+        if restored and start_step < steps:
+            # Fast-forward the feed to the resume point — ONLY when this
+            # call restored from a checkpoint (an in-memory state carried
+            # across run() calls means the caller's iterator is already
+            # positioned). Deterministic feeds (cycling volumes, seeded
+            # synthetic streams) then serve step N the same batch an
+            # uninterrupted run would have — the loss trajectory CONTINUES
+            # instead of replaying early batches (asserted by the
+            # multi-host kill/resume e2e). Cost: O(start_step) host-side
+            # batch production; for deep resumes prefer a feed that can
+            # seek (reseed/skip at the source) over replaying decode work.
+            try:
+                for _ in range(start_step):
+                    next(data)
+            except StopIteration:
+                raise RuntimeError(
+                    f"feed exhausted while fast-forwarding to resume step "
+                    f"{start_step}: the resumed feed must cover at least "
+                    "as many batches as the original run consumed"
+                ) from None
         fps = flops_per_step(cfg)
         peak = peak_flops_per_device() * self.mesh.size
         last_loss = float("nan")
@@ -599,6 +624,7 @@ class Trainer:
                 and (i + 1) % cfg.checkpoint_every == 0
             ):
                 self.checkpointer.save(i + 1, self.state)
+                log.info("checkpoint", step=i + 1, dir=cfg.checkpoint_dir)
         if self.checkpointer is not None:
             self.checkpointer.save(steps, self.state, wait=True)
         return last_loss
