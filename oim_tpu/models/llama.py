@@ -51,6 +51,10 @@ class Config:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # "gather" (index-based, the measured default) or "einsum" (GShard
+    # dense dispatch); see models/moe.py MoEConfig.dispatch and the
+    # BASELINE.md r4 measurement row.
+    moe_dispatch: str = "gather"
     # Rematerialize each layer's activations in the backward pass
     # (jax.checkpoint around the scan body): ~1/3 more FLOPs for O(1)-layer
     # activation memory — what makes 8B-class configs at long context fit
@@ -75,6 +79,7 @@ class Config:
             n_experts=self.n_experts,
             top_k=self.moe_top_k,
             capacity_factor=self.moe_capacity_factor,
+            dispatch=self.moe_dispatch,
         )
 
     @property

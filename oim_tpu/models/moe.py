@@ -25,6 +25,17 @@ class MoEConfig:
     n_experts: int = 8
     top_k: int = 2
     capacity_factor: float = 1.25
+    # "einsum": GShard dense dispatch/combine — one-hot einsums, pure MXU
+    #   work, but O(N^2 * D) FLOPs (capacity C ~ N/E makes N*E*C*D
+    #   quadratic in tokens): the measured dispatch tax behind the r3
+    #   38%-MFU MoE row.
+    # "gather": index-based — scatter token ids into the [E, C] buffer,
+    #   gather tokens into expert_in, gather expert outputs back per
+    #   routing round. O(k * N * D) data movement, no quadratic matmul.
+    #   Default since the r4 measurement: +13% step speed at cf=1.25 on
+    #   the MoE flagship (BASELINE.md), numerics identical to einsum
+    #   (tested incl. gradients and capacity drops).
+    dispatch: str = "gather"
 
 
 def init(rng, dim: int, mlp_dim: int, cfg: MoEConfig, dtype, n_layers: int | None = None):
@@ -75,12 +86,12 @@ def apply(params, x, cfg: MoEConfig):
     logits = tokens.astype(jnp.float32) @ params["router"].astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)  # [N, E]
 
-    # Top-k assignment, capacity-limited per expert.
-    combine = jnp.zeros((n, e, cap), jnp.float32)
-    dispatch = jnp.zeros((n, e, cap), bool)
+    # Top-k assignment, capacity-limited per expert. Per round we keep the
+    # (expert, pos, keep, gate) routing coordinates; the two dispatch
+    # modes consume them differently below.
     remaining = probs
-    # Track how many tokens each expert has accepted across the k rounds.
-    fill = jnp.zeros((e,), jnp.int32)
+    fill = jnp.zeros((e,), jnp.int32)  # accepted per expert across rounds
+    rounds = []
     for _ in range(k):
         gate = jnp.max(remaining, axis=-1)  # [N]
         expert = jnp.argmax(remaining, axis=-1)  # [N]
@@ -91,34 +102,79 @@ def apply(params, x, cfg: MoEConfig):
         keep = pos < cap
         fill = fill + jnp.sum(onehot * keep[:, None].astype(jnp.int32), axis=0)
         pos = jnp.clip(pos, 0, cap - 1)
-        slot = jax.nn.one_hot(pos, cap, dtype=jnp.float32)  # [N, C]
-        contrib = (
-            onehot.astype(jnp.float32)[:, :, None]
-            * slot[:, None, :]
-            * keep[:, None, None]
-        )
-        combine = combine + gate[:, None, None] * contrib
-        dispatch = jnp.logical_or(dispatch, contrib > 0)
+        rounds.append((gate, expert, pos, keep))
         remaining = remaining * (1.0 - onehot.astype(jnp.float32))
 
+    # Gate renormalization over the experts actually used (GShard). For
+    # k == 1 keep the RAW router prob (Switch): normalizing would make
+    # the gate identically 1 and kill the router's task-loss gradient.
     if k > 1:
-        # Renormalize gates over the experts actually used (GShard). For
-        # k == 1 keep the RAW router prob (Switch): normalizing would make
-        # combine identically 1 and kill the router's task-loss gradient.
-        denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
-        combine = combine / jnp.maximum(denom, 1e-9)
+        denom = sum(
+            jnp.where(keep, gate, 0.0) for gate, _, _, keep in rounds)
+        rounds = [
+            (gate / jnp.maximum(denom, 1e-9), expert, pos, keep)
+            for gate, expert, pos, keep in rounds
+        ]
 
-    # Dispatch -> expert FFN -> combine (all einsums; "expert" axis rides E).
-    expert_in = jnp.einsum(
-        "nec,nd->ecd", dispatch.astype(x.dtype), tokens
-    )  # [E, C, D]
-    h = jax.nn.silu(
-        jnp.einsum("ecd,edf->ecf", expert_in, params["w_gate"])
-    ) * jnp.einsum("ecd,edf->ecf", expert_in, params["w_up"])
-    expert_out = jnp.einsum("ecf,efd->ecd", h, params["w_down"])  # [E, C, D]
-    out = jnp.einsum(
-        "nec,ecd->nd", combine.astype(x.dtype), expert_out
-    ).reshape(b, t, d)
+    def expert_ffn(expert_in):
+        """[E, C, D] -> [E, C, D]: the expert SwiGLU, shared by both
+        dispatch modes (they must never diverge — TestMoEDispatchModes
+        asserts numerical identity)."""
+        h = jax.nn.silu(
+            jnp.einsum("ecd,edf->ecf", expert_in, params["w_gate"])
+        ) * jnp.einsum("ecd,edf->ecf", expert_in, params["w_up"])
+        return jnp.einsum("ecf,efd->ecd", h, params["w_down"])
+
+    if cfg.dispatch == "gather":
+        # Index-based dispatch: token ids scatter into the [E, C] buffer
+        # (each (expert, pos) pair is written at most once across rounds
+        # by construction), tokens gather into expert_in, and each round
+        # gathers its expert outputs straight back to token positions —
+        # O(k*N*D) movement instead of the O(N^2*D) one-hot matmuls.
+        idx_buf = jnp.zeros((e, cap), jnp.int32)
+        valid = jnp.zeros((e, cap), bool)
+        for _, expert, pos, keep in rounds:
+            # Dropped tokens redirect to the out-of-range slot `cap` and
+            # fall off via mode="drop" — they must never overwrite the
+            # legitimate occupant of slot cap-1.
+            pos_w = jnp.where(keep, pos, cap)
+            idx_buf = idx_buf.at[expert, pos_w].set(
+                jnp.arange(n, dtype=jnp.int32), mode="drop")
+            valid = valid.at[expert, pos_w].set(True, mode="drop")
+        expert_in = jnp.take(tokens, idx_buf.reshape(-1), axis=0)
+        expert_in = (expert_in.reshape(e, cap, d)
+                     * valid[..., None].astype(x.dtype))
+        flat_out = expert_ffn(expert_in).reshape(e * cap, d)
+        out = jnp.zeros((n, d), x.dtype)
+        for gate, expert, pos, keep in rounds:
+            picked = jnp.take(flat_out, expert * cap + pos, axis=0)  # [N, D]
+            w = (gate * keep).astype(x.dtype)
+            out = out + picked * w[:, None]
+        out = out.reshape(b, t, d)
+    elif cfg.dispatch == "einsum":
+        # GShard dense dispatch/combine (einsums; "expert" axis rides E).
+        combine = jnp.zeros((n, e, cap), jnp.float32)
+        dispatch = jnp.zeros((n, e, cap), bool)
+        for gate, expert, pos, keep in rounds:
+            onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)
+            slot = jax.nn.one_hot(pos, cap, dtype=jnp.float32)  # [N, C]
+            contrib = (
+                onehot[:, :, None] * slot[:, None, :] * keep[:, None, None]
+            )
+            combine = combine + gate[:, None, None] * contrib
+            dispatch = jnp.logical_or(dispatch, contrib > 0)
+        expert_in = jnp.einsum(
+            "nec,nd->ecd", dispatch.astype(x.dtype), tokens
+        )  # [E, C, D]
+        expert_out = expert_ffn(expert_in)
+        out = jnp.einsum(
+            "nec,ecd->nd", combine.astype(x.dtype), expert_out
+        ).reshape(b, t, d)
+    else:
+        raise ValueError(
+            f"unknown MoE dispatch mode {cfg.dispatch!r} "
+            "(valid: 'gather', 'einsum')"
+        )
 
     # Load-balance auxiliary loss (Switch Transformer eq. 4): E * sum_e
     # (fraction of tokens routed to e) * (mean router prob for e).

@@ -320,8 +320,12 @@ def pipeline_1f1b_value_and_grad(
         )
         h_in = lax.dynamic_index_in_dim(
             stash_x, mbf_c % sched.stash_x, keepdims=False)
+        # The LAST stage's F-tick output is never consumed (its backward
+        # recomputes the forward inside the loss vjp, and the ring wrap to
+        # stage 0 is always discarded — stage 0 injects): skip it instead
+        # of paying M wasted stage-forwards on the critical last stage.
         y_send = lax.cond(
-            mbf >= 0,
+            jnp.logical_and(mbf >= 0, idx != p - 1),
             lambda h_in=h_in: run_stage(stage_params, h_in).astype(x.dtype),
             lambda: zeros_mb,
         )
