@@ -639,7 +639,13 @@ def main(argv: list[str] | None = None) -> int:
                         help="zigzag = load-balanced causal ring "
                              "(rules=tp_sp only)")
     parser.add_argument("--microbatches", type=int, default=4,
-                        help="GPipe microbatch count (--rules pipe)")
+                        help="pipeline microbatch count (--rules pipe)")
+    parser.add_argument("--pipeline-schedule", default="gpipe",
+                        choices=("gpipe", "1f1b"),
+                        help="1f1b bounds live activations by the pipe "
+                             "depth instead of the microbatch count "
+                             "(needs microbatches %% pipe == 0; gpipe "
+                             "serves MoE and seq-in-pipe)")
     parser.add_argument("--remat", action="store_true",
                         help="recompute activations in the backward pass "
                              "(fit bigger models/batches in HBM)")
@@ -765,6 +771,7 @@ def main(argv: list[str] | None = None) -> int:
         rules=args.rules,
         seq_parallel=args.seq_parallel,
         microbatches=args.microbatches,
+        pipeline_schedule=args.pipeline_schedule,
         remat=args.remat,
         remat_policy=args.remat_policy,
         accum_steps=args.accum_steps,

@@ -348,16 +348,7 @@ def make_pipelined_loss(mesh, cfg: Config, n_microbatches: int,
                 h, layer, cfg, cos[positions], sin[positions], sp_attn
             )
     else:
-        local_attn = attn_fn if attn_fn is not None else default_attention
-
-        def layer_fn(h, layer):
-            # RoPE tables are recomputed per layer call from static shapes
-            # only; XLA constant-folds them, so nothing traced crosses the
-            # shard_map boundary by closure.
-            cos, sin = rope_frequencies(
-                cfg.head_dim, h.shape[1], cfg.rope_theta
-            )
-            return _layer(h, layer, cfg, cos, sin, local_attn)
+        layer_fn = _stage_layer_fn(cfg, attn_fn)
 
     if cfg.remat:
         # Scanned per stage inside the pipeline: prevent_cse not needed.
@@ -379,24 +370,120 @@ def make_pipelined_loss(mesh, cfg: Config, n_microbatches: int,
         x = x.reshape(n_microbatches, B // n_microbatches, T, cfg.dim)
         y, aux = pipe_fn(params["layers"], x)
         y = y.reshape(B, T, cfg.dim)
-        y = rmsnorm(y, params["final_norm"])
-        if cfg.vocab_chunk:
-            # Same chunked-vocab CE as the non-pipelined path: the
-            # [B, T, vocab] logits never materialize — at 128k vocab that
-            # is the step's biggest activation, and --rules pipe is exactly
-            # where HBM pressure peaks (ADVICE r2 #1).
-            loss = chunked_softmax_cross_entropy(
-                y, params["lm_head"], tokens[:, 1:], cfg.vocab_chunk,
-                ignore_index,
-            )
-        else:
-            logits = (y @ params["lm_head"]).astype(jnp.float32)
-            loss = softmax_cross_entropy(logits, tokens[:, 1:], ignore_index)
+        loss = _head_ce(cfg, y, params["final_norm"], params["lm_head"],
+                        tokens[:, 1:], ignore_index)
         if cfg.n_experts:
             loss = loss + cfg.moe_aux_weight * aux
         return loss
 
     return loss_fn
+
+
+def _stage_layer_fn(cfg: Config, attn_fn: AttentionFn | None,
+                    with_aux: bool = True):
+    """One decoder layer as the pipeline stage body (GPipe and 1F1B scan
+    the same function — schedule changes must never change the math).
+    RoPE tables are recomputed per call from static shapes only; XLA
+    constant-folds them, so nothing traced crosses the shard_map boundary
+    by closure."""
+    local_attn = attn_fn if attn_fn is not None else default_attention
+
+    def layer_fn(h, layer):
+        cos, sin = rope_frequencies(cfg.head_dim, h.shape[1], cfg.rope_theta)
+        out = _layer(h, layer, cfg, cos, sin, local_attn)
+        return out if with_aux else out[0]
+
+    return layer_fn
+
+
+def _head_ce(cfg: Config, y, final_norm, lm_head, targets, ignore_index):
+    """Final norm + LM head + CE, shared by both pipeline schedules.
+    Chunked-vocab CE when cfg.vocab_chunk: the [.., vocab] logits never
+    materialize — at 128k vocab that is the step's biggest activation,
+    and pipelining is exactly where HBM pressure peaks (ADVICE r2 #1)."""
+    y = rmsnorm(y, final_norm)
+    if cfg.vocab_chunk:
+        return chunked_softmax_cross_entropy(
+            y, lm_head, targets, cfg.vocab_chunk, ignore_index)
+    logits = (y @ lm_head).astype(jnp.float32)
+    return softmax_cross_entropy(logits, targets, ignore_index)
+
+
+def make_1f1b_loss(mesh, cfg: Config, n_microbatches: int,
+                   attn_fn: AttentionFn | None = None,
+                   axis: str = "pipe", ignore_index: int = -1):
+    """Next-token CE under the 1F1B schedule: returns
+    ``value_and_grad(params, tokens[B, T+1]) -> (loss, grads)`` with grads
+    shaped like ``params`` — a drop-in for ``jax.value_and_grad`` of the
+    GPipe loss, but with live activations bounded by the pipe depth
+    (parallel/pipeline_1f1b.py; the memory law in BASELINE.md).
+
+    The loss head (final norm + LM head + CE, chunked when
+    ``cfg.vocab_chunk``) runs inside the LAST stage's backward vjp; embed
+    gradients come from the returned d_x through the embedding's own vjp.
+
+    v1 restrictions (GPipe serves these): no MoE aux loss, no seq axis
+    inside the pipe, and n_microbatches % pipe_size == 0. Two more honest
+    caveats:
+    - The head/final-norm enter the 1F1B shard_map REPLICATED (hp_spec
+      P()), so a PIPE_RULES vocab-sharded lm_head is all-gathered onto
+      every stage each step — fine at flagship vocab, but the 128k-vocab
+      8B config should stay on GPipe (whose head math runs outside the
+      pipeline on the sharded array) until 1F1B learns a sharded head.
+    - The scalar is the mean of per-microbatch masked means. Without
+      ``ignore_index`` padding (the trainer's volume feeds are dense)
+      that equals GPipe's global masked mean exactly (tested); with
+      UNEVENLY padded microbatches the two weight tokens differently.
+    """
+    from oim_tpu.parallel.pipeline_1f1b import make_1f1b_value_and_grad
+
+    if cfg.n_experts:
+        raise ValueError(
+            "1F1B does not carry the MoE load-balance aux loss; use the "
+            "GPipe schedule for MoE configs"
+        )
+
+    # The stage body and loss head are THE SAME functions GPipe uses
+    # (_stage_layer_fn / _head_ce): the schedules cannot drift apart.
+    layer_fn = _stage_layer_fn(cfg, attn_fn, with_aux=False)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(
+            layer_fn, prevent_cse=False, policy=_remat_policy(cfg))
+
+    def head_loss_fn(h, hp, tgt):
+        return _head_ce(cfg, h, hp["final_norm"], hp["lm_head"], tgt,
+                        ignore_index)
+
+    vg = make_1f1b_value_and_grad(
+        mesh, layer_fn, head_loss_fn, n_microbatches, axis=axis)
+    m = n_microbatches
+
+    def value_and_grad(params, tokens):
+        inputs = tokens[:, :-1]
+        B, T = inputs.shape
+        if B % m:
+            raise ValueError(
+                f"batch {B} not divisible by {m} microbatches")
+        mb = B // m
+
+        def embed_fn(emb):
+            return emb[inputs].astype(cfg.dtype).reshape(m, mb, T, cfg.dim)
+
+        x, embed_vjp = jax.vjp(embed_fn, params["embed"])
+        targets = tokens[:, 1:].reshape(m, mb, T)
+        head = {"final_norm": params["final_norm"],
+                "lm_head": params["lm_head"]}
+        loss, d_layers, d_head, d_x = vg(params["layers"], head, x, targets)
+        (d_embed,) = embed_vjp(d_x.astype(x.dtype))
+        grads = {
+            "embed": d_embed,
+            "layers": d_layers,
+            "final_norm": d_head["final_norm"],
+            "lm_head": d_head["lm_head"],
+        }
+        return loss, grads
+
+    return value_and_grad
 
 
 def _param_counts(cfg: Config, experts: int) -> int:

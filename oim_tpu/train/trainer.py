@@ -97,7 +97,11 @@ class TrainConfig:
     rules: str = "dp"  # dp | fsdp | tp_sp | pipe
     seq_parallel: str = "ring"  # ring | zigzag | ulysses (mesh seq axis > 1;
     # zigzag = load-balanced causal ring: equal per-step work on every chip)
-    microbatches: int = 4  # GPipe microbatch count (rules == "pipe")
+    microbatches: int = 4  # pipeline microbatch count (rules == "pipe")
+    # "gpipe" (simple; MoE aux + seq-axis composition) or "1f1b"
+    # (PipeDream-flush: live activations O(P) not O(M); needs
+    # microbatches % pipe == 0, no MoE, no seq axis in the pipe).
+    pipeline_schedule: str = "gpipe"
     remat: bool = False  # recompute activations in bwd (fit big configs)
     remat_policy: str = ""  # "", "dots", "dots_with_no_batch_dims", "nothing"
     accum_steps: int = 1  # gradient accumulation: split the batch, one update
@@ -218,6 +222,18 @@ def make_train_step(
                     "pipe rules need a mesh with a 'pipe' axis "
                     f"(got axes {tuple(mesh.shape)}); e.g. --mesh data=2,pipe=2"
                 )
+            if cfg.pipeline_schedule not in ("gpipe", "1f1b"):
+                raise ValueError(
+                    f"unknown pipeline_schedule {cfg.pipeline_schedule!r} "
+                    "(valid: 'gpipe', '1f1b')"
+                )
+            if cfg.pipeline_schedule == "1f1b" and pipe_with_seq:
+                raise ValueError(
+                    "1F1B does not compose with a seq axis inside the "
+                    "pipe; use the gpipe schedule (or rules=tp_sp)"
+                )
+            # GPipe loss always exists: it is the eval forward even when
+            # the train step's gradients come from the 1F1B schedule.
             pipe_loss = llama.make_pipelined_loss(
                 mesh, mcfg, cfg.microbatches, attn_fn,
                 seq_axis="seq" if pipe_with_seq else None,
@@ -305,6 +321,16 @@ def make_train_step(
     init_fn = jax.jit(abstract_state, out_shardings=state_shardings)
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    if (cfg.model.startswith("llama") and cfg.rules == "pipe"
+            and cfg.pipeline_schedule == "1f1b"):
+        # The 1F1B schedule computes its own gradients (manual interleaved
+        # vjp — jax.grad over the tick loop would pin every microbatch's
+        # activations and defeat the schedule). Same signature as grad_fn.
+        vg_1f1b = llama.make_1f1b_loss(mesh, mcfg, cfg.microbatches, attn_fn)
+
+        def grad_fn(params, extra, batch):  # noqa: F811 - deliberate override
+            loss, grads = vg_1f1b(params, batch["tokens"])
+            return (loss, extra), grads
     accum = max(1, cfg.accum_steps)
 
     def compute_grads(params, extra, batch):

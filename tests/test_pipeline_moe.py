@@ -461,3 +461,40 @@ class TestMoEDispatchModes:
         out_g, _ = moe.apply(params, x, cfg_g)
         np.testing.assert_allclose(np.asarray(out_e), np.asarray(out_g),
                                    atol=1e-6)
+
+
+class Test1F1BTrainer:
+    """--pipeline-schedule 1f1b: the trainer's pipe rules can train under
+    the 1F1B schedule, producing the same loss trajectory as GPipe (the
+    scalar and its gradients are identical; only the schedule differs)."""
+
+    def _run(self, schedule, steps=2):
+        cfg = TrainConfig(
+            model="llama-tiny", rules="pipe", microbatches=4,
+            pipeline_schedule=schedule, batch_size=8, seq_len=32,
+            log_every=1, warmup_steps=1, total_steps=steps,
+            model_overrides={"n_layers": 4},
+        )
+        trainer = Trainer(cfg, axes=[("data", 2), ("pipe", 2)])
+        return trainer.run(steps=steps)
+
+    def test_matches_gpipe_trajectory(self):
+        loss_g = self._run("gpipe")
+        loss_f = self._run("1f1b")
+        assert np.isfinite(loss_f)
+        np.testing.assert_allclose(loss_f, loss_g, rtol=2e-4)
+
+    def test_moe_rejected(self):
+        cfg = TrainConfig(
+            model="llama-tiny-moe", rules="pipe", microbatches=4,
+            pipeline_schedule="1f1b", batch_size=8, seq_len=32,
+        )
+        with pytest.raises(ValueError, match="MoE"):
+            Trainer(cfg, axes=[("data", 2), ("pipe", 2)])
+
+    def test_unknown_schedule_rejected(self):
+        cfg = TrainConfig(
+            model="llama-tiny", rules="pipe", pipeline_schedule="2f2b",
+        )
+        with pytest.raises(ValueError, match="pipeline_schedule"):
+            Trainer(cfg, axes=[("data", 2), ("pipe", 2)])
