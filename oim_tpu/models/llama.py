@@ -422,19 +422,24 @@ def make_1f1b_loss(mesh, cfg: Config, n_microbatches: int,
     ``cfg.vocab_chunk``) runs inside the LAST stage's backward vjp; embed
     gradients come from the returned d_x through the embedding's own vjp.
 
+    The LM head stays VOCAB-SHARDED over the pipe axis, matching
+    PIPE_RULES: the loss is a vocab-parallel CE (ops/losses.py
+    vocab_parallel_cross_entropy — Megatron's shape) computed by every
+    stage on its own 1/P vocab slice, so a 128k-vocab head is never
+    all-gathered (and the [.., V] logits never exist on any device; the
+    per-device logits slice is [mb, T, V/P], which is why
+    ``cfg.vocab_chunk`` is not additionally applied here).
+
     v1 restrictions (GPipe serves these): no MoE aux loss, no seq axis
-    inside the pipe, and n_microbatches % pipe_size == 0. Two more honest
-    caveats:
-    - The head/final-norm enter the 1F1B shard_map REPLICATED (hp_spec
-      P()), so a PIPE_RULES vocab-sharded lm_head is all-gathered onto
-      every stage each step — fine at flagship vocab, but the 128k-vocab
-      8B config should stay on GPipe (whose head math runs outside the
-      pipeline on the sharded array) until 1F1B learns a sharded head.
-    - The scalar is the mean of per-microbatch masked means. Without
-      ``ignore_index`` padding (the trainer's volume feeds are dense)
-      that equals GPipe's global masked mean exactly (tested); with
-      UNEVENLY padded microbatches the two weight tokens differently.
+    inside the pipe, and n_microbatches % pipe_size == 0. One honest
+    caveat: the scalar is the mean of per-microbatch masked means —
+    without ``ignore_index`` padding (the trainer's volume feeds are
+    dense) that equals GPipe's global masked mean exactly (tested); with
+    UNEVENLY padded microbatches the two weight tokens differently.
     """
+    from jax.sharding import PartitionSpec as P
+
+    from oim_tpu.ops.losses import vocab_parallel_cross_entropy
     from oim_tpu.parallel.pipeline_1f1b import make_1f1b_value_and_grad
 
     if cfg.n_experts:
@@ -443,19 +448,23 @@ def make_1f1b_loss(mesh, cfg: Config, n_microbatches: int,
             "GPipe schedule for MoE configs"
         )
 
-    # The stage body and loss head are THE SAME functions GPipe uses
-    # (_stage_layer_fn / _head_ce): the schedules cannot drift apart.
+    # The stage body is THE SAME function GPipe scans (_stage_layer_fn):
+    # the schedules cannot drift apart.
     layer_fn = _stage_layer_fn(cfg, attn_fn, with_aux=False)
     if cfg.remat:
         layer_fn = jax.checkpoint(
             layer_fn, prevent_cse=False, policy=_remat_policy(cfg))
 
     def head_loss_fn(h, hp, tgt):
-        return _head_ce(cfg, h, hp["final_norm"], hp["lm_head"], tgt,
-                        ignore_index)
+        y = rmsnorm(h, hp["final_norm"])
+        return vocab_parallel_cross_entropy(
+            y, hp["lm_head"], tgt, axis, ignore_index)
 
     vg = make_1f1b_value_and_grad(
-        mesh, layer_fn, head_loss_fn, n_microbatches, axis=axis)
+        mesh, layer_fn, head_loss_fn, n_microbatches, axis=axis,
+        head_specs={"final_norm": P(), "lm_head": P(None, axis)},
+        sharded_head=True,
+    )
     m = n_microbatches
 
     def value_and_grad(params, tokens):

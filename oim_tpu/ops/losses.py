@@ -138,3 +138,45 @@ def chunked_softmax_cross_entropy(
         mask = (yf != ignore_index).astype(jnp.float32)
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
     return jnp.mean(nll)
+
+
+def vocab_parallel_cross_entropy(
+    y, lm_head_shard, labels, axis: str,
+    ignore_index: int | None = None,
+):
+    """Mean token CE with the LM head VOCAB-SHARDED over mesh ``axis``.
+
+    Must run inside shard_map with ``axis`` bound. ``y`` [.., D] is
+    replicated across the axis; ``lm_head_shard`` [D, V/n] is this
+    device's contiguous vocab slice (device i owns rows [i*V/n,
+    (i+1)*V/n)); ``labels`` are GLOBAL vocab ids. The softmax
+    normalizer is assembled with a pmax + psum (the Megatron
+    vocab-parallel CE shape), so the full [.., V] logits never exist on
+    any device — what lets the 1F1B pipeline keep a 128k-vocab head
+    sharded over the pipe axis instead of all-gathering it. Collectives
+    are differentiable, so one jax.vjp through this yields the sharded
+    head gradient and d_y directly.
+    """
+    from jax import lax
+
+    idx = lax.axis_index(axis)
+    z = (y @ lm_head_shard).astype(jnp.float32)  # [.., V/n]
+    vshard = z.shape[-1]
+    local_max = jnp.max(z, axis=-1)
+    # stop_gradient BEFORE the pmax: the max is only a numerical shift
+    # (the CE value and gradient are invariant to it), and pmax has no
+    # differentiation rule — the tracer must never reach it.
+    gmax = lax.pmax(lax.stop_gradient(local_max), axis)
+    sumexp = lax.psum(
+        jnp.sum(jnp.exp(z - gmax[..., None]), axis=-1), axis)
+    logz = gmax + jnp.log(sumexp)
+    offset = idx * vshard
+    local_label = jnp.clip(labels - offset, 0, vshard - 1)
+    mine = (labels >= offset) & (labels < offset + vshard)
+    picked = jnp.take_along_axis(z, local_label[..., None], axis=-1)[..., 0]
+    label_logits = lax.psum(jnp.where(mine, picked, 0.0), axis)
+    nll = logz - label_logits
+    if ignore_index is not None:
+        mask = (labels != ignore_index).astype(jnp.float32)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)

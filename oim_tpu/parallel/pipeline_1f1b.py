@@ -220,6 +220,8 @@ def pipeline_1f1b_value_and_grad(
     n_microbatches: int,
     axis: str = "pipe",
     batch_axes: tuple[str, ...] = (),
+    sharded_head: bool = False,
+    head_is_sharded: Any = None,
 ):
     """1F1B forward+backward inside shard_map; returns
     (loss, d_stage_params, d_head_params, d_x).
@@ -229,6 +231,28 @@ def pipeline_1f1b_value_and_grad(
     head_loss_fn(h, head_params, target_mb) -> scalar per-microbatch MEAN
         loss (final norm + LM head + CE); runs inside the LAST stage's
         backward vjp.
+
+    ``sharded_head=True`` changes where the loss head runs: head_params
+    may be SHARDED over the pipe axis (e.g. a vocab-parallel LM head with
+    collectives inside head_loss_fn — ops/losses.py
+    vocab_parallel_cross_entropy), so the head must execute on EVERY
+    stage, unconditionally (collectives cannot live inside a cond). The
+    last stage's F-tick output is stashed and broadcast with one masked
+    psum per backward tick; every stage computes its head shard's loss
+    contribution and gradient, and the last stage seeds its stage
+    backward with the resulting d_h. Per-device head compute is
+    ~2(M+P-1)/P microbatches' worth — LESS than the replicated mode's M
+    for P > 2 — and no stage ever holds more than its 1/P head slice.
+
+    GRADIENT CONTRACT for sharded_head: inside shard_map with
+    check_vma=False, psum transposes to psum, so the per-device
+    ``jax.vjp`` of head_loss_fn returns P x the device's LOCAL partial
+    gradient for every input whose path crosses exactly ONE collective
+    (vocab_parallel_cross_entropy's shape). The kernel applies the exact
+    correction: replicated inputs (hb, replicated head leaves per
+    ``head_is_sharded``) get psum(g)/P (= the sum of true partials);
+    shard-local leaves get g/P. head_loss_fn must therefore keep ONE
+    collective layer per gradient path — nesting psums would need P^2.
     x: [M/P, mb, ...] THIS STAGE'S SHARD of the microbatched stage-0
         input (the microbatch dim is sharded over the pipe axis — holding
         the full [M, ...] on every stage would put O(M) bytes back on
@@ -283,8 +307,13 @@ def pipeline_1f1b_value_and_grad(
         return lax.psum(mine, axis)
 
     def tick(carry, rows):
-        (stash_x, stash_dh, d_stage, d_head, d_x, loss_acc,
-         y_recv, dh_recv) = carry
+        if sharded_head:
+            (stash_x, stash_dh, stash_y, d_stage, d_head, d_x, loss_acc,
+             y_recv, dh_recv) = carry
+        else:
+            (stash_x, stash_dh, d_stage, d_head, d_x, loss_acc,
+             y_recv, dh_recv) = carry
+            stash_y = None
         arr_f = rows["arr_f"][idx]
         arr_b = rows["arr_b"][idx]
         mbf = rows["fwd"][idx]
@@ -320,15 +349,34 @@ def pipeline_1f1b_value_and_grad(
         )
         h_in = lax.dynamic_index_in_dim(
             stash_x, mbf_c % sched.stash_x, keepdims=False)
-        # The LAST stage's F-tick output is never consumed (its backward
-        # recomputes the forward inside the loss vjp, and the ring wrap to
-        # stage 0 is always discarded — stage 0 injects): skip it instead
-        # of paying M wasted stage-forwards on the critical last stage.
-        y_send = lax.cond(
-            jnp.logical_and(mbf >= 0, idx != p - 1),
-            lambda h_in=h_in: run_stage(stage_params, h_in).astype(x.dtype),
-            lambda: zeros_mb,
-        )
+        if sharded_head:
+            # The last stage's output feeds the unconditional head phase
+            # below: compute and stash it on every F tick.
+            y_val = lax.cond(
+                mbf >= 0,
+                lambda h_in=h_in: run_stage(stage_params,
+                                            h_in).astype(x.dtype),
+                lambda: zeros_mb,
+            )
+            stash_y = jnp.where(
+                mbf >= 0,
+                lax.dynamic_update_index_in_dim(
+                    stash_y, y_val, mbf_c % sched.stash_x, axis=0),
+                stash_y,
+            )
+            y_send = y_val
+        else:
+            # The LAST stage's F-tick output is never consumed (its
+            # backward recomputes the forward inside the loss vjp, and the
+            # ring wrap to stage 0 is always discarded — stage 0 injects):
+            # skip it instead of paying M wasted stage-forwards on the
+            # critical last stage.
+            y_send = lax.cond(
+                jnp.logical_and(mbf >= 0, idx != p - 1),
+                lambda h_in=h_in: run_stage(stage_params,
+                                            h_in).astype(x.dtype),
+                lambda: zeros_mb,
+            )
 
         # --- backward tick --------------------------------------------
         mbb_c = jnp.maximum(mbb, 0)
@@ -338,37 +386,80 @@ def pipeline_1f1b_value_and_grad(
             stash_dh, mbb_c % sched.stash_dh, keepdims=False)
         # Targets go to the LAST stage's microbatch this tick; d_x comes
         # back from STAGE 0's. Both psums use the consumer's row.
-        tgt_j = owner_slice(targets, jnp.maximum(rows["bwd_last"], 0))
+        jl = rows["bwd_last"]
+        jl_c = jnp.maximum(jl, 0)
+        tgt_j = owner_slice(targets, jl_c)
 
-        def bwd_last(x_j=x_j, tgt_j=tgt_j):
-            loss_j, vjp = jax.vjp(
-                lambda sp, hp, xx: head_loss_fn(run_stage(sp, xx), hp,
-                                                tgt_j),
-                stage_params, head_params, x_j)
-            d_sp, d_hp, d_xj = vjp(jnp.asarray(inv_m, loss_j.dtype))
-            return loss_j, d_sp, d_hp, d_xj.astype(jnp.float32)
+        if sharded_head:
+            # --- vocab-parallel head phase (unconditional: collectives
+            # inside head_loss_fn must run on every stage every tick) ---
+            y_jl = lax.dynamic_index_in_dim(
+                stash_y, jl_c % sched.stash_x, keepdims=False)
+            hb = lax.psum(
+                jnp.where(idx == p - 1, y_jl, zeros_mb), axis)
+            loss_jl, head_vjp = jax.vjp(
+                lambda hp, h: head_loss_fn(h, hp, tgt_j), head_params, hb)
+            d_hp_l, d_hb = head_vjp(jnp.asarray(inv_m, loss_jl.dtype))
+            # Per-device vjp cotangents are P x the LOCAL partials (see
+            # the gradient contract in the docstring): replicated inputs
+            # need the SUM of all devices' partials, shard-local inputs
+            # just their own.
+            d_hb = lax.psum(d_hb, axis) / p
+            d_hp_l = jax.tree.map(
+                lambda g, shd: g / p if shd else lax.psum(g, axis) / p,
+                d_hp_l, head_is_sharded)
+            active_l = jl >= 0
+            loss_acc = loss_acc + jnp.where(active_l, loss_jl, 0.0) * inv_m
+            d_head = jax.tree.map(
+                lambda a, g: a + jnp.where(active_l, g, jnp.zeros_like(g)),
+                d_head, d_hp_l)
+            # On the last stage, mbb == jl by construction: its stage
+            # backward seeds from the head phase's cotangent.
+            dh_eff = jnp.where(idx == p - 1,
+                               d_hb.astype(jnp.float32), dh_j)
 
-        def bwd_mid(x_j=x_j, dh_j=dh_j):
-            _, vjp = jax.vjp(
-                lambda sp, xx: run_stage(sp, xx), stage_params, x_j)
-            d_sp, d_xj = vjp(dh_j.astype(x.dtype))
-            return (jnp.zeros((), jnp.float32), d_sp,
-                    _tree_zeros_like(head_params),
-                    d_xj.astype(jnp.float32))
+            def bwd_active(x_j=x_j, dh_eff=dh_eff):
+                _, vjp = jax.vjp(
+                    lambda sp, xx: run_stage(sp, xx), stage_params, x_j)
+                d_sp, d_xj = vjp(dh_eff.astype(x.dtype))
+                return d_sp, d_xj.astype(jnp.float32)
 
-        def bwd_idle():
-            return (jnp.zeros((), jnp.float32),
-                    _tree_zeros_like(stage_params),
-                    _tree_zeros_like(head_params), f32_mb)
+            d_sp, d_xj = lax.cond(
+                mbb >= 0,
+                bwd_active,
+                lambda: (_tree_zeros_like(stage_params), f32_mb),
+            )
+            d_stage = jax.tree.map(lambda a, g: a + g, d_stage, d_sp)
+        else:
+            def bwd_last(x_j=x_j, tgt_j=tgt_j):
+                loss_j, vjp = jax.vjp(
+                    lambda sp, hp, xx: head_loss_fn(run_stage(sp, xx), hp,
+                                                    tgt_j),
+                    stage_params, head_params, x_j)
+                d_sp, d_hp, d_xj = vjp(jnp.asarray(inv_m, loss_j.dtype))
+                return loss_j, d_sp, d_hp, d_xj.astype(jnp.float32)
 
-        loss_j, d_sp, d_hp, d_xj = lax.cond(
-            mbb >= 0,
-            lambda: lax.cond(idx == p - 1, bwd_last, bwd_mid),
-            bwd_idle,
-        )
-        loss_acc = loss_acc + loss_j * inv_m
-        d_stage = jax.tree.map(lambda a, g: a + g, d_stage, d_sp)
-        d_head = jax.tree.map(lambda a, g: a + g, d_head, d_hp)
+            def bwd_mid(x_j=x_j, dh_j=dh_j):
+                _, vjp = jax.vjp(
+                    lambda sp, xx: run_stage(sp, xx), stage_params, x_j)
+                d_sp, d_xj = vjp(dh_j.astype(x.dtype))
+                return (jnp.zeros((), jnp.float32), d_sp,
+                        _tree_zeros_like(head_params),
+                        d_xj.astype(jnp.float32))
+
+            def bwd_idle():
+                return (jnp.zeros((), jnp.float32),
+                        _tree_zeros_like(stage_params),
+                        _tree_zeros_like(head_params), f32_mb)
+
+            loss_j, d_sp, d_hp, d_xj = lax.cond(
+                mbb >= 0,
+                lambda: lax.cond(idx == p - 1, bwd_last, bwd_mid),
+                bwd_idle,
+            )
+            loss_acc = loss_acc + loss_j * inv_m
+            d_stage = jax.tree.map(lambda a, g: a + g, d_stage, d_sp)
+            d_head = jax.tree.map(lambda a, g: a + g, d_head, d_hp)
         # Stage 0's input cotangent travels back to the microbatch's OWNER
         # stage, which banks it in its d_x shard (collective outside
         # conds). The banked microbatch is STAGE 0's bwd row this tick.
@@ -386,6 +477,9 @@ def pipeline_1f1b_value_and_grad(
         # --- communication (unconditional; outside every cond) --------
         y_recv = ppermute_ring(y_send, axis)            # activations ->
         dh_recv = ppermute_ring(d_xj, axis, shift=-1)   # cotangents <-
+        if sharded_head:
+            return (stash_x, stash_dh, stash_y, d_stage, d_head, d_x,
+                    loss_acc, y_recv, dh_recv), None
         return (stash_x, stash_dh, d_stage, d_head, d_x, loss_acc,
                 y_recv, dh_recv), None
 
@@ -401,6 +495,8 @@ def pipeline_1f1b_value_and_grad(
     carry0 = (
         jnp.zeros((sched.stash_x,) + mb_shape, x.dtype),
         jnp.zeros((sched.stash_dh,) + mb_shape, jnp.float32),
+    ) + ((jnp.zeros((sched.stash_x,) + mb_shape, x.dtype),)
+         if sharded_head else ()) + (
         _tree_zeros_like(stage_params),
         _tree_zeros_like(head_params),
         jnp.zeros_like(x),
@@ -408,16 +504,23 @@ def pipeline_1f1b_value_and_grad(
         zeros_mb,  # y_recv (tick-0 arrival rows are all -1)
         f32_mb,    # dh_recv
     )
-    (_, _, d_stage, d_head, d_x, loss_acc, _, _), _ = lax.scan(
-        tick, carry0, rows)
+    out_carry, _ = lax.scan(tick, carry0, rows)
+    d_stage, d_head, d_x, loss_acc = out_carry[-6:-2]
 
-    # Loss and head grads live on the last stage; d_x is already banked
-    # per owner stage (sharded like x).
-    loss = lax.psum(jnp.where(idx == p - 1, loss_acc, 0.0), axis)
-    d_head = jax.tree.map(
-        lambda g: lax.psum(jnp.where(idx == p - 1, g, jnp.zeros_like(g)),
-                           axis),
-        d_head)
+    if sharded_head:
+        # The head phase computed loss/d_head identically on every stage
+        # (from replicated collectives) except that each stage's lm_head
+        # grad is ITS OWN shard — exactly the sharded out_specs: no
+        # cross-stage reduction needed, and loss is already replicated.
+        loss = loss_acc
+    else:
+        # Loss and head grads live on the last stage; d_x is already
+        # banked per owner stage (sharded like x).
+        loss = lax.psum(jnp.where(idx == p - 1, loss_acc, 0.0), axis)
+        d_head = jax.tree.map(
+            lambda g: lax.psum(
+                jnp.where(idx == p - 1, g, jnp.zeros_like(g)), axis),
+            d_head)
     batch_shards = 1
     for b in batch_axes:
         batch_shards = batch_shards * lax.psum(1, b)
@@ -441,6 +544,8 @@ def make_1f1b_value_and_grad(
     n_microbatches: int,
     axis: str = "pipe",
     batch_axes: tuple[str, ...] | None = None,
+    head_specs: Any = None,
+    sharded_head: bool = False,
 ):
     """shard_map-wrapped 1F1B over ``mesh``: returns
     vg(stacked_params, head_params, x, targets) ->
@@ -463,15 +568,27 @@ def make_1f1b_value_and_grad(
     x_spec = P(axis, batch_axes or None)
     tgt_spec = P(axis, batch_axes or None)
 
+    def _mentions_axis(spec) -> bool:
+        for part in tuple(spec or ()):
+            if part == axis or (isinstance(part, tuple) and axis in part):
+                return True
+        return False
+
     def vg(stacked_params, head_params, x, targets):
         sp_spec = jax.tree.map(lambda _: P(axis), stacked_params)
-        hp_spec = jax.tree.map(lambda _: P(), head_params)
+        if head_specs is not None:
+            hp_spec = head_specs
+        else:
+            hp_spec = jax.tree.map(lambda _: P(), head_params)
+        head_is_sharded = jax.tree.map(
+            _mentions_axis, hp_spec, is_leaf=lambda s: isinstance(s, P))
         return shard_map(
             functools.partial(
                 pipeline_1f1b_value_and_grad,
                 layer_fn, head_loss_fn,
                 n_microbatches=n_microbatches, axis=axis,
-                batch_axes=batch_axes,
+                batch_axes=batch_axes, sharded_head=sharded_head,
+                head_is_sharded=head_is_sharded,
             ),
             mesh=mesh,
             in_specs=(sp_spec, hp_spec, x_spec, tgt_spec),
