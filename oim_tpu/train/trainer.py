@@ -98,9 +98,9 @@ class TrainConfig:
     seq_parallel: str = "ring"  # ring | zigzag | ulysses (mesh seq axis > 1;
     # zigzag = load-balanced causal ring: equal per-step work on every chip)
     microbatches: int = 4  # pipeline microbatch count (rules == "pipe")
-    # "gpipe" (simple; MoE aux + seq-axis composition) or "1f1b"
-    # (PipeDream-flush: live activations O(P) not O(M); needs
-    # microbatches % pipe == 0, no MoE, no seq axis in the pipe).
+    # "gpipe" (simple) or "1f1b" (PipeDream-flush: live activations O(P)
+    # not O(M); needs microbatches % pipe == 0). Both compose with MoE
+    # and with a seq axis inside the pipe (ring/ulysses/zigzag).
     pipeline_schedule: str = "gpipe"
     remat: bool = False  # recompute activations in bwd (fit big configs)
     remat_policy: str = ""  # "", "dots", "dots_with_no_batch_dims", "nothing"
@@ -227,11 +227,6 @@ def make_train_step(
                     f"unknown pipeline_schedule {cfg.pipeline_schedule!r} "
                     "(valid: 'gpipe', '1f1b')"
                 )
-            if cfg.pipeline_schedule == "1f1b" and pipe_with_seq:
-                raise ValueError(
-                    "1F1B does not compose with a seq axis inside the "
-                    "pipe; use the gpipe schedule (or rules=tp_sp)"
-                )
             # GPipe loss always exists: it is the eval forward even when
             # the train step's gradients come from the 1F1B schedule.
             pipe_loss = llama.make_pipelined_loss(
@@ -326,7 +321,11 @@ def make_train_step(
         # The 1F1B schedule computes its own gradients (manual interleaved
         # vjp — jax.grad over the tick loop would pin every microbatch's
         # activations and defeat the schedule). Same signature as grad_fn.
-        vg_1f1b = llama.make_1f1b_loss(mesh, mcfg, cfg.microbatches, attn_fn)
+        vg_1f1b = llama.make_1f1b_loss(
+            mesh, mcfg, cfg.microbatches, attn_fn,
+            seq_axis="seq" if pipe_with_seq else None,
+            seq_parallel=cfg.seq_parallel,
+        )
 
         def grad_fn(params, extra, batch):  # noqa: F811 - deliberate override
             loss, grads = vg_1f1b(params, batch["tokens"])
