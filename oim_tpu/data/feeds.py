@@ -1,0 +1,668 @@
+"""Feed construction: volumes -> training batches (VERDICT r4 weak #6).
+
+Everything between a published OIM volume and the Trainer's batch
+iterator lives here: source-kind dispatch (raw/npy file, labeled
+TFRecord, webdataset token/image shards), whole-volume vs windowed
+streaming (host working set = one window/shard, the hot-path rule of
+SURVEY section 3.5 applied to the feed), record framing and epoch-wrap
+rules, label validation, and host-side JPEG decode (native batch decoder
+with a Pillow thread-pool fallback). ``cli/oim_trainer.py`` is flag
+parsing that calls into this module.
+
+The ``args`` objects are the trainer CLI's parsed namespaces (any object
+with the same attributes works — tests build them with
+argparse.Namespace).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from oim_tpu.common.logging import from_context
+from oim_tpu.train import TrainConfig
+
+def eval_feed_args(args):
+    """The feed arguments for the held-out eval volume, or None when no
+    --eval-volume-* source was given. The eval volume stages as
+    '<volume>-eval' (its own MapVolume, never shadowing the training
+    volume), materialized whole and never shuffled — every eval pass sees
+    the same batches, so the metric is comparable across steps. Covers
+    all three source kinds: file, labeled TFRecord, and webdataset shard
+    lists (token or jpg/cls — the config-5 shape)."""
+    if not (args.eval_volume_file or args.eval_volume_tfrecord
+            or args.eval_volume_webdataset):
+        return None
+    return argparse.Namespace(**{
+        **vars(args),
+        "volume": f"{args.volume}-eval",
+        "volume_file": args.eval_volume_file,
+        "volume_tfrecord": args.eval_volume_tfrecord,
+        "volume_webdataset": args.eval_volume_webdataset,
+        "feed_window_bytes": 0,
+        "shuffle": False,
+    })
+
+
+def feeder_batches(args, cfg: TrainConfig, tls, start_batch: int = 0):
+    """Batches from a feeder-published volume.
+
+    Default (--feed-window-bytes > 0): a WINDOWED stream — only one window
+    of the volume is host-resident at a time (ranged ReadVolume through the
+    proxy in remote mode), so a volume larger than host RAM trains fine;
+    the hot-path rule of SURVEY §3.5 applied to the feed. With
+    --feed-window-bytes 0 the whole volume is materialized once and batches
+    are views (config-3 style, fine for small volumes).
+    """
+    from oim_tpu.feeder import Feeder
+    from oim_tpu.spec import pb
+
+    feeder = Feeder(
+        registry_address=args.registry,
+        controller_id=args.controller_id,
+        tls=tls,
+    )
+    req = pb.MapVolumeRequest(volume_id=args.volume)
+    if getattr(args, "volume_webdataset", ""):
+        req.webdataset.shard_urls.extend(
+            u for u in args.volume_webdataset.split(",") if u
+        )
+    elif getattr(args, "volume_tfrecord", ""):
+        # Checked BEFORE publish: staging a multi-GB volume only to discover
+        # the model can't consume it would waste minutes and HBM.
+        if cfg.model.startswith("llama"):
+            raise SystemExit(
+                "--volume-tfrecord holds labeled tf.Example images (feeds "
+                "resnet); llama-family models take --volume-file or "
+                "--volume-webdataset token volumes"
+            )
+        req.tfrecord.paths.extend(
+            p for p in args.volume_tfrecord.split(",") if p
+        )
+    elif args.volume_file:
+        req.file.path = args.volume_file
+        req.file.format = "npy" if args.volume_file.endswith(".npy") else "raw"
+    else:
+        req.malloc.SetInParent()
+    pub = feeder.publish(req, timeout=args.publish_timeout)
+    window = getattr(args, "feed_window_bytes", 0)
+    if start_batch and window > 0:
+        raise ValueError(
+            "start_batch repositioning is a whole-volume-feed feature "
+            "(--feed-window-bytes 0); windowed feeds replay instead"
+        )
+    kind = req.WhichOneof("params")
+    if kind == "webdataset":
+        if cfg.model.startswith("llama"):
+            # Config-5 shape: llama fed from webdataset shards through
+            # MapVolume. Shards are tars, so windows are SHARD-granular (a
+            # byte window could split a header): with --feed-window-bytes >
+            # 0 one shard is host-resident at a time; 0 materializes the
+            # volume.
+            yield from _webdataset_token_batches(
+                args, cfg, feeder, pub, list(req.webdataset.shard_urls),
+                start_batch)
+        else:
+            # Supervised vision: jpg/cls sample pairs, decoded host-side.
+            yield from _webdataset_image_batches(
+                args, cfg, feeder, pub, list(req.webdataset.shard_urls),
+                start_batch)
+        return
+    if kind == "tfrecord":
+        # Labeled tf.Example records (image/encoded + image/class/label):
+        # the framed bytes are staged; framing + proto parse + JPEG decode
+        # happen in the feed — real labels end to end (config 3/4).
+        yield from _tfrecord_image_batches(args, cfg, feeder, pub,
+                                           start_batch)
+        return
+
+    if window <= 0:
+        # Whole-volume mode: local hands back the live array; remote streams
+        # the full data window through the proxy (ReadVolume).
+        data = np.asarray(pub.array) if pub.array is not None else feeder.fetch(
+            args.volume, timeout=args.publish_timeout)
+        from_context().info(
+            "volume published", volume=args.volume, shape=str(data.shape)
+        )
+        seed = _shuffle_seed(args)
+        if cfg.model.startswith("llama"):
+            yield from _cycle_token_batches(
+                data.reshape(-1), cfg, args.volume, seed, start_batch)
+        else:
+            # Raw byte volumes carry no labels anywhere: this path is a
+            # bandwidth/e2e shape, not supervised training. Say so loudly
+            # instead of letting a zero-label loss masquerade as learning.
+            from_context().warning(
+                "raw image volume has no labels (training against zeros); "
+                "use --volume-tfrecord or --volume-webdataset jpg/cls for "
+                "supervised vision"
+            )
+            # Keep the source dtype: uint8 volumes ride to the device
+            # as uint8 (resnet.apply normalizes on-chip; 1/4 the H2D
+            # bytes); float volumes are assumed pre-normalized.
+            images = np.asarray(data)
+            labels = np.zeros((images.shape[0],), np.int32)
+            for idx in _cycle_indices(images.shape[0], cfg.batch_size,
+                                      seed, start_batch):
+                yield {"images": images[idx], "labels": labels[idx]}
+        return
+
+    from oim_tpu.controller.backend import spec_dtype
+
+    # The first window also carries the volume's ArraySpec (dtype/shape).
+    w, total, spec = feeder.fetch_window(
+        args.volume, 0, window, timeout=args.publish_timeout, heal=True
+    )
+    dt = (np.dtype(spec_dtype(spec))
+          if spec is not None and spec.dtype else np.dtype(np.uint8))
+    if cfg.model.startswith("llama"):
+        rec_bytes = (cfg.seq_len + 1) * dt.itemsize
+
+        def to_batch(raw):
+            recs = raw.view(dt).reshape(cfg.batch_size, -1)
+            return {"tokens": recs.astype(np.int32)}
+    else:
+        if spec is not None and len(spec.shape) > 1:
+            sample = tuple(int(d) for d in spec.shape[1:])
+        else:
+            sample = (cfg.image_size, cfg.image_size, 3)
+        rec_bytes = int(np.prod(sample)) * dt.itemsize
+        # Same unlabeled-feed caveat as the whole-volume raw path.
+        from_context().warning(
+            "raw image volume has no labels (training against zeros); "
+            "use --volume-tfrecord or --volume-webdataset jpg/cls for "
+            "supervised vision"
+        )
+        labels = np.zeros((cfg.batch_size,), np.int32)
+
+        def to_batch(raw):
+            imgs = raw.view(dt).reshape((cfg.batch_size,) + sample)
+            return {"images": np.ascontiguousarray(imgs), "labels": labels}
+
+    need = cfg.batch_size * rec_bytes
+    if total < need:
+        raise SystemExit(
+            f"volume {args.volume!r} holds {total} bytes but one batch needs "
+            f"{need} ({cfg.batch_size} records x {rec_bytes}B); shrink the "
+            f"batch/seq or use --feed-window-bytes 0 (whole-volume mode)"
+        )
+    from_context().info(
+        "volume published (windowed feed)", volume=args.volume,
+        total_bytes=total, window_bytes=window, record_bytes=rec_bytes,
+    )
+    carry = np.zeros((0,), np.uint8)
+    offset = w.size
+    while True:
+        carry = np.concatenate([carry, w]) if carry.size else np.asarray(w)
+        while carry.size >= need:
+            yield to_batch(carry[:need])
+            carry = carry[need:]
+        if offset >= total:
+            # Wrap to the volume start. Whole RECORDS in the carry survive
+            # the wrap (only a partial-record byte tail is dropped, since
+            # the next epoch restarts record-aligned at offset 0).
+            offset = 0
+            carry = carry[:(carry.size // rec_bytes) * rec_bytes]
+        w, total, _ = feeder.fetch_window(
+            args.volume, offset, window, timeout=args.publish_timeout,
+            heal=True,
+        )
+        offset += w.size
+
+
+class SeekableFeed:
+    """A batch iterator that can REPOSITION for checkpoint resume.
+
+    Wraps a feed FACTORY ``make(start_batch) -> iterator``; ``seek(n)``
+    rebuilds the feed positioned at batch n, so a deep resume costs one
+    repositioned rebuild (index arithmetic for cycle feeds) instead of
+    O(start_step) replayed host decode (the Trainer falls back to
+    replaying ``next()`` for feeds without this hook)."""
+
+    def __init__(self, make, start: int = 0):
+        self._make = make
+        self._it = iter(make(start))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return next(self._it)
+
+    def seek(self, batch_index: int) -> None:
+        self._it = iter(self._make(batch_index))
+
+
+def _shuffle_seed(args) -> int | None:
+    return getattr(args, "shuffle_seed", 0) if getattr(args, "shuffle", False) else None
+
+
+def _cycle_indices(n: int, batch: int, shuffle_seed: int | None = None,
+                   start_batch: int = 0):
+    """Endless batch-index generator over n records: sequential wraparound
+    by default, or permutation-queue shuffling when shuffle_seed is set —
+    each permutation is consumed exactly once before the next is drawn, so
+    every record is served exactly once per epoch even when batch doesn't
+    divide n (batches may straddle epoch boundaries; nothing is dropped or
+    double-sampled).
+
+    ``start_batch`` repositions mid-stream (checkpoint resume): the
+    sequential path jumps in O(1); the shuffled path replays only INDEX
+    work (drawing permutations — no record decode), identical to serving
+    and discarding the first start_batch batches."""
+    if shuffle_seed is None:
+        i = (start_batch * batch) % n if n else 0
+        while True:
+            yield np.arange(i, i + batch) % n
+            i = (i + batch) % n
+        return
+    rng = np.random.RandomState(shuffle_seed)
+    queue = rng.permutation(n)
+    skip = start_batch
+    while True:
+        while queue.size < batch:
+            queue = np.concatenate([queue, rng.permutation(n)])
+        if skip > 0:
+            # Discard the batch's index slice without yielding — pure
+            # numpy index work, no record decode.
+            skip -= 1
+        else:
+            yield queue[:batch]
+        queue = queue[batch:]
+
+
+def _cycle_token_batches(tokens_flat, cfg: TrainConfig, volume: str,
+                         shuffle_seed: int | None = None,
+                         start_batch: int = 0):
+    """Flat token stream -> cyclic [batch, seq_len+1] batches (the record
+    framing + epoch-wrap loop shared by the file and webdataset feeds)."""
+    span = cfg.seq_len + 1
+    n = (tokens_flat.size // span) * span
+    if n == 0:
+        raise SystemExit(
+            f"volume {volume!r} holds {tokens_flat.size} tokens "
+            f"< seq_len+1={span}"
+        )
+    # copy=False: the webdataset feed arrives already int32 — don't
+    # duplicate a multi-GB volume in host RAM for a no-op cast.
+    tokens = np.asarray(tokens_flat[:n]).reshape(-1, span).astype(
+        np.int32, copy=False)
+    for idx in _cycle_indices(tokens.shape[0], cfg.batch_size,
+                              shuffle_seed, start_batch):
+        yield {"tokens": tokens[idx]}
+
+
+def _wds_tokens(shard, ext: str, volume: str) -> np.ndarray:
+    """Token payloads of one (or a concatenation of) tar shard(s)."""
+    from oim_tpu.data import webdataset as wds
+
+    payloads = [s[ext] for s in wds.iter_samples([np.asarray(shard)]) if ext in s]
+    if not payloads:
+        return np.zeros((0,), np.int32)
+    blob = b"".join(payloads)
+    if len(blob) % 4:
+        raise SystemExit(
+            f"webdataset volume {volume!r}: payloads under extension "
+            f"{ext!r} total {len(blob)} bytes — not int32-aligned; is "
+            f"--wds-ext pointing at the token member?"
+        )
+    return np.frombuffer(blob, dtype=np.int32)
+
+
+def _webdataset_token_batches(args, cfg: TrainConfig, feeder, pub, urls,
+                              start_batch: int = 0):
+    """Samples from a staged webdataset volume -> token batches.
+
+    The staged flat bytes are shards laid back to back; the tar index
+    (data/webdataset.py) groups members into samples, and each sample's
+    --wds-ext payload holds raw int32 tokens. Sample order is shard order.
+
+    Streaming mode (feed_window_bytes > 0, the default): shard boundaries
+    are recomputed from the request's URLs and one shard is fetched
+    host-side at a time through the ReadVolume data window — the host
+    working set is one shard, not the dataset. Whole-volume mode
+    (--feed-window-bytes 0) materializes everything and supports --shuffle.
+    """
+    ext = getattr(args, "wds_ext", "bin")
+    window = getattr(args, "feed_window_bytes", 0)
+    span = cfg.seq_len + 1
+
+    if window <= 0:
+        data = (np.asarray(pub.array) if pub.array is not None
+                else feeder.fetch(args.volume, timeout=args.publish_timeout))
+        tokens = _wds_tokens(data, ext, args.volume)
+        if tokens.size == 0:
+            raise SystemExit(
+                f"webdataset volume {args.volume!r} has no samples with "
+                f"extension {ext!r}"
+            )
+        from_context().info(
+            "webdataset volume published", volume=args.volume,
+            tokens=tokens.size,
+        )
+        yield from _cycle_token_batches(
+            tokens, cfg, args.volume, _shuffle_seed(args), start_batch)
+        return
+
+    from oim_tpu.data import webdataset as wds
+
+    sizes = wds.shard_sizes(urls)
+    offsets = np.concatenate([[0], np.cumsum(sizes)])
+    from_context().info(
+        "webdataset streaming feed", volume=args.volume, shards=len(urls),
+        max_shard_bytes=int(max(sizes)),
+    )
+    carry = np.zeros((0,), np.int32)
+    rows = np.zeros((0, span), np.int32)
+    produced = False
+    checked = False
+    while True:
+        for i, size in enumerate(sizes):
+            shard, total, _ = feeder.fetch_window(
+                args.volume, int(offsets[i]), int(size),
+                timeout=args.publish_timeout, heal=True,
+            )
+            if not checked:
+                # Offsets were recomputed from the URLs at feed time; if a
+                # shard changed size since staging the layout no longer
+                # matches and windows would slice mid-tar — fail with the
+                # real cause instead of a tar-parse error later.
+                if int(offsets[-1]) != int(total):
+                    raise SystemExit(
+                        f"webdataset volume {args.volume!r}: staged volume "
+                        f"is {total} bytes but the shard URLs now sum to "
+                        f"{int(offsets[-1])} — shards changed since staging?"
+                    )
+                checked = True
+            toks = _wds_tokens(shard, ext, args.volume)
+            if toks.size:
+                carry = np.concatenate([carry, toks])
+                n = (carry.size // span) * span
+                if n:
+                    rows = np.concatenate(
+                        [rows, carry[:n].reshape(-1, span)])
+                    carry = carry[n:]
+            while rows.shape[0] >= cfg.batch_size:
+                produced = True
+                yield {"tokens": rows[:cfg.batch_size]}
+                rows = rows[cfg.batch_size:]
+        if not produced:
+            raise SystemExit(
+                f"webdataset volume {args.volume!r}: one full pass over "
+                f"{len(urls)} shards produced no {ext!r} token batches"
+            )
+        # Epoch wrap: drop the partial-record token tail so every epoch
+        # frames rows identically (whole-volume mode truncates once up
+        # front; without this the tail would shift all framing each epoch).
+        carry = carry[:0]
+
+
+_DECODE_POOL = None
+
+
+def _decode_pool():
+    """Shared thread pool for image decode: Pillow releases the GIL during
+    JPEG decode, so the feed decodes a window's images in parallel instead
+    of one-at-a-time between train steps."""
+    global _DECODE_POOL
+    if _DECODE_POOL is None:
+        import concurrent.futures
+        import os
+
+        _DECODE_POOL = concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(8, os.cpu_count() or 4),
+            thread_name_prefix="oim-image-decode",
+        )
+    return _DECODE_POOL
+
+
+def _decode_images(payloads: list, cfg: TrainConfig):
+    """JPEG payloads -> [image uint8 [S,S,3]] via the C++ engine's batch
+    decoder when available (native threads, DCT prescale), else the Pillow
+    thread pool; order preserved either way. Images stay uint8 all the way
+    to the device — normalization happens on-chip (resnet.apply), so H2D
+    moves 1/4 the bytes and the host never runs a float pass."""
+    from oim_tpu.data import readers, staging
+
+    arr = None
+    try:
+        arr = staging.decode_jpeg_batch(payloads, cfg.image_size)
+    except staging.StagingError as err:
+        from_context().warning(
+            "native jpeg decode failed; falling back to Pillow",
+            error=str(err)[:120],
+        )
+    if arr is not None:
+        return list(arr)
+
+    def one(p):
+        return readers.resize_image(readers.decode_image(p), cfg.image_size)
+
+    return list(_decode_pool().map(one, payloads))
+
+
+def _decode_examples(records, cfg: TrainConfig, volume: str):
+    """Serialized tf.Examples -> [(image f32, label int)], decode batched
+    through _decode_images."""
+    from oim_tpu.data import readers
+
+    payloads, labels = [], []
+    for rec in records:
+        p, lab = _example_payload(readers.parse_example(rec), volume, cfg)
+        payloads.append(p)
+        labels.append(lab)
+    return list(zip(_decode_images(payloads, cfg), labels))
+
+
+def _check_label(label: int, cfg: TrainConfig, origin: str) -> int:
+    """Apply --label-offset and validate against --num-classes, loudly.
+
+    One-hot silently zeroes an out-of-range class, corrupting loss and
+    accuracy with no error — the classic trap is the ImageNet-TFRecord
+    convention, whose labels are 1-based (1..1000): either pass
+    --num-classes 1001 or --label-offset -1.
+    """
+    label += cfg.label_offset
+    if not 0 <= label < cfg.num_classes:
+        raise SystemExit(
+            f"{origin}: label {label} (after --label-offset "
+            f"{cfg.label_offset}) outside [0, {cfg.num_classes}); "
+            "ImageNet-convention records are 1-based — use "
+            "--num-classes 1001 or --label-offset -1"
+        )
+    return label
+
+
+def _example_payload(ex: dict, volume: str, cfg: TrainConfig):
+    """Parsed tf.Example -> (image bytes, label int).
+
+    Keys follow the ImageNet-TFRecord convention: image/encoded (JPEG/PNG
+    bytes), image/class/label (int64) — the third-party format the feed
+    translates, the role of the reference's emulation personality
+    (ceph-csi.go:34-108). NOTE the convention's labels are 1-based; see
+    _check_label."""
+    img = ex.get("image/encoded")
+    if not img:
+        raise SystemExit(
+            f"volume {volume!r}: tf.Example has no image/encoded feature "
+            f"(found {sorted(ex)})"
+        )
+    label = ex.get("image/class/label")
+    if label is None or not len(label):
+        raise SystemExit(
+            f"volume {volume!r}: tf.Example has no image/class/label feature"
+        )
+    return img[0], _check_label(int(label[0]), cfg, f"volume {volume!r}")
+
+
+def _tfrecord_image_batches(args, cfg: TrainConfig, feeder, pub,
+                            start_batch: int = 0):
+    """Labeled (image, label) batches from a staged TFRecord volume.
+
+    The volume holds TFRecord-FRAMED serialized tf.Examples (framing
+    survives staging, data/readers.py read_tfrecord_batch). Whole-volume
+    mode decodes everything once and cycles (supports --shuffle); windowed
+    mode carries framed bytes across ReadVolume windows and decodes whole
+    records as they complete — host working set is one window of JPEGs.
+    """
+    from oim_tpu.data import readers
+
+    window = getattr(args, "feed_window_bytes", 0)
+    if window <= 0:
+        data = (np.asarray(pub.array) if pub.array is not None
+                else feeder.fetch(args.volume, timeout=args.publish_timeout))
+        samples = _decode_examples(
+            list(readers.iter_tfrecord_bytes(data)), cfg, args.volume)
+        if not samples:
+            raise SystemExit(f"volume {args.volume!r} holds no tf.Examples")
+        images = [im for im, _ in samples]
+        labels = [lab for _, lab in samples]
+        images = np.stack(images)
+        labels = np.asarray(labels, np.int32)
+        from_context().info(
+            "labeled tfrecord volume published", volume=args.volume,
+            examples=images.shape[0],
+        )
+        for idx in _cycle_indices(
+                images.shape[0], cfg.batch_size, _shuffle_seed(args),
+                start_batch):
+            yield {"images": images[idx], "labels": labels[idx]}
+        return
+
+    from_context().info(
+        "labeled tfrecord streaming feed", volume=args.volume,
+        window_bytes=window,
+    )
+    carry = np.zeros((0,), np.uint8)
+    imgs: list[np.ndarray] = []
+    labs: list[int] = []
+    offset, produced = 0, False
+    while True:
+        w, total, _ = feeder.fetch_window(
+            args.volume, offset, window, timeout=args.publish_timeout,
+            heal=True,
+        )
+        offset += w.size
+        w8 = np.asarray(w, np.uint8)
+        carry = np.concatenate([carry, w8]) if carry.size else w8
+        cut = readers.complete_tfrecord_prefix(carry)
+        for im, lab in _decode_examples(
+                list(readers.iter_tfrecord_bytes(carry[:cut])), cfg,
+                args.volume):
+            imgs.append(im)
+            labs.append(lab)
+        carry = carry[cut:]
+        while len(imgs) >= cfg.batch_size:
+            produced = True
+            yield {
+                "images": np.stack(imgs[:cfg.batch_size]),
+                "labels": np.asarray(labs[:cfg.batch_size], np.int32),
+            }
+            del imgs[:cfg.batch_size], labs[:cfg.batch_size]
+        if offset >= total:
+            if not produced and not imgs:
+                raise SystemExit(
+                    f"volume {args.volume!r}: a full pass produced no "
+                    f"tf.Example records"
+                )
+            # Framing restarts at the volume head; a partial-record byte
+            # tail cannot continue across the wrap.
+            offset, carry = 0, carry[:0]
+
+
+def _wds_image_sample(sample: dict, cfg: TrainConfig):
+    """jpg/cls sample -> (image bytes, label) or None (no image member)."""
+    payload = sample.get("jpg") or sample.get("jpeg") or sample.get("png")
+    if payload is None:
+        return None
+    cls = sample.get("cls")
+    if cls is None:
+        raise SystemExit(
+            "webdataset image sample has no 'cls' member (label); "
+            f"members: {sorted(sample)}"
+        )
+    label = _check_label(
+        int(cls.decode().strip() or 0), cfg,
+        f"webdataset sample {sample.get('__key__', b'?').decode()!r}",
+    )
+    return payload, label
+
+
+def _decode_wds_samples(samples, cfg: TrainConfig, imgs, labs):
+    pairs = [p for p in (_wds_image_sample(s, cfg) for s in samples) if p]
+    if not pairs:
+        return
+    payloads = [p for p, _ in pairs]
+    imgs.extend(_decode_images(payloads, cfg))
+    labs.extend(lab for _, lab in pairs)
+
+
+def _webdataset_image_batches(args, cfg: TrainConfig, feeder, pub, urls,
+                              start_batch: int = 0):
+    """Supervised-vision twin of _webdataset_token_batches: each sample's
+    jpg/png member is decoded and its cls member is the integer label.
+    Windowed mode streams shard-granular; whole-volume supports --shuffle."""
+    from oim_tpu.data import webdataset as wds
+
+    window = getattr(args, "feed_window_bytes", 0)
+    if window <= 0:
+        data = (np.asarray(pub.array) if pub.array is not None
+                else feeder.fetch(args.volume, timeout=args.publish_timeout))
+        imgs: list[np.ndarray] = []
+        labs: list[int] = []
+        _decode_wds_samples(list(wds.iter_samples([np.asarray(data)])), cfg,
+                            imgs, labs)
+        if not imgs:
+            raise SystemExit(
+                f"webdataset volume {args.volume!r} has no jpg/cls samples"
+            )
+        images = np.stack(imgs)
+        labels = np.asarray(labs, np.int32)
+        from_context().info(
+            "webdataset image volume published", volume=args.volume,
+            samples=images.shape[0],
+        )
+        for idx in _cycle_indices(
+                images.shape[0], cfg.batch_size, _shuffle_seed(args),
+                start_batch):
+            yield {"images": images[idx], "labels": labels[idx]}
+        return
+
+    sizes = wds.shard_sizes(urls)
+    offsets = np.concatenate([[0], np.cumsum(sizes)])
+    from_context().info(
+        "webdataset image streaming feed", volume=args.volume,
+        shards=len(urls),
+    )
+    imgs, labs = [], []
+    produced = False
+    while True:
+        for i, size in enumerate(sizes):
+            shard, total, _ = feeder.fetch_window(
+                args.volume, int(offsets[i]), int(size),
+                timeout=args.publish_timeout, heal=True,
+            )
+            if int(offsets[-1]) != int(total):
+                raise SystemExit(
+                    f"webdataset volume {args.volume!r}: staged volume is "
+                    f"{total} bytes but the shard URLs now sum to "
+                    f"{int(offsets[-1])} — shards changed since staging?"
+                )
+            _decode_wds_samples(
+                list(wds.iter_samples([np.asarray(shard)])), cfg, imgs, labs)
+            while len(imgs) >= cfg.batch_size:
+                produced = True
+                yield {
+                    "images": np.stack(imgs[:cfg.batch_size]),
+                    "labels": np.asarray(labs[:cfg.batch_size], np.int32),
+                }
+                del imgs[:cfg.batch_size], labs[:cfg.batch_size]
+        # Samples smaller than one batch carry into the next pass (same
+        # rule as the tfrecord feed); only a pass that parsed NOTHING is
+        # a dead volume.
+        if not produced and not imgs:
+            raise SystemExit(
+                f"webdataset volume {args.volume!r}: one full pass over "
+                f"{len(urls)} shards produced no jpg/cls image batches"
+            )

@@ -254,6 +254,11 @@ def slice_runs(
     starts, stops = [], []
     for d in range(dims):
         s = index[d] if d < len(index) else slice(None)
+        if s.step not in (None, 1):
+            # A stepped slice would need per-element runs; staging the
+            # contiguous [start, stop) range instead would land WRONG
+            # bytes silently — fall back to whole-array staging.
+            return None
         starts.append(int(s.start) if s.start is not None else 0)
         stops.append(int(s.stop) if s.stop is not None else int(shape[d]))
     slice_shape = tuple(stops[d] - starts[d] for d in range(dims))
@@ -392,6 +397,10 @@ LAST_STAGE_PEAK = 0
 # Total stage_source invocations — tests assert the plane (not the
 # whole-read fallback) served a given MapVolume.
 STAGE_CALLS = 0
+# stage_source runs on async controller staging threads: concurrent
+# MapVolume calls must not interleave the read-modify-write of the two
+# accounting globals above.
+_STATS_LOCK = threading.Lock()
 
 
 # Buffers beyond int32 indexing land chunks under a scoped enable_x64 so
@@ -514,7 +523,8 @@ def stage_source(
     global LAST_STAGE_PEAK, STAGE_CALLS
     import jax
 
-    STAGE_CALLS += 1
+    with _STATS_LOCK:
+        STAGE_CALLS += 1
     dtype = np.dtype(dtype)
     shape = tuple(int(d) for d in shape)
     imap = sharding.addressable_devices_indices_map(shape)
@@ -555,7 +565,8 @@ def stage_source(
             for d, b in bufs.items():
                 shards.append((d, _as_typed(b, dtype, slice_shape)))
     finally:
-        LAST_STAGE_PEAK = peak[1]
+        with _STATS_LOCK:
+            LAST_STAGE_PEAK = peak[1]
     from jax.sharding import SingleDeviceSharding
 
     if isinstance(sharding, SingleDeviceSharding) and len(shards) == 1:

@@ -62,23 +62,42 @@ from oim_tpu.parallel.collectives import ppermute_ring
 
 @dataclasses.dataclass(frozen=True)
 class Schedule1F1B:
-    """Static 1F1B schedule for (P stages, M microbatches).
+    """Static 1F1B schedule for (P devices, M microbatches, v virtual
+    stages per device — v=1 is classic PipeDream-flush, v>1 the
+    Megatron-style interleaved schedule whose bubble is
+    (P-1)/(v*M+P-1), v times smaller).
 
-    Arrays are [n_ticks, P] of microbatch indices (-1 = idle):
-    - fwd[t, s]: microbatch stage s forwards at tick t
-    - bwd[t, s]: microbatch stage s backwards at tick t
-    - arr_f[t, s]: microbatch whose ACTIVATION arrives at s this tick
-      (sent by s-1 at t-1); written into the input stash on arrival.
-    - arr_b[t, s]: microbatch whose COTANGENT arrives at s this tick.
-    - stash_x / stash_dh: ring-buffer depths proven collision-free.
+    Global stage s = chunk*P + device. Per-device arrays are
+    [n_ticks, P] of microbatch indices (-1 = idle) with companion CHUNK
+    arrays (always 0 at v=1):
+    - fwd/fwd_c[t, d]: microbatch/chunk device d forwards at tick t
+    - bwd/bwd_c[t, d]: microbatch/chunk device d backwards at tick t
+    - arr_f/arr_f_c[t, d]: microbatch/chunk whose ACTIVATION arrives at
+      d this tick (sent by d-1 at t-1; the ring wrap P-1 -> 0 carries
+      chunk c outputs to chunk c+1 inputs); written into the input
+      stash on arrival.
+    - arr_b/arr_b_c[t, d]: microbatch/chunk whose COTANGENT arrives.
+    - inject[t]: microbatch injected from x at device 0 chunk 0 (-1).
+    - bank[t]: microbatch whose d_x banks (device 0 chunk 0 B) (-1).
+    - head[t]: microbatch in the head phase (device P-1 chunk v-1 B).
+    - stash_x / stash_dh: PER-CHUNK ring-buffer depths proven
+      collision-free (total slots = v * depth).
     """
 
     p: int
     m: int
+    v: int
     fwd: np.ndarray
     bwd: np.ndarray
+    fwd_c: np.ndarray
+    bwd_c: np.ndarray
     arr_f: np.ndarray
     arr_b: np.ndarray
+    arr_f_c: np.ndarray
+    arr_b_c: np.ndarray
+    inject: np.ndarray
+    bank: np.ndarray
+    head: np.ndarray
     stash_x: int
     stash_dh: int
 
@@ -87,76 +106,145 @@ class Schedule1F1B:
         return self.fwd.shape[0]
 
 
-def simulate_1f1b(p: int, m: int) -> Schedule1F1B:
-    """Greedy per-stage simulation of non-interleaved 1F1B.
+def _device_order(p: int, m: int, v: int, d: int):
+    """Canonical action order for device d: [("F"|"B", chunk, mb), ...].
 
-    Each stage's canonical action order is W forwards (W = min(M, P-1-s)
-    warmup), then (F, B) pairs, then the trailing backwards; an action
-    runs at the first tick its dependency (upstream F / downstream B,
-    completed at an earlier tick) is satisfied. One action per stage per
-    tick (F and B cost one tick each)."""
-    if p < 1 or m < 1:
-        raise ValueError(f"need p >= 1, m >= 1, got {p}, {m}")
-    actions = []
-    for s in range(p):
-        w = min(m, p - 1 - s)
-        order = [("F", j) for j in range(w)]
+    v=1: classic 1F1B — warmup P-1-d forwards, then (F, B) pairs, then
+    trailing backwards (minimal in-flight = min(M, P-d)).
+    v>1: Megatron interleaved — F order is chunk-major within groups of
+    P microbatches; B order reverse-chunk-major; warmup
+    2(P-1-d) + (v-1)P forwards then strict F/B alternation (the 2x and
+    the (v-1)P term are what keep the chunk rotation deadlock-free; the
+    extra in-flight window is interleaving's memory tax)."""
+    total = v * m
+    if v == 1:
+        w = min(m, p - 1 - d)
+        order = [("F", 0, j) for j in range(w)]
         for j in range(m - w):
-            order.append(("F", w + j))
-            order.append(("B", j))
-        order.extend(("B", j) for j in range(m - w, m))
-        actions.append(order)
+            order.append(("F", 0, w + j))
+            order.append(("B", 0, j))
+        order.extend(("B", 0, j) for j in range(m - w, m))
+        return order
 
-    done_f = [dict() for _ in range(p)]  # stage -> {mb: completion tick}
-    done_b = [dict() for _ in range(p)]
+    def f_action(n):
+        g, r = divmod(n, p * v)
+        chunk, pos = divmod(r, p)
+        return ("F", chunk, g * p + pos)
+
+    def b_action(n):
+        g, r = divmod(n, p * v)
+        chunk, pos = divmod(r, p)
+        return ("B", v - 1 - chunk, g * p + pos)
+
+    warmup = min((p - d - 1) * 2 + (v - 1) * p, total)
+    order = [f_action(n) for n in range(warmup)]
+    nf, nb = warmup, 0
+    while nf < total or nb < total:
+        if nf < total:
+            order.append(f_action(nf))
+            nf += 1
+        if nb < total:
+            order.append(b_action(nb))
+            nb += 1
+    return order
+
+
+def simulate_1f1b(p: int, m: int, v: int = 1) -> Schedule1F1B:
+    """Greedy per-device simulation of (interleaved) 1F1B.
+
+    Each device follows its canonical action order (``_device_order``);
+    an action runs at the first tick its dependency (upstream F /
+    downstream B over GLOBAL stages s = chunk*P + device, completed at
+    an earlier tick) is satisfied. One action per device per tick (F and
+    B cost one tick each). Interleaving requires M % P == 0 (Megatron's
+    grouping)."""
+    if p < 1 or m < 1 or v < 1:
+        raise ValueError(f"need p, m, v >= 1, got {p}, {m}, {v}")
+    if v > 1 and m % p:
+        raise ValueError(
+            f"interleaved 1F1B groups microbatches by the pipe size: "
+            f"M={m} must divide by P={p}"
+        )
+    s_total = v * p
+    orders = [_device_order(p, m, v, d) for d in range(p)]
+    done_f = {}  # (global stage, mb) -> completion tick
+    done_b = {}
     cursor = [0] * p
-    fwd_rows, bwd_rows = [], []
+    fc_rows, fm_rows, bc_rows, bm_rows = [], [], [], []
     t = 0
-    while any(cursor[s] < len(actions[s]) for s in range(p)):
-        if t > 4 * (m + p) + 16:
+    while any(cursor[d] < len(orders[d]) for d in range(p)):
+        if t > 8 * (v * m + p) + 64:
             raise AssertionError("1F1B simulation did not converge")
-        frow = [-1] * p
-        brow = [-1] * p
-        for s in range(p):
-            if cursor[s] >= len(actions[s]):
+        fc = [-1] * p
+        fm = [-1] * p
+        bc = [-1] * p
+        bm = [-1] * p
+        for d in range(p):
+            if cursor[d] >= len(orders[d]):
                 continue
-            kind, j = actions[s][cursor[s]]
+            kind, c, j = orders[d][cursor[d]]
+            s = c * p + d
             if kind == "F":
-                ready = s == 0 or done_f[s - 1].get(j, t) < t
+                ready = s == 0 or done_f.get((s - 1, j), t) < t
                 if ready:
-                    frow[s] = j
-                    done_f[s][j] = t
-                    cursor[s] += 1
+                    fc[d], fm[d] = c, j
+                    done_f[(s, j)] = t
+                    cursor[d] += 1
             else:
-                ready = s == p - 1 or done_b[s + 1].get(j, t) < t
+                ready = s == s_total - 1 or done_b.get((s + 1, j), t) < t
                 if ready:
-                    brow[s] = j
-                    done_b[s][j] = t
-                    cursor[s] += 1
-        fwd_rows.append(frow)
-        bwd_rows.append(brow)
+                    bc[d], bm[d] = c, j
+                    done_b[(s, j)] = t
+                    cursor[d] += 1
+        fc_rows.append(fc)
+        fm_rows.append(fm)
+        bc_rows.append(bc)
+        bm_rows.append(bm)
         t += 1
 
-    fwd = np.asarray(fwd_rows, np.int32)
-    bwd = np.asarray(bwd_rows, np.int32)
+    fwd = np.asarray(fm_rows, np.int32)
+    bwd = np.asarray(bm_rows, np.int32)
+    fwd_c = np.asarray(fc_rows, np.int32)
+    bwd_c = np.asarray(bc_rows, np.int32)
     n_ticks = fwd.shape[0]
 
-    # Arrivals: what s-1 forwarded at t-1 lands at s at t (and the reverse
-    # for cotangents). Stage 0 "receives" its own injection at F time.
+    # Arrivals: device d-1's F output at t-1 lands at d at t; the ring
+    # wrap P-1 -> 0 advances the chunk (c outputs feed chunk c+1 inputs;
+    # the LAST global stage's output is discarded — the head consumes
+    # it). Reverse for cotangents, with the 0 -> P-1 wrap retreating the
+    # chunk (chunk 0's d_x banks instead of wrapping).
     arr_f = np.full_like(fwd, -1)
     arr_b = np.full_like(bwd, -1)
+    arr_f_c = np.full_like(fwd, -1)
+    arr_b_c = np.full_like(bwd, -1)
     for t_ in range(1, n_ticks):
-        for s in range(1, p):
-            arr_f[t_, s] = fwd[t_ - 1, s - 1]
-        for s in range(p - 1):
-            arr_b[t_, s] = bwd[t_ - 1, s + 1]
+        for d in range(p):
+            src = (d - 1) % p
+            j, c = fwd[t_ - 1, src], fwd_c[t_ - 1, src]
+            if j >= 0:
+                cc = c if d > 0 else c + 1
+                if cc < v:
+                    arr_f[t_, d] = j
+                    arr_f_c[t_, d] = cc
+            srcb = (d + 1) % p
+            jb, cb = bwd[t_ - 1, srcb], bwd_c[t_ - 1, srcb]
+            if jb >= 0:
+                cc = cb if d < p - 1 else cb - 1
+                if cc >= 0:
+                    arr_b[t_, d] = jb
+                    arr_b_c[t_, d] = cc
+    inject = np.where(fwd_c[:, 0] == 0, fwd[:, 0], -1).astype(np.int32)
+    bank = np.where(bwd_c[:, 0] == 0, bwd[:, 0], -1).astype(np.int32)
+    head = np.where(
+        bwd_c[:, -1] == v - 1, bwd[:, -1], -1).astype(np.int32)
 
     def min_safe_depth(write_tick, release_tick) -> int:
-        """Smallest ring depth where no two microbatches with the same
-        slot have overlapping [write, release] lifetimes, any stage."""
+        """Smallest PER-CHUNK ring depth where no two microbatches with
+        the same (chunk, slot) have overlapping [write, release]
+        lifetimes, any device."""
         for depth in range(1, m + 1):
             ok = True
-            for s in range(p):
+            for s in range(s_total):
                 spans = {}
                 for j in range(m):
                     w = write_tick(s, j)
@@ -174,18 +262,23 @@ def simulate_1f1b(p: int, m: int) -> Schedule1F1B:
         return m
 
     stash_x = min_safe_depth(
-        # Written at arrival (or injection at F-time for stage 0); the
-        # stash is also the recompute source, so it lives until B.
-        lambda s, j: done_f[s][j] if s == 0 else done_f[s - 1][j] + 1,
-        lambda s, j: done_b[s][j],
+        # Written at arrival (or injection at F-time for global stage
+        # 0); the stash is also the recompute source, so it lives until
+        # this stage's B.
+        lambda s, j: done_f[(s, j)] if s == 0 else done_f[(s - 1, j)] + 1,
+        lambda s, j: done_b[(s, j)],
     )
     stash_dh = min_safe_depth(
-        lambda s, j: (done_f[p - 1][j] if s == p - 1
-                      else done_b[s + 1][j] + 1),
-        lambda s, j: done_b[s][j],
+        # The last global stage never stashes a cotangent (its backward
+        # seeds straight from the head phase at B time).
+        lambda s, j: (None if s == s_total - 1
+                      else done_b[(s + 1, j)] + 1),
+        lambda s, j: done_b[(s, j)],
     )
 
-    sched = Schedule1F1B(p, m, fwd, bwd, arr_f, arr_b, stash_x, stash_dh)
+    sched = Schedule1F1B(
+        p, m, v, fwd, bwd, fwd_c, bwd_c, arr_f, arr_b, arr_f_c, arr_b_c,
+        inject, bank, head, stash_x, stash_dh)
     validate_schedule(sched)
     return sched
 
@@ -193,36 +286,49 @@ def simulate_1f1b(p: int, m: int) -> Schedule1F1B:
 def validate_schedule(sched: Schedule1F1B) -> None:
     """Invariants the kernel relies on; raises on violation (these run at
     trace time, so a broken schedule can never silently compile)."""
-    p, m = sched.p, sched.m
+    p, m, v = sched.p, sched.m, sched.v
+    s_total = v * p
     f_tick = {}
     b_tick = {}
     for t in range(sched.n_ticks):
-        for s in range(p):
-            if sched.fwd[t, s] >= 0:
-                f_tick[(s, int(sched.fwd[t, s]))] = t
-            if sched.bwd[t, s] >= 0:
-                b_tick[(s, int(sched.bwd[t, s]))] = t
-    for s in range(p):
+        for d in range(p):
+            if sched.fwd[t, d] >= 0:
+                s = int(sched.fwd_c[t, d]) * p + d
+                key = (s, int(sched.fwd[t, d]))
+                assert key not in f_tick, ("duplicate F", key)
+                f_tick[key] = t
+            if sched.bwd[t, d] >= 0:
+                s = int(sched.bwd_c[t, d]) * p + d
+                key = (s, int(sched.bwd[t, d]))
+                assert key not in b_tick, ("duplicate B", key)
+                b_tick[key] = t
+    for s in range(s_total):
         for j in range(m):
             assert (s, j) in f_tick and (s, j) in b_tick, (s, j)
             if s > 0:
                 assert f_tick[(s - 1, j)] < f_tick[(s, j)], "F dependency"
-            if s < p - 1:
+            if s < s_total - 1:
                 assert b_tick[(s + 1, j)] < b_tick[(s, j)], "B dependency"
             assert f_tick[(s, j)] <= b_tick[(s, j)], "B before F"
     # THE 1F1B property: in-flight (forwarded, not yet backwarded)
-    # microbatches per stage never exceed the warmup depth + 1 <= P.
-    for s in range(p):
+    # microbatch-chunks per DEVICE stay bounded by the warmup window +1
+    # — O(P + vP), never O(vM). At v=1 the bound is the classic
+    # min(M, P - d).
+    for d in range(p):
         live = 0
         peak = 0
         for t in range(sched.n_ticks):
-            if sched.fwd[t, s] >= 0:
+            if sched.fwd[t, d] >= 0:
                 live += 1
-            if sched.bwd[t, s] >= 0:
+            if sched.bwd[t, d] >= 0:
                 live -= 1
             peak = max(peak, live)
-        assert peak <= min(m, p - s), (s, peak)
-    assert sched.stash_x <= min(m, p)
+        if v == 1:
+            assert peak <= min(m, p - d), (d, peak)
+        else:
+            assert peak <= min(
+                v * m, (p - d - 1) * 2 + (v - 1) * p + 1) + 1, (d, peak)
+    assert sched.stash_x <= min(m, 2 * p)
 
 
 def _tree_zeros_like(t):
@@ -245,6 +351,7 @@ def pipeline_1f1b_value_and_grad(
     unconditional: bool = False,
     with_aux: bool = False,
     aux_seed: float = 0.0,
+    n_virtual: int = 1,
 ):
     """1F1B forward+backward inside shard_map; returns
     (loss, d_stage_params, d_head_params, d_x).
@@ -357,11 +464,32 @@ def pipeline_1f1b_value_and_grad(
             f"weights, got shape {loss_weights.shape}"
         )
     mb_shape = x.shape[1:]
+    v = n_virtual
     # Static schedule: p is concrete under shard_map.
-    sched = simulate_1f1b(int(p), m)
+    sched = simulate_1f1b(int(p), m, v)
+    # v virtual stages per device: the [L/P] layer shard is v chunks of
+    # L/(P*v) back to back (the caller pre-permuted the global stack so
+    # device d's shard = its chunks in order — chunk c on device d is
+    # GLOBAL stage c*P+d, Megatron's round-robin assignment).
+    if v > 1:
+        def reshape_chunks(a):
+            if a.shape[0] % v:
+                raise ValueError(
+                    f"stage_params leading dim {a.shape[0]} must divide "
+                    f"by n_virtual={v}"
+                )
+            return a.reshape((v, a.shape[0] // v) + a.shape[1:])
 
-    def run_stage(sp, h):
-        """[stack of layers] applied to h; returns (out, aux_sum)."""
+        stage_params = jax.tree.map(reshape_chunks, stage_params)
+
+    def run_stage(sp, h, chunk):
+        """[stack of layers] applied to h; returns (out, aux_sum).
+        With v > 1, scans only the selected chunk's layers."""
+        if v > 1:
+            sp = jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(
+                    a, chunk, keepdims=False), sp)
+
         def body(carry, layer):
             out = layer_fn(carry, layer)
             if with_aux:
@@ -393,83 +521,96 @@ def pipeline_1f1b_value_and_grad(
             stash_y = None
         arr_f = rows["arr_f"][idx]
         arr_b = rows["arr_b"][idx]
+        af_c = jnp.maximum(rows["arr_f_c"][idx], 0)
+        ab_c = jnp.maximum(rows["arr_b_c"][idx], 0)
         mbf = rows["fwd"][idx]
         mbb = rows["bwd"][idx]
+        cf = jnp.maximum(rows["fwd_c"][idx], 0)
+        cb = jnp.maximum(rows["bwd_c"][idx], 0)
 
         # --- arrivals (what the previous tick's ppermutes delivered) ---
+        # Stash slots are (chunk, mb % depth): chunk * depth + mb % depth.
         stash_x = jnp.where(
             arr_f >= 0,
             lax.dynamic_update_index_in_dim(
                 stash_x, y_recv,
-                jnp.maximum(arr_f, 0) % sched.stash_x, axis=0),
+                af_c * sched.stash_x
+                + jnp.maximum(arr_f, 0) % sched.stash_x, axis=0),
             stash_x,
         )
         stash_dh = jnp.where(
             arr_b >= 0,
             lax.dynamic_update_index_in_dim(
                 stash_dh, dh_recv,
-                jnp.maximum(arr_b, 0) % sched.stash_dh, axis=0),
+                ab_c * sched.stash_dh
+                + jnp.maximum(arr_b, 0) % sched.stash_dh, axis=0),
             stash_dh,
         )
 
         # --- forward tick ---------------------------------------------
         mbf_c = jnp.maximum(mbf, 0)
-        # The inject psum's j must be STAGE 0's microbatch this tick (the
-        # consumer's row, identical on every participant), not each
-        # stage's own row.
-        inject = owner_slice(x, jnp.maximum(rows["fwd0"], 0))
+        # The inject psum's j must be GLOBAL STAGE 0's microbatch this
+        # tick (the consumer's row, identical on every participant), not
+        # each device's own row.
+        inject = owner_slice(x, jnp.maximum(rows["inject"], 0))
         stash_x = jnp.where(
-            jnp.logical_and(mbf >= 0, idx == 0),
+            jnp.logical_and(mbf >= 0,
+                            jnp.logical_and(idx == 0, cf == 0)),
             lax.dynamic_update_index_in_dim(
                 stash_x, inject, mbf_c % sched.stash_x, axis=0),
             stash_x,
         )
         h_in = lax.dynamic_index_in_dim(
-            stash_x, mbf_c % sched.stash_x, keepdims=False)
+            stash_x, cf * sched.stash_x + mbf_c % sched.stash_x,
+            keepdims=False)
+        is_last_stage_f = jnp.logical_and(idx == p - 1, cf == v - 1)
         if sharded_head:
-            # The last stage's output feeds the unconditional head phase
-            # below: compute and stash it on every F tick.
+            # The last GLOBAL stage's output feeds the unconditional head
+            # phase below: compute and stash it on every F tick.
             if unconditional:
                 # Collectives in the body: run it every tick, mask the
                 # RESULT (bubble-tick inputs are finite stash contents).
-                y_raw, _ = run_stage(stage_params, h_in)
+                y_raw, _ = run_stage(stage_params, h_in, cf)
                 y_val = jnp.where(mbf >= 0, y_raw.astype(x.dtype), zeros_mb)
             else:
                 y_val = lax.cond(
                     mbf >= 0,
-                    lambda h_in=h_in: run_stage(
-                        stage_params, h_in)[0].astype(x.dtype),
+                    lambda h_in=h_in, cf=cf: run_stage(
+                        stage_params, h_in, cf)[0].astype(x.dtype),
                     lambda: zeros_mb,
                 )
             stash_y = jnp.where(
-                mbf >= 0,
+                jnp.logical_and(mbf >= 0, cf == v - 1),
                 lax.dynamic_update_index_in_dim(
                     stash_y, y_val, mbf_c % sched.stash_x, axis=0),
                 stash_y,
             )
             y_send = y_val
         else:
-            # The LAST stage's F-tick output is never consumed (its
-            # backward recomputes the forward inside the loss vjp, and the
-            # ring wrap to stage 0 is always discarded — stage 0 injects):
-            # skip it instead of paying M wasted stage-forwards on the
-            # critical last stage.
+            # The LAST global stage's F-tick output is never consumed
+            # (its backward recomputes the forward inside the loss vjp,
+            # and its ring wrap is always discarded): skip it instead of
+            # paying M wasted stage-forwards on the critical last stage.
             y_send = lax.cond(
-                jnp.logical_and(mbf >= 0, idx != p - 1),
-                lambda h_in=h_in: run_stage(
-                    stage_params, h_in)[0].astype(x.dtype),
+                jnp.logical_and(mbf >= 0,
+                                jnp.logical_not(is_last_stage_f)),
+                lambda h_in=h_in, cf=cf: run_stage(
+                    stage_params, h_in, cf)[0].astype(x.dtype),
                 lambda: zeros_mb,
             )
 
         # --- backward tick --------------------------------------------
         mbb_c = jnp.maximum(mbb, 0)
         x_j = lax.dynamic_index_in_dim(
-            stash_x, mbb_c % sched.stash_x, keepdims=False)
+            stash_x, cb * sched.stash_x + mbb_c % sched.stash_x,
+            keepdims=False)
         dh_j = lax.dynamic_index_in_dim(
-            stash_dh, mbb_c % sched.stash_dh, keepdims=False)
-        # Targets go to the LAST stage's microbatch this tick; d_x comes
-        # back from STAGE 0's. Both psums use the consumer's row.
-        jl = rows["bwd_last"]
+            stash_dh, cb * sched.stash_dh + mbb_c % sched.stash_dh,
+            keepdims=False)
+        # Targets go to the LAST global stage's microbatch this tick;
+        # d_x comes back from GLOBAL STAGE 0's. Both psums use the
+        # consumer's row.
+        jl = rows["head"]
         jl_c = jnp.maximum(jl, 0)
         tgt_j = owner_slice(targets, jl_c)
         w_jl = lax.dynamic_index_in_dim(loss_weights, jl_c, keepdims=False)
@@ -497,9 +638,10 @@ def pipeline_1f1b_value_and_grad(
             d_head = jax.tree.map(
                 lambda a, g: a + jnp.where(active_l, g, jnp.zeros_like(g)),
                 d_head, d_hp_l)
-            # On the last stage, mbb == jl by construction: its stage
-            # backward seeds from the head phase's cotangent.
-            dh_eff = jnp.where(idx == p - 1,
+            # On the last GLOBAL stage, mbb == jl by construction: its
+            # stage backward seeds from the head phase's cotangent.
+            is_last_stage_b = jnp.logical_and(idx == p - 1, cb == v - 1)
+            dh_eff = jnp.where(is_last_stage_b,
                                d_hb.astype(jnp.float32), dh_j)
             active_b = mbb >= 0
             if unconditional:
@@ -507,7 +649,7 @@ def pipeline_1f1b_value_and_grad(
                 # collectives) runs every tick; zero seeds make idle
                 # ticks' gradient contributions exactly zero.
                 (y_p, aux_p), stage_vjp = jax.vjp(
-                    lambda sp, xx: run_stage(sp, xx), stage_params, x_j)
+                    lambda sp, xx: run_stage(sp, xx, cb), stage_params, x_j)
                 dh_seed = jnp.where(active_b, dh_eff, 0.0).astype(x.dtype)
                 aux_ct = jnp.where(
                     active_b, jnp.asarray(aux_seed, jnp.float32), 0.0
@@ -517,9 +659,10 @@ def pipeline_1f1b_value_and_grad(
                 if with_aux:
                     aux_acc = aux_acc + jnp.where(active_b, aux_p, 0.0)
             else:
-                def bwd_active(x_j=x_j, dh_eff=dh_eff):
+                def bwd_active(x_j=x_j, dh_eff=dh_eff, cb=cb):
                     (y_p, aux_p), vjp = jax.vjp(
-                        lambda sp, xx: run_stage(sp, xx), stage_params, x_j)
+                        lambda sp, xx: run_stage(sp, xx, cb),
+                        stage_params, x_j)
                     aux_ct = jnp.asarray(
                         aux_seed, jnp.float32).astype(aux_p.dtype)
                     d_sp, d_xj = vjp((dh_eff.astype(x.dtype), aux_ct))
@@ -535,18 +678,19 @@ def pipeline_1f1b_value_and_grad(
                     aux_acc = aux_acc + aux_p
             d_stage = jax.tree.map(lambda a, g: a + g, d_stage, d_sp)
         else:
-            def bwd_last(x_j=x_j, tgt_j=tgt_j, w_jl=w_jl):
+            def bwd_last(x_j=x_j, tgt_j=tgt_j, w_jl=w_jl, cb=cb):
                 loss_j, vjp = jax.vjp(
                     lambda sp, hp, xx: head_loss_fn(
-                        run_stage(sp, xx)[0], hp, tgt_j),
+                        run_stage(sp, xx, cb)[0], hp, tgt_j),
                     stage_params, head_params, x_j)
                 d_sp, d_hp, d_xj = vjp(w_jl.astype(loss_j.dtype))
                 return (loss_j * w_jl, d_sp, d_hp,
                         d_xj.astype(jnp.float32))
 
-            def bwd_mid(x_j=x_j, dh_j=dh_j):
+            def bwd_mid(x_j=x_j, dh_j=dh_j, cb=cb):
                 _, vjp = jax.vjp(
-                    lambda sp, xx: run_stage(sp, xx)[0], stage_params, x_j)
+                    lambda sp, xx: run_stage(sp, xx, cb)[0],
+                    stage_params, x_j)
                 d_sp, d_xj = vjp(dh_j.astype(x.dtype))
                 return (jnp.zeros((), jnp.float32), d_sp,
                         _tree_zeros_like(head_params),
@@ -559,19 +703,23 @@ def pipeline_1f1b_value_and_grad(
 
             loss_j, d_sp, d_hp, d_xj = lax.cond(
                 mbb >= 0,
-                lambda: lax.cond(idx == p - 1, bwd_last, bwd_mid),
+                lambda: lax.cond(
+                    jnp.logical_and(idx == p - 1, cb == v - 1),
+                    bwd_last, bwd_mid),
                 bwd_idle,
             )
             loss_acc = loss_acc + loss_j
             d_stage = jax.tree.map(lambda a, g: a + g, d_stage, d_sp)
             d_head = jax.tree.map(lambda a, g: a + g, d_head, d_hp)
-        # Stage 0's input cotangent travels back to the microbatch's OWNER
-        # stage, which banks it in its d_x shard (collective outside
-        # conds). The banked microbatch is STAGE 0's bwd row this tick.
-        bank_j = rows["bwd0"]
+        # Global stage 0's input cotangent travels back to the
+        # microbatch's OWNER device, which banks it in its d_x shard
+        # (collective outside conds). The banked microbatch is the
+        # schedule's bank row this tick (device 0's chunk-0 backward).
+        bank_j = rows["bank"]
         bank_c = jnp.maximum(bank_j, 0)
         d_xj_at_owner = lax.psum(
-            jnp.where(idx == 0, d_xj, jnp.zeros_like(d_xj)), axis)
+            jnp.where(jnp.logical_and(idx == 0, cb == 0),
+                      d_xj, jnp.zeros_like(d_xj)), axis)
         d_x = jnp.where(
             jnp.logical_and(bank_j >= 0, idx == bank_c // m_local),
             lax.dynamic_update_index_in_dim(
@@ -591,15 +739,19 @@ def pipeline_1f1b_value_and_grad(
     rows = {
         "fwd": jnp.asarray(sched.fwd),
         "bwd": jnp.asarray(sched.bwd),
+        "fwd_c": jnp.asarray(sched.fwd_c),
+        "bwd_c": jnp.asarray(sched.bwd_c),
         "arr_f": jnp.asarray(sched.arr_f),
         "arr_b": jnp.asarray(sched.arr_b),
-        "fwd0": jnp.asarray(sched.fwd[:, 0]),          # stage 0 injects
-        "bwd0": jnp.asarray(sched.bwd[:, 0]),          # stage 0 emits d_x
-        "bwd_last": jnp.asarray(sched.bwd[:, -1]),     # last stage's loss
+        "arr_f_c": jnp.asarray(sched.arr_f_c),
+        "arr_b_c": jnp.asarray(sched.arr_b_c),
+        "inject": jnp.asarray(sched.inject),  # global stage 0 injects
+        "bank": jnp.asarray(sched.bank),      # global stage 0 emits d_x
+        "head": jnp.asarray(sched.head),      # last global stage's loss
     }
     carry0 = (
-        jnp.zeros((sched.stash_x,) + mb_shape, x.dtype),
-        jnp.zeros((sched.stash_dh,) + mb_shape, jnp.float32),
+        jnp.zeros((v * sched.stash_x,) + mb_shape, x.dtype),
+        jnp.zeros((v * sched.stash_dh,) + mb_shape, jnp.float32),
     ) + ((jnp.zeros((sched.stash_x,) + mb_shape, x.dtype),)
          if sharded_head else ()) + (
         _tree_zeros_like(stage_params),
@@ -639,7 +791,30 @@ def pipeline_1f1b_value_and_grad(
         loss = lax.psum(loss, b)
         d_head = jax.tree.map(lambda g, b=b: lax.psum(g, b), d_head)
         d_stage = jax.tree.map(lambda g, b=b: lax.psum(g, b), d_stage)
+    if v > 1:
+        # Back to the [L/P, ...] per-device layout the out_specs expect.
+        d_stage = jax.tree.map(
+            lambda a: a.reshape((-1,) + a.shape[2:]), d_stage)
     return loss, d_stage, d_head, d_x
+
+
+def interleave_layer_permutation(n_layers: int, p: int, v: int):
+    """Global [L] layer-stack order for interleaved 1F1B: device-major
+    chunks, so shard_map's contiguous [L/P] shard on device d is exactly
+    its v chunks (chunk c = GLOBAL stage c*P+d) back to back. Returns
+    (perm, inv): ``stack[perm]`` is the schedule layout, ``grads[inv]``
+    restores canonical layer order."""
+    if n_layers % (p * v):
+        raise ValueError(
+            f"{n_layers} layers not divisible by pipe {p} x virtual {v}")
+    lc = n_layers // (p * v)
+    perm = []
+    for d in range(p):
+        for c in range(v):
+            s = c * p + d
+            perm.extend(range(s * lc, (s + 1) * lc))
+    perm = np.asarray(perm, np.int32)
+    return perm, np.argsort(perm).astype(np.int32)
 
 
 def _mentions_axis(spec, axis: str) -> bool:
@@ -661,6 +836,7 @@ def make_1f1b_value_and_grad(
     seq_axis: str | None = None,
     with_aux: bool = False,
     aux_weight: float = 0.0,
+    n_virtual: int = 1,
 ):
     """shard_map-wrapped 1F1B over ``mesh``: returns
     vg(stacked_params, head_params, x, targets, loss_weights=None) ->
@@ -683,6 +859,14 @@ def make_1f1b_value_and_grad(
     ``with_aux``/``aux_weight``: layer_fn returns (h, aux); the summed
     aux joins the loss at weight aux_weight/(M * reduce_shards) —
     GPipe's per-microbatch-mean + cross-shard pmean semantics.
+
+    ``n_virtual`` > 1 runs the Megatron-interleaved schedule (v chunks
+    of L/(P*v) layers per device; bubble (P-1)/(v*M+P-1)). The global
+    layer stack is re-ordered with ``interleave_layer_permutation``
+    before the shard_map and gradients restored after — a static gather
+    that XLA lowers to one weight exchange per call; production runs at
+    scale should pre-permute storage instead (the schedule layout is a
+    placement decision, like any sharding).
     """
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
@@ -709,6 +893,12 @@ def make_1f1b_value_and_grad(
         if loss_weights is None:
             loss_weights = jnp.full((m,), 1.0 / (m * reduce_shards),
                                     jnp.float32)
+        if n_virtual > 1:
+            n_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+            perm, inv = interleave_layer_permutation(
+                n_layers, int(mesh.shape[axis]), n_virtual)
+            stacked_params = jax.tree.map(
+                lambda a: jnp.take(a, perm, axis=0), stacked_params)
         sp_spec = jax.tree.map(lambda _: P(axis), stacked_params)
         if head_specs is not None:
             hp_spec = head_specs
@@ -717,7 +907,7 @@ def make_1f1b_value_and_grad(
         head_is_sharded = jax.tree.map(
             lambda s: _mentions_axis(s, axis), hp_spec,
             is_leaf=lambda s: isinstance(s, P))
-        return shard_map(
+        out = shard_map(
             functools.partial(
                 pipeline_1f1b_value_and_grad,
                 layer_fn, head_loss_fn,
@@ -726,12 +916,19 @@ def make_1f1b_value_and_grad(
                 head_is_sharded=head_is_sharded,
                 unconditional=seq_axis is not None,
                 with_aux=with_aux, aux_seed=aux_seed,
+                n_virtual=n_virtual,
             ),
             mesh=mesh,
             in_specs=(sp_spec, hp_spec, x_spec, tgt_spec, P()),
             out_specs=(P(), sp_spec, hp_spec, x_spec),
             check_vma=False,
         )(stacked_params, head_params, x, targets, loss_weights)
+        if n_virtual > 1:
+            loss, d_stacked, d_head, d_x = out
+            d_stacked = jax.tree.map(
+                lambda a: jnp.take(a, inv, axis=0), d_stacked)
+            return loss, d_stacked, d_head, d_x
+        return out
 
     return vg
 

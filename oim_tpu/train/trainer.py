@@ -102,6 +102,10 @@ class TrainConfig:
     # not O(M); needs microbatches % pipe == 0). Both compose with MoE
     # and with a seq axis inside the pipe (ring/ulysses/zigzag).
     pipeline_schedule: str = "gpipe"
+    # Interleaved 1F1B: v virtual stages (layer chunks) per device,
+    # bubble (P-1)/(v*M+P-1) instead of (P-1)/(M+P-1). Needs
+    # pipeline_schedule="1f1b" and n_layers % (pipe * v) == 0.
+    virtual_stages: int = 1
     remat: bool = False  # recompute activations in bwd (fit big configs)
     remat_policy: str = ""  # "", "dots", "dots_with_no_batch_dims", "nothing"
     accum_steps: int = 1  # gradient accumulation: split the batch, one update
@@ -325,6 +329,7 @@ def make_train_step(
             mesh, mcfg, cfg.microbatches, attn_fn,
             seq_axis="seq" if pipe_with_seq else None,
             seq_parallel=cfg.seq_parallel,
+            n_virtual=max(1, cfg.virtual_stages),
         )
 
         def grad_fn(params, extra, batch):  # noqa: F811 - deliberate override
@@ -490,7 +495,21 @@ class Trainer:
         rules = RULES[self.cfg.rules]
         multihost = jax.process_count() > 1
         out = {}
+        from jax.sharding import NamedSharding
+
         for k, v in batch.items():
+            if (isinstance(v, jax.Array)
+                    and isinstance(v.sharding, NamedSharding)
+                    and v.sharding.mesh == self.mesh):
+                # Device-resident feed: the batch was staged straight
+                # into HBM (the plane's sharded MapVolume scatter) with
+                # a global sharding over THIS mesh already attached —
+                # re-placing it would round-trip through the host (and
+                # is impossible for a multi-host global array anyway).
+                # Anything else (host arrays, stray single-device
+                # device_puts) still goes through normal placement.
+                out[k] = v
+                continue
             axes = (BATCH,) + (None,) * (np.ndim(v) - 1)
             if k == "tokens":
                 axes = (BATCH, None)  # seq dim of the (T+1) batch stays host-split
@@ -578,18 +597,24 @@ class Trainer:
             # synthetic streams) then serve step N the same batch an
             # uninterrupted run would have — the loss trajectory CONTINUES
             # instead of replaying early batches (asserted by the
-            # multi-host kill/resume e2e). Cost: O(start_step) host-side
-            # batch production; for deep resumes prefer a feed that can
-            # seek (reseed/skip at the source) over replaying decode work.
-            try:
-                for _ in range(start_step):
-                    next(data)
-            except StopIteration:
-                raise RuntimeError(
-                    f"feed exhausted while fast-forwarding to resume step "
-                    f"{start_step}: the resumed feed must cover at least "
-                    "as many batches as the original run consumed"
-                ) from None
+            # multi-host kill/resume e2e). Feeds exposing ``seek(n)``
+            # (data/feeds.py SeekableFeed — whole-volume cycle feeds)
+            # reposition at the source in index arithmetic; others replay
+            # at O(start_step) host-side batch production.
+            seek = getattr(data, "seek", None)
+            if callable(seek):
+                seek(start_step)
+            else:
+                try:
+                    for _ in range(start_step):
+                        next(data)
+                except StopIteration:
+                    raise RuntimeError(
+                        f"feed exhausted while fast-forwarding to resume "
+                        f"step {start_step}: the resumed feed must cover "
+                        "at least as many batches as the original run "
+                        "consumed"
+                    ) from None
         fps = flops_per_step(cfg)
         peak = peak_flops_per_device() * self.mesh.size
         last_loss = float("nan")

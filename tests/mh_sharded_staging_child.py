@@ -1,0 +1,125 @@
+"""Rank process for TestCrossProcessShardedStaging (VERDICT r4 missing
+#3): stages ONE volume into ONE NamedSharding whose devices span TWO
+processes, reading only this process's shard bytes.
+
+Flow (per rank):
+1. jax.distributed via the registry-elected coordinator (the trainer's
+   bootstrap path), global ``data=8`` mesh over 2 processes x 4 devices.
+2. Control plane: publish the volume through MapVolume on THIS rank's
+   controller (the feeder path — registration, coordinates, StageStatus).
+3. Data plane: stage the same source through ``plane.stage_source`` with
+   ``NamedSharding(global_mesh, P("data"))``. The plane reads ONLY the
+   byte runs of this process's addressable shards
+   (``addressable_devices_indices_map`` + ``slice_runs``) and assembles
+   the global array with ``jax.make_array_from_single_device_arrays`` —
+   the multi-host claim of plane.py:29-34, executed here for real. A
+   counting reader proves per-process bytes read == shard bytes ==
+   volume/2, and readback of every addressable shard is exact.
+4. The trainer consumes the staged global array for a 2-step DP run
+   (device-resident batches pass through place_batch untouched).
+
+The staging runs in the RANK processes because only the process that
+owns a device may create its shards — on a real pod the controller
+backend is hosted in the device-owning process; the MapVolume publish
+above keeps the control-plane contract identical either way.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--registry", required=True)
+    ap.add_argument("--controller-id", required=True)
+    ap.add_argument("--coordinator-port", type=int, required=True)
+    ap.add_argument("--volume-file", required=True)
+    ap.add_argument("--ca", required=True)
+    ap.add_argument("--key", required=True)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from oim_tpu.common.tlsutil import load_tls
+    from oim_tpu.parallel.bootstrap import initialize_from_registry
+
+    tls = load_tls(args.ca, args.key, "component.registry")
+    pid, n = initialize_from_registry(
+        args.registry, args.controller_id, 2, tls,
+        coordinator_port=args.coordinator_port,
+    )
+    print(f"distributed process_id: {pid} num_processes: {n}", flush=True)
+
+    from oim_tpu.parallel import build_mesh
+
+    mesh = build_mesh([("data", 8)])
+
+    # -- control plane: MapVolume on THIS rank's controller --------------
+    from oim_tpu.feeder import Feeder
+    from oim_tpu.spec import pb
+
+    feeder = Feeder(
+        registry_address=args.registry,
+        controller_id=args.controller_id, tls=tls,
+    )
+    file_params = pb.FileParams(path=args.volume_file, format="raw")
+    feeder.publish(pb.MapVolumeRequest(
+        volume_id="mh-sharded-vol", file=file_params), timeout=60)
+
+    # -- data plane: sharded staging, counting THIS process's reads ------
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from oim_tpu.data import plane
+
+    src = plane.lower_source("file", file_params)
+    counted = {"bytes": 0}
+    orig_reader = plane.READERS["file"]
+
+    def counting_reader(locator, offset, length, dst, headers):
+        counted["bytes"] += length
+        return orig_reader(locator, offset, length, dst, headers)
+
+    plane.READERS["file"] = counting_reader
+    rows = src.total_bytes // (33 * 4)
+    sharding = NamedSharding(mesh, P("data"))
+    arr = plane.stage_source(
+        src, dtype=np.dtype(np.int32), shape=(rows, 33),
+        sharding=sharding, chunk_bytes=1 << 20,
+    )
+    plane.READERS["file"] = orig_reader
+    bytes_read = counted["bytes"]
+
+    shard_bytes = sum(s.data.nbytes for s in arr.addressable_shards)
+    volume_bytes = src.total_bytes
+    assert bytes_read == shard_bytes, (bytes_read, shard_bytes)
+    assert shard_bytes * 2 == volume_bytes, (shard_bytes, volume_bytes)
+
+    # Exact readback of every addressable shard against the source file.
+    full = np.fromfile(args.volume_file, np.int32).reshape(rows, 33)
+    for s in arr.addressable_shards:
+        np.testing.assert_array_equal(np.asarray(s.data), full[s.index])
+    print(f"STAGED_OK bytes_read={bytes_read} shard_bytes={shard_bytes} "
+          f"volume_bytes={volume_bytes}", flush=True)
+
+    # -- the trainer consumes the staged array (device-resident feed) ----
+    from oim_tpu.train import TrainConfig, Trainer
+
+    cfg = TrainConfig(
+        model="llama-tiny", batch_size=rows, seq_len=32, log_every=1,
+        warmup_steps=1, total_steps=2,
+    )
+    trainer = Trainer(cfg, mesh=mesh)
+    loss = trainer.run(steps=2, data=itertools.repeat({"tokens": arr}))
+    print(f"final_loss: {round(float(loss), 4)}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

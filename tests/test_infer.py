@@ -8,7 +8,7 @@ import sys
 
 import numpy as np
 
-from oim_tpu.cli.oim_trainer import _cycle_indices
+from oim_tpu.data.feeds import _cycle_indices
 from oim_tpu.train import TrainConfig, Trainer
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -131,7 +131,7 @@ class TestWebdatasetStreamingFeed:
     def test_streaming_matches_whole_volume(self, tmp_path):
         from types import SimpleNamespace
 
-        from oim_tpu.cli.oim_trainer import _webdataset_token_batches
+        from oim_tpu.data.feeds import _webdataset_token_batches
         from oim_tpu.controller import ControllerService, MallocBackend
         from oim_tpu.feeder import Feeder
         from oim_tpu.spec import pb

@@ -471,3 +471,17 @@ class TestOverlapTiming:
         assert concurrent > 2.5 * min(self.READ_S, self.CONSUME_S), (
             f"reads and consumes barely overlap ({concurrent:.3f}s "
             f"concurrent vs wall {wall:.3f}s, serialized {serial:.3f}s)")
+
+
+class TestSteppedSliceGuard:
+    def test_stepped_slice_falls_back_to_whole_read(self):
+        """A stepped per-dim slice cannot lower to contiguous byte runs;
+        slice_runs must return None (whole-read fallback) instead of
+        staging wrong bytes silently (advisor r4)."""
+        from oim_tpu.data import plane
+
+        assert plane.slice_runs(
+            (8, 4), (slice(0, 8, 2), slice(None)), 4) is None
+        # step=1 / None stay lowerable.
+        assert plane.slice_runs(
+            (8, 4), (slice(0, 4, 1), slice(None)), 4) is not None

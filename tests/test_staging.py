@@ -382,7 +382,7 @@ class TestNativeJpegDecode:
     def test_feed_uses_native_and_matches_pillow_tolerance(self, native):
         """_decode_images: native path output within JPEG-decoder tolerance
         of the Pillow path at the same (non-resized) size."""
-        from oim_tpu.cli.oim_trainer import _decode_images
+        from oim_tpu.data.feeds import _decode_images
         from oim_tpu.data import staging as staging_mod
         from oim_tpu.train import TrainConfig
 

@@ -369,3 +369,71 @@ class TestWebdatasetEval:
         b = next(eval_data)
         assert b["tokens"].shape == (2, 33)
         assert b["tokens"].dtype == np.int32
+
+
+class TestSeekableFeeds:
+    """Deep-resume repositioning (advisor r4): whole-volume cycle feeds
+    seek in index arithmetic instead of replaying start_step batches of
+    host decode; the Trainer prefers ``seek`` when the feed has it."""
+
+    def test_cycle_indices_start_batch_equivalence(self):
+        from oim_tpu.data.feeds import _cycle_indices
+
+        for seed in (None, 7):
+            ref = _cycle_indices(10, 4, seed)
+            for _ in range(5):
+                next(ref)
+            expect = [next(ref) for _ in range(3)]
+            got_it = _cycle_indices(10, 4, seed, start_batch=5)
+            got = [next(got_it) for _ in range(3)]
+            for a, b in zip(expect, got):
+                np.testing.assert_array_equal(a, b)
+
+    def test_seekable_feed_repositions(self):
+        from oim_tpu.data.feeds import SeekableFeed, _cycle_indices
+
+        feed = SeekableFeed(
+            lambda start: _cycle_indices(12, 4, 3, start_batch=start))
+        ref = _cycle_indices(12, 4, 3)
+        for _ in range(4):
+            next(ref)
+        feed.seek(4)
+        np.testing.assert_array_equal(next(feed), next(ref))
+        np.testing.assert_array_equal(next(feed), next(ref))
+
+    def test_trainer_uses_seek_on_resume(self, tmp_path):
+        """Resume with a seek-capable feed: the trainer calls seek(n)
+        instead of draining n batches."""
+        from oim_tpu.train import TrainConfig, Trainer
+        from oim_tpu.train.trainer import synthetic_batches
+
+        cfg = TrainConfig(
+            model="llama-tiny", batch_size=2, seq_len=16, log_every=1,
+            warmup_steps=1, total_steps=4,
+            checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_every=2,
+        )
+        Trainer(cfg, axes=[("data", 2)]).run(steps=2)  # step-2 checkpoint
+
+        calls = []
+
+        class Recorder:
+            def __init__(self, inner):
+                self.inner = inner
+
+            def __iter__(self):
+                return self
+
+            def __next__(self):
+                return next(self.inner)
+
+            def seek(self, n):
+                calls.append(n)
+                # Deterministic synthetic stream: reposition by replay
+                # (the recording, not the cost, is under test).
+                self.inner = synthetic_batches(cfg)
+                for _ in range(n):
+                    next(self.inner)
+
+        t2 = Trainer(cfg, axes=[("data", 2)])
+        t2.run(steps=4, data=Recorder(synthetic_batches(cfg)))
+        assert calls == [2], calls
