@@ -102,6 +102,10 @@ FEED_WAIT_SECONDS = DEFAULT.gauge(
     "oim_feed_wait_seconds",
     "host time blocked waiting on the input feed per step (input-bound "
     "when this approaches oim_train_step_seconds)")
+MOE_DROP_FRAC = DEFAULT.gauge(
+    "oim_moe_drop_fraction",
+    "share of MoE routing assignments dropped for capacity in the most "
+    "recent step (mean over layers; the capacity_factor quality signal)")
 
 
 class MetricsServer:

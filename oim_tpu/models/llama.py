@@ -196,15 +196,17 @@ def _remat_policy(cfg: Config):
 
 def _ffn(h, layer, cfg: Config):
     """FFN half of a block on the pre-normed activations; returns
-    (out, aux) — aux is 0 for the dense FFN, the load-balance loss for MoE.
-    Shared by the training path (_layer) and the KV-cached decode path
-    (models/generate.py)."""
+    (out, aux) — aux is the f32 vector [load_balance_loss,
+    dropped_token_fraction] (zeros for the dense FFN): one uniform aux
+    shape lets every schedule's masked accumulator carry the MoE
+    telemetry without special cases. Shared by the training path
+    (_layer) and the KV-cached decode path (models/generate.py)."""
     if cfg.n_experts:
         from oim_tpu.models import moe
 
-        return moe.apply(layer["moe"], h, cfg.moe)
+        return moe.apply(layer["moe"], h, cfg.moe, with_stats=True)
     gated = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
-    return gated @ layer["w_down"], jnp.zeros((), jnp.float32)
+    return gated @ layer["w_down"], jnp.zeros((2,), jnp.float32)
 
 
 def _layer(x, layer, cfg: Config, cos, sin, attn_fn: AttentionFn):
@@ -225,7 +227,8 @@ def _layer(x, layer, cfg: Config, cos, sin, attn_fn: AttentionFn):
 
 def hidden_states(params, tokens, cfg: Config = LLAMA3_8B,
                   attn_fn: AttentionFn | None = None):
-    """tokens [B, T] -> (final-normed hidden [B, T, D], summed MoE aux)."""
+    """tokens [B, T] -> (final-normed hidden [B, T, D], aux vector [2]:
+    [summed MoE load-balance loss, summed per-layer drop fraction])."""
     if attn_fn is None:
         attn_fn = default_attention
     T = tokens.shape[1]
@@ -241,7 +244,7 @@ def hidden_states(params, tokens, cfg: Config = LLAMA3_8B,
         body = jax.checkpoint(
             body, prevent_cse=False, policy=_remat_policy(cfg))
     x, aux = lax.scan(body, x, params["layers"])
-    return rmsnorm(x, params["final_norm"]), jnp.sum(aux)
+    return rmsnorm(x, params["final_norm"]), jnp.sum(aux, axis=0)
 
 
 def apply(params, tokens, cfg: Config = LLAMA3_8B,
@@ -251,14 +254,17 @@ def apply(params, tokens, cfg: Config = LLAMA3_8B,
     x, aux = hidden_states(params, tokens, cfg, attn_fn)
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     if return_aux:
-        return logits, aux
+        return logits, aux[0]
     return logits
 
 
-def loss_fn(params, tokens, cfg: Config = LLAMA3_8B,
-            attn_fn: AttentionFn | None = None,
-            ignore_index: int = -1):
-    """Next-token cross entropy (+ weighted MoE aux loss); tokens [B, T+1].
+def loss_and_stats(params, tokens, cfg: Config = LLAMA3_8B,
+                   attn_fn: AttentionFn | None = None,
+                   ignore_index: int = -1):
+    """Next-token CE (+ weighted MoE aux); returns (loss, stats) with
+    stats["moe_drop_frac"] = mean per-layer dropped share of routing
+    assignments (0 for dense configs) — the capacity_factor telemetry
+    (VERDICT r4 weak #4). tokens [B, T+1].
 
     With cfg.vocab_chunk the CE comes straight from the hidden states via
     the vocab-chunked logsumexp — the [B, T, vocab] logits never exist.
@@ -269,12 +275,22 @@ def loss_fn(params, tokens, cfg: Config = LLAMA3_8B,
             x, params["lm_head"], tokens[:, 1:], cfg.vocab_chunk, ignore_index
         )
     else:
-        logits, aux = apply(params, tokens[:, :-1], cfg, attn_fn,
-                            return_aux=True)
+        x, aux = hidden_states(params, tokens[:, :-1], cfg, attn_fn)
+        logits = (x @ params["lm_head"]).astype(jnp.float32)
         loss = softmax_cross_entropy(logits, tokens[:, 1:], ignore_index)
+    stats = {}
     if cfg.n_experts:
-        loss = loss + cfg.moe_aux_weight * aux
-    return loss
+        loss = loss + cfg.moe_aux_weight * aux[0]
+        stats["moe_drop_frac"] = aux[1] / cfg.n_layers
+    return loss, stats
+
+
+def loss_fn(params, tokens, cfg: Config = LLAMA3_8B,
+            attn_fn: AttentionFn | None = None,
+            ignore_index: int = -1):
+    """Next-token cross entropy (+ weighted MoE aux loss); tokens [B, T+1].
+    See ``loss_and_stats`` for the telemetry-returning variant."""
+    return loss_and_stats(params, tokens, cfg, attn_fn, ignore_index)[0]
 
 
 @functools.lru_cache(maxsize=None)
@@ -357,7 +373,8 @@ def make_pipelined_loss(mesh, cfg: Config, n_microbatches: int,
                         attn_fn: AttentionFn | None = None,
                         axis: str = "pipe", ignore_index: int = -1,
                         seq_axis: str | None = None,
-                        seq_parallel: str = "ring"):
+                        seq_parallel: str = "ring",
+                        with_stats: bool = False):
     """Next-token CE with the stacked layer axis pipelined over ``axis``.
 
     The decoder body runs as a GPipe schedule (parallel/pipeline.py): each
@@ -443,8 +460,12 @@ def make_pipelined_loss(mesh, cfg: Config, n_microbatches: int,
             y = jnp.take(y, inv, axis=1)  # back to natural order
         loss = _head_ce(cfg, y, params["final_norm"], params["lm_head"],
                         tokens[:, 1:], ignore_index)
+        stats = {}
         if cfg.n_experts:
-            loss = loss + cfg.moe_aux_weight * aux
+            loss = loss + cfg.moe_aux_weight * aux[0]
+            stats["moe_drop_frac"] = aux[1] / cfg.n_layers
+        if with_stats:
+            return loss, stats
         return loss
 
     return loss_fn
@@ -486,7 +507,8 @@ def make_1f1b_loss(mesh, cfg: Config, n_microbatches: int,
                    seq_axis: str | None = None,
                    seq_parallel: str = "ring",
                    verify_head: bool | None = None,
-                   n_virtual: int = 1):
+                   n_virtual: int = 1,
+                   with_stats: bool = False):
     """Next-token CE under the 1F1B schedule: returns
     ``value_and_grad(params, tokens[B, T+1]) -> (loss, grads)`` with grads
     shaped like ``params`` — a drop-in for ``jax.value_and_grad`` of the
@@ -604,6 +626,7 @@ def make_1f1b_loss(mesh, cfg: Config, n_microbatches: int,
             head_specs=head_specs, sharded_head=True, seq_axis=seq_axis,
             with_aux=bool(cfg.n_experts),
             aux_weight=cfg.moe_aux_weight if cfg.n_experts else 0.0,
+            aux_shape=(2,) if cfg.n_experts else (),
             n_virtual=n_virtual,
         )
 
@@ -637,8 +660,8 @@ def make_1f1b_loss(mesh, cfg: Config, n_microbatches: int,
         head = {"final_norm": params["final_norm"],
                 "lm_head": params["lm_head"]}
         vg = make_vg(T if zigzag else -1)
-        loss, d_layers, d_head, d_x = vg(
-            params["layers"], head, x, targets, loss_weights)
+        out = vg(params["layers"], head, x, targets, loss_weights)
+        loss, d_layers, d_head, d_x = out[:4]
         (d_embed,) = embed_vjp(d_x.astype(x.dtype))
         grads = {
             "embed": d_embed,
@@ -646,7 +669,19 @@ def make_1f1b_loss(mesh, cfg: Config, n_microbatches: int,
             "final_norm": d_head["final_norm"],
             "lm_head": d_head["lm_head"],
         }
-        return loss, grads
+        if not with_stats:
+            return loss, grads
+        stats = {}
+        if cfg.n_experts:
+            # Fifth output: globally-summed [aux, drop]; normalize drop
+            # to the mean per-layer fraction (the GPipe/stats contract)
+            # by the SAME shard count the kernel psummed over (exposed
+            # by the wrapper — never re-derived here, where it could
+            # silently drift from the kernel's reduce_axes).
+            aux_tot = out[4]
+            stats["moe_drop_frac"] = aux_tot[1] / (
+                m * vg.reduce_shards * cfg.n_layers)
+        return loss, grads, stats
 
     return value_and_grad
 

@@ -16,6 +16,7 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+from jax.lax import stop_gradient as lax_stop_gradient
 
 from oim_tpu.parallel.sharding import EMBED, EXPERT, LAYER, MLP
 
@@ -70,12 +71,19 @@ def capacity(n_tokens: int, cfg: MoEConfig) -> int:
     return max(1, int(cfg.top_k * n_tokens * cfg.capacity_factor / cfg.n_experts))
 
 
-def apply(params, x, cfg: MoEConfig):
+def apply(params, x, cfg: MoEConfig, with_stats: bool = False):
     """x: [B, T, D] -> (out [B, T, D], aux_loss scalar f32).
 
     Tokens over capacity for their chosen expert are dropped (contribute
     zero; the residual stream carries them), the standard capacity
     trade-off that keeps every shape static for XLA.
+
+    ``with_stats``: the second return becomes the f32 vector
+    [aux_loss, dropped_fraction] — dropped_fraction is the share of the
+    N*k routing assignments this group rejected for capacity, the
+    telemetry that makes the capacity_factor quality knob observable
+    (VERDICT r4 weak #4; rides the aux channel so the pipelined paths'
+    masked accumulators carry it unchanged).
     """
     b, t, d = x.shape
     n = b * t
@@ -182,4 +190,10 @@ def apply(params, x, cfg: MoEConfig):
     frac_tokens = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
     frac_probs = jnp.mean(probs, axis=0)
     aux = e * jnp.sum(frac_tokens * frac_probs)
-    return out, aux
+    if not with_stats:
+        return out, aux
+    # Dropped share of the N*k routing assignments (gradient-free: a
+    # count, not a differentiable quantity).
+    kept = sum(jnp.sum(keep.astype(jnp.float32)) for _, _, _, keep in rounds)
+    dropped = lax_stop_gradient(1.0 - kept / (n * k))
+    return out, jnp.stack([aux, dropped])

@@ -50,12 +50,13 @@ def pipeline_apply(
 
     Must be called inside shard_map with ``axis`` bound.
 
-    layer_fn(carry, layer_params) -> carry (or (carry, aux_scalar) when
-        ``with_aux``): one layer (the same body the sequential model scans
-        with). Aux losses (MoE load balance) are summed over a stage's
-        layers, masked to REAL microbatch ticks (bubble ticks compute
-        garbage activations whose aux must not leak into the loss), and
-        reduced across stages.
+    layer_fn(carry, layer_params) -> carry (or (carry, aux) when
+        ``with_aux``; aux a scalar or f32 vector — llama uses
+        [load_balance_loss, drop_fraction]): one layer (the same body the
+        sequential model scans with). Aux values (MoE load balance +
+        telemetry) are summed over a stage's layers, masked to REAL
+        microbatch ticks (bubble ticks compute garbage activations whose
+        aux must not leak into the loss), and reduced across stages.
     stage_params: THIS stage's layer stack [L/P, ...] pytree (the "pipe"
         axis of the global [L, ...] stack, sharded by shard_map).
     x: [M, mb, ...] microbatched input (real data on every stage; only
@@ -80,7 +81,7 @@ def pipeline_apply(
             return out, jnp.zeros((), jnp.float32)
 
         out, aux = lax.scan(body, h, stage_params)
-        return out, jnp.sum(aux)
+        return out, jnp.sum(aux, axis=0)  # sum layers, keep aux vector
 
     outputs = jnp.zeros((m,) + mb_shape, x.dtype)
     h = jnp.zeros(mb_shape, x.dtype)  # activation arriving from the left

@@ -328,7 +328,10 @@ def validate_schedule(sched: Schedule1F1B) -> None:
         else:
             assert peak <= min(
                 v * m, (p - d - 1) * 2 + (v - 1) * p + 1) + 1, (d, peak)
-    assert sched.stash_x <= min(m, 2 * p)
+    # v=1 keeps the classic tight bound (stash depth never exceeds the
+    # pipe depth); interleaving's warmup window legitimately needs up to
+    # ~2P per chunk.
+    assert sched.stash_x <= min(m, p if v == 1 else 2 * p)
 
 
 def _tree_zeros_like(t):
@@ -351,6 +354,7 @@ def pipeline_1f1b_value_and_grad(
     unconditional: bool = False,
     with_aux: bool = False,
     aux_seed: float = 0.0,
+    aux_shape: tuple[int, ...] = (),
     n_virtual: int = 1,
 ):
     """1F1B forward+backward inside shard_map; returns
@@ -415,11 +419,15 @@ def pipeline_1f1b_value_and_grad(
     equals the pipeline bubble, the same FLOPs GPipe always spends.
 
     ``with_aux=True`` (requires sharded_head): layer_fn returns
-    (h, aux_scalar); each (stage, microbatch)'s summed aux joins the loss
-    with static weight ``aux_seed`` (accumulated and seeded on its ONE
-    backward tick, so bubble garbage can't leak in) — the MoE
-    load-balance loss under 1F1B, matching GPipe's masked accumulator
-    semantics exactly (both group capacity per microbatch).
+    (h, aux) with aux of static shape ``aux_shape`` (scalar, or a vector
+    whose FIRST component is the differentiable loss term — llama sends
+    [load_balance_loss, drop_fraction]); each (stage, microbatch)'s
+    summed aux joins the loss with static weight ``aux_seed`` on
+    component 0 (accumulated and seeded on its ONE backward tick, so
+    bubble garbage can't leak in) — the MoE load-balance loss under
+    1F1B, matching GPipe's masked accumulator semantics exactly (both
+    group capacity per microbatch). The summed aux (psum over stages,
+    then the reduce axes) is returned as a fifth output for telemetry.
 
     x: [M/P, mb, ...] THIS STAGE'S SHARD of the microbatched stage-0
         input (the microbatch dim is sharded over the pipe axis — holding
@@ -497,10 +505,16 @@ def pipeline_1f1b_value_and_grad(
             return out, jnp.zeros((), jnp.float32)
 
         out, aux = lax.scan(body, h, sp)
-        return out, jnp.sum(aux)
+        return out, jnp.sum(aux, axis=0)  # sum layers, keep aux vector
 
     zeros_mb = jnp.zeros(mb_shape, x.dtype)
     f32_mb = jnp.zeros(mb_shape, jnp.float32)
+    # Aux cotangent seed: component 0 (the differentiable loss term)
+    # carries aux_seed; telemetry components get zero cotangent.
+    seed_np = np.zeros(aux_shape, np.float32)
+    if with_aux:
+        seed_np.flat[0] = aux_seed
+    aux_seed_c = jnp.asarray(seed_np)
 
     def owner_slice(arr, j):
         """arr[j] of the pipe-sharded [M/P, ...] array, valid on every
@@ -652,7 +666,7 @@ def pipeline_1f1b_value_and_grad(
                     lambda sp, xx: run_stage(sp, xx, cb), stage_params, x_j)
                 dh_seed = jnp.where(active_b, dh_eff, 0.0).astype(x.dtype)
                 aux_ct = jnp.where(
-                    active_b, jnp.asarray(aux_seed, jnp.float32), 0.0
+                    active_b, aux_seed_c, jnp.zeros_like(aux_seed_c)
                 ).astype(aux_p.dtype)
                 d_sp, d_xj = stage_vjp((dh_seed, aux_ct))
                 d_xj = d_xj.astype(jnp.float32)
@@ -663,8 +677,7 @@ def pipeline_1f1b_value_and_grad(
                     (y_p, aux_p), vjp = jax.vjp(
                         lambda sp, xx: run_stage(sp, xx, cb),
                         stage_params, x_j)
-                    aux_ct = jnp.asarray(
-                        aux_seed, jnp.float32).astype(aux_p.dtype)
+                    aux_ct = aux_seed_c.astype(aux_p.dtype)
                     d_sp, d_xj = vjp((dh_eff.astype(x.dtype), aux_ct))
                     return d_sp, d_xj.astype(jnp.float32), aux_p
 
@@ -672,7 +685,7 @@ def pipeline_1f1b_value_and_grad(
                     active_b,
                     bwd_active,
                     lambda: (_tree_zeros_like(stage_params), f32_mb,
-                             jnp.zeros((), jnp.float32)),
+                             jnp.zeros(aux_shape, jnp.float32)),
                 )
                 if with_aux:
                     aux_acc = aux_acc + aux_p
@@ -758,7 +771,7 @@ def pipeline_1f1b_value_and_grad(
         _tree_zeros_like(head_params),
         jnp.zeros_like(x),
         jnp.zeros((), jnp.float32),
-        jnp.zeros((), jnp.float32),  # aux_acc
+        jnp.zeros(aux_shape, jnp.float32),  # aux_acc
         zeros_mb,  # y_recv (tick-0 arrival rows are all -1)
         f32_mb,    # dh_recv
     )
@@ -779,11 +792,14 @@ def pipeline_1f1b_value_and_grad(
             lambda g: lax.psum(
                 jnp.where(idx == p - 1, g, jnp.zeros_like(g)), axis),
             d_head)
+    aux_tot = None
     if with_aux:
         # Each stage accumulated ITS OWN layers' aux; sum over stages,
-        # weight like GPipe's masked accumulator (aux_seed is the global
-        # per-(stage,mb) weight — aux_weight / (M * reduce_shards)).
-        loss = loss + lax.psum(aux_acc, axis) * jnp.float32(aux_seed)
+        # weight component 0 like GPipe's masked accumulator (aux_seed
+        # is the global per-(stage,mb) weight —
+        # aux_weight / (M * reduce_shards)).
+        aux_tot = lax.psum(aux_acc, axis)
+        loss = loss + jnp.sum(aux_tot * aux_seed_c)
     # Global units everywhere: loss_weights already carry the 1/shards
     # factor, so cross-shard reductions are plain psums and d_x needs no
     # correction (it came out of vjps seeded in global units).
@@ -791,10 +807,14 @@ def pipeline_1f1b_value_and_grad(
         loss = lax.psum(loss, b)
         d_head = jax.tree.map(lambda g, b=b: lax.psum(g, b), d_head)
         d_stage = jax.tree.map(lambda g, b=b: lax.psum(g, b), d_stage)
+        if aux_tot is not None:
+            aux_tot = lax.psum(aux_tot, b)
     if v > 1:
         # Back to the [L/P, ...] per-device layout the out_specs expect.
         d_stage = jax.tree.map(
             lambda a: a.reshape((-1,) + a.shape[2:]), d_stage)
+    if with_aux:
+        return loss, d_stage, d_head, d_x, aux_tot
     return loss, d_stage, d_head, d_x
 
 
@@ -836,6 +856,7 @@ def make_1f1b_value_and_grad(
     seq_axis: str | None = None,
     with_aux: bool = False,
     aux_weight: float = 0.0,
+    aux_shape: tuple[int, ...] = (),
     n_virtual: int = 1,
 ):
     """shard_map-wrapped 1F1B over ``mesh``: returns
@@ -856,9 +877,12 @@ def make_1f1b_value_and_grad(
     (see pipeline_1f1b_value_and_grad); default = 1/(M * reduce_shards),
     the mean over microbatches and batch/seq shards.
 
-    ``with_aux``/``aux_weight``: layer_fn returns (h, aux); the summed
-    aux joins the loss at weight aux_weight/(M * reduce_shards) —
-    GPipe's per-microbatch-mean + cross-shard pmean semantics.
+    ``with_aux``/``aux_weight``: layer_fn returns (h, aux) of shape
+    ``aux_shape``; component 0 joins the loss at weight
+    aux_weight/(M * reduce_shards) — GPipe's per-microbatch-mean +
+    cross-shard pmean semantics — and vg returns the globally-summed
+    aux as a FIFTH output (telemetry; divide by M * reduce_shards for
+    the per-microbatch mean).
 
     ``n_virtual`` > 1 runs the Megatron-interleaved schedule (v chunks
     of L/(P*v) layers per device; bubble (P-1)/(v*M+P-1)). The global
@@ -916,20 +940,26 @@ def make_1f1b_value_and_grad(
                 head_is_sharded=head_is_sharded,
                 unconditional=seq_axis is not None,
                 with_aux=with_aux, aux_seed=aux_seed,
+                aux_shape=aux_shape,
                 n_virtual=n_virtual,
             ),
             mesh=mesh,
             in_specs=(sp_spec, hp_spec, x_spec, tgt_spec, P()),
-            out_specs=(P(), sp_spec, hp_spec, x_spec),
+            out_specs=(P(), sp_spec, hp_spec, x_spec)
+            + ((P(),) if with_aux else ()),
             check_vma=False,
         )(stacked_params, head_params, x, targets, loss_weights)
         if n_virtual > 1:
-            loss, d_stacked, d_head, d_x = out
-            d_stacked = jax.tree.map(
-                lambda a: jnp.take(a, inv, axis=0), d_stacked)
-            return loss, d_stacked, d_head, d_x
+            out = (out[0],
+                   jax.tree.map(lambda a: jnp.take(a, inv, axis=0), out[1]),
+                   ) + tuple(out[2:])
         return out
 
+    # Callers normalizing the returned aux (telemetry) must divide by
+    # the SAME shard count the kernel psums over — expose it instead of
+    # making them mirror the reduce_axes derivation.
+    vg.reduce_shards = reduce_shards
+    vg.reduce_axes = reduce_axes
     return vg
 
 

@@ -236,16 +236,18 @@ def make_train_step(
             pipe_loss = llama.make_pipelined_loss(
                 mesh, mcfg, cfg.microbatches, attn_fn,
                 seq_axis="seq" if pipe_with_seq else None,
-                seq_parallel=cfg.seq_parallel,
+                seq_parallel=cfg.seq_parallel, with_stats=True,
             )
 
             def loss_fn(params, extra, batch):
-                return pipe_loss(params, batch["tokens"]), extra
+                loss, stats = pipe_loss(params, batch["tokens"])
+                return loss, (extra, stats)
         else:
 
             def loss_fn(params, extra, batch):
-                loss = llama.loss_fn(params, batch["tokens"], mcfg, attn_fn)
-                return loss, extra
+                loss, stats = llama.loss_and_stats(
+                    params, batch["tokens"], mcfg, attn_fn)
+                return loss, (extra, stats)
 
         def eval_stats_fn(params, extra, batch):
             # llama eval = same forward, no update.
@@ -269,7 +271,8 @@ def make_train_step(
             logits, new_extra = resnet.apply(
                 params, extra, batch["images"], mcfg, training=True
             )
-            return softmax_cross_entropy(logits, batch["labels"]), new_extra
+            loss = softmax_cross_entropy(logits, batch["labels"])
+            return loss, (new_extra, {})
 
         def eval_stats_fn(params, extra, batch):
             # Inference mode: running BN statistics, state untouched.
@@ -330,11 +333,12 @@ def make_train_step(
             seq_axis="seq" if pipe_with_seq else None,
             seq_parallel=cfg.seq_parallel,
             n_virtual=max(1, cfg.virtual_stages),
+            with_stats=True,
         )
 
         def grad_fn(params, extra, batch):  # noqa: F811 - deliberate override
-            loss, grads = vg_1f1b(params, batch["tokens"])
-            return (loss, extra), grads
+            loss, grads, stats = vg_1f1b(params, batch["tokens"])
+            return (loss, (extra, stats)), grads
     accum = max(1, cfg.accum_steps)
 
     def compute_grads(params, extra, batch):
@@ -356,28 +360,29 @@ def make_train_step(
 
         def body(carry, mb):
             gsum, extra, loss_sum = carry
-            (loss, new_extra), grads = grad_fn(params, extra, mb)
+            (loss, (new_extra, stats)), grads = grad_fn(params, extra, mb)
             # Accumulate in f32: a bf16 accumulator (param dtype) rounds
             # away low bits every add — the drift grows with accum_steps on
             # exactly the big-model configs accumulation exists for.
             gsum = jax.tree.map(
                 lambda a, g: a + g.astype(jnp.float32), gsum, grads
             )
-            return (gsum, new_extra, loss_sum + loss), None
+            return (gsum, new_extra, loss_sum + loss), stats
 
         zeros = jax.tree.map(
             lambda p: jnp.zeros(p.shape, jnp.float32), params
         )
-        (gsum, new_extra, loss_sum), _ = lax.scan(
+        (gsum, new_extra, loss_sum), stats_stack = lax.scan(
             body, (zeros, extra, jnp.zeros((), jnp.float32)), micro
         )
         grads = jax.tree.map(
             lambda g, p: (g / accum).astype(p.dtype), gsum, params
         )
-        return (loss_sum / accum, new_extra), grads
+        stats = jax.tree.map(lambda s: jnp.mean(s), stats_stack)
+        return (loss_sum / accum, (new_extra, stats)), grads
 
     def step_fn(state: TrainState, batch):
-        (loss, new_extra), grads = compute_grads(
+        (loss, (new_extra, model_stats)), grads = compute_grads(
             state.params, state.extra, batch
         )
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
@@ -391,6 +396,9 @@ def make_train_step(
         stats = {
             "loss": loss.astype(jnp.float32),
             "grad_norm": optax.global_norm(grads).astype(jnp.float32),
+            # Model telemetry (MoE routing drop fraction etc.) rides the
+            # same stats dict the loop logs/exports.
+            **{k: v.astype(jnp.float32) for k, v in model_stats.items()},
         }
         return new_state, stats
 
@@ -652,11 +660,17 @@ class Trainer:
                 M.FEED_WAIT_SECONDS.set(feed_wait / n_steps)
                 mfu = fps / dt / peak if peak else 0.0
                 M.TRAIN_MFU.set(mfu)
+                extra_stats = {}
+                if "moe_drop_frac" in stats:
+                    drop = float(stats["moe_drop_frac"])
+                    M.MOE_DROP_FRAC.set(drop)
+                    extra_stats["moe_drop_frac"] = round(drop, 4)
                 log.info(
                     "step", step=i + 1, loss=round(last_loss, 4),
                     grad_norm=round(float(stats["grad_norm"]), 4),
                     step_s=round(dt, 4), mfu=round(mfu, 4),
                     feed_wait_s=round(feed_wait / n_steps, 4),
+                    **extra_stats,
                 )
                 feed_wait = 0.0
             if eval_every and (i + 1) % eval_every == 0:

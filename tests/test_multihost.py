@@ -332,7 +332,7 @@ class TestDistributedCheckpointResume:
     recovery proven by killing processes (controller_test.go:107-127)."""
 
     def _spawn_pair(self, cluster, volume_path, steps, ckpt_dir=None,
-                    checkpoint_every=0):
+                    checkpoint_every=0, volume="mh-ckpt"):
         coord_port = free_port()
         procs = []
         for i in range(2):
@@ -346,7 +346,7 @@ class TestDistributedCheckpointResume:
                 "--controller-id", f"host-{i}",
                 "--expected-hosts", "2",
                 "--coordinator-port", str(coord_port),
-                "--volume", "mh-ckpt", "--volume-file", str(volume_path),
+                "--volume", volume, "--volume-file", str(volume_path),
                 "--feed-window-bytes", "0",
                 "--ca", f"{cluster.certs}/ca.crt",
                 "--key", f"{cluster.certs}/host.host-{i}",
@@ -382,6 +382,82 @@ class TestDistributedCheckpointResume:
         m = re.findall(r"final_loss: ([0-9.]+)", out)
         assert m, out[-2000:]
         return float(m[-1])
+
+    def test_resume_into_fewer_processes(self, cluster, tmp_path):
+        """Distributed ELASTIC resume (VERDICT r4 next-round #9): a
+        checkpoint written by 2 ranks x 4 devices (data=8) restores into
+        ONE process x 4 devices (data=4) — orbax reshards every
+        state leaf onto the smaller mesh on restore — and training
+        CONTINUES the trajectory (same global batch, same math; the loss
+        matches a 2-rank uninterrupted control run)."""
+        tokens = np.random.RandomState(6).randint(0, 256, 8 * 33 * 4)
+        path = tmp_path / "tokens.bin"
+        tokens.astype(np.int32).tofile(path)
+        ckpt = tmp_path / "ckpt-elastic"
+
+        # Phase 1: 2-rank pair checkpoints step 2, then SIGKILL.
+        # A distinct volume id: the conflicting-republish guard would
+        # (rightly) reject the sibling test's "mh-ckpt" with a different
+        # source file on the shared module cluster.
+        pair = self._spawn_pair(cluster, path, steps=50, ckpt_dir=ckpt,
+                                checkpoint_every=2,
+                                volume="mh-ckpt-elastic")
+        deadline = time.monotonic() + 420
+        committed = None
+        while time.monotonic() < deadline:
+            if any(p.poll() is not None for p in pair):
+                outs = [p.communicate()[0] for p in pair]
+                raise AssertionError(
+                    f"rank died before checkpoint: {outs[0][-2000:]}\n"
+                    f"{outs[1][-2000:]}")
+            committed = self._committed_step(ckpt)
+            if committed is not None and committed >= 2:
+                break
+            time.sleep(0.5)
+        assert committed is not None and committed >= 2
+        for p in pair:
+            p.kill()
+        for p in pair:
+            p.wait(timeout=30)
+        resumed_from = self._committed_step(ckpt) or committed
+        target = resumed_from + 1
+
+        # Control: uninterrupted 2-rank run to the same target.
+        control = self._spawn_pair(cluster, path, steps=target,
+                                   volume="mh-ckpt-elastic")
+        control_losses = []
+        for i, proc in enumerate(control):
+            out, _ = proc.communicate(timeout=600)
+            assert proc.returncode == 0, f"control rank {i}:\n{out[-4000:]}"
+            control_losses.append(self._final_loss(out))
+
+        # Phase 2: ONE process, HALF the mesh (data=4), resumes the
+        # 2-rank checkpoint and trains one more step.
+        single = subprocess.Popen(
+            [sys.executable, "-m", "oim_tpu.cli.oim_trainer",
+             "--platform", "cpu", "--model", "llama-tiny",
+             "--steps", str(target), "--batch-size", "8",
+             "--seq-len", "32", "--log-every", "1",
+             "--warmup-steps", "1", "--mesh", "data=4",
+             "--registry", f"127.0.0.1:{cluster.registry_port}",
+             "--controller-id", "host-0",
+             "--volume", "mh-ckpt-elastic", "--volume-file", str(path),
+             "--feed-window-bytes", "0",
+             "--checkpoint-dir", str(ckpt), "--checkpoint-every", "0",
+             "--ca", f"{cluster.certs}/ca.crt",
+             "--key", f"{cluster.certs}/host.host-0"],
+            env=child_env(devices=4),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        out, _ = single.communicate(timeout=600)
+        assert single.returncode == 0, f"elastic resume failed:\n{out[-4000:]}"
+        assert re.search(rf"resumed \| step: {resumed_from}\b", out), (
+            f"single process did not resume from step {resumed_from}:\n"
+            f"{out[-2000:]}")
+        loss = self._final_loss(out)
+        # Same global batch and math on half the devices: only collective
+        # reduction order differs.
+        np.testing.assert_allclose(loss, control_losses[0], rtol=1e-4)
 
     def test_kill_both_ranks_resume_continues_trajectory(
             self, cluster, tmp_path):
