@@ -71,6 +71,10 @@ class Config:
     # [B, T, vocab] logits (ops/losses.py chunked_softmax_cross_entropy) —
     # at 128k vocab that tensor is the step's biggest activation.
     vocab_chunk: int = 0
+    # z_loss > 0 adds z_loss * mean(logsumexp^2) to the CE (Megatron/PaLM
+    # logit-drift regularizer; typical 1e-4). Supported by every loss
+    # path: plain, chunked-vocab, and the 1F1B vocab-parallel head.
+    z_loss: float = 0.0
 
     @property
     def moe(self):
@@ -269,16 +273,30 @@ def loss_and_stats(params, tokens, cfg: Config = LLAMA3_8B,
     With cfg.vocab_chunk the CE comes straight from the hidden states via
     the vocab-chunked logsumexp — the [B, T, vocab] logits never exist.
     """
-    if cfg.vocab_chunk:
-        x, aux = hidden_states(params, tokens[:, :-1], cfg, attn_fn)
-        loss = chunked_softmax_cross_entropy(
-            x, params["lm_head"], tokens[:, 1:], cfg.vocab_chunk, ignore_index
-        )
-    else:
-        x, aux = hidden_states(params, tokens[:, :-1], cfg, attn_fn)
-        logits = (x @ params["lm_head"]).astype(jnp.float32)
-        loss = softmax_cross_entropy(logits, tokens[:, 1:], ignore_index)
     stats = {}
+    x, aux = hidden_states(params, tokens[:, :-1], cfg, attn_fn)
+    labels = tokens[:, 1:]
+    if cfg.vocab_chunk:
+        loss = chunked_softmax_cross_entropy(
+            x, params["lm_head"], labels, cfg.vocab_chunk,
+            ignore_index, z_loss=cfg.z_loss,
+            return_z_term=bool(cfg.z_loss),
+        )
+        if cfg.z_loss:
+            loss, stats["z_loss_term"] = loss
+    else:
+        logits = (x @ params["lm_head"]).astype(jnp.float32)
+        loss = softmax_cross_entropy(logits, labels, ignore_index,
+                                     z_loss=cfg.z_loss)
+        if cfg.z_loss:
+            # Report the regularizer separately (raw CE = loss - term:
+            # perplexity and logit drift stay observable; eval losses
+            # stay comparable across z_loss coefficients).
+            logz = jax.nn.logsumexp(logits, axis=-1)
+            mask = (labels != ignore_index).astype(jnp.float32)
+            stats["z_loss_term"] = cfg.z_loss * (
+                jnp.sum(jnp.square(logz) * mask)
+                / jnp.maximum(jnp.sum(mask), 1.0))
     if cfg.n_experts:
         loss = loss + cfg.moe_aux_weight * aux[0]
         stats["moe_drop_frac"] = aux[1] / cfg.n_layers
@@ -496,9 +514,11 @@ def _head_ce(cfg: Config, y, final_norm, lm_head, targets, ignore_index):
     y = rmsnorm(y, final_norm)
     if cfg.vocab_chunk:
         return chunked_softmax_cross_entropy(
-            y, lm_head, targets, cfg.vocab_chunk, ignore_index)
+            y, lm_head, targets, cfg.vocab_chunk, ignore_index,
+            z_loss=cfg.z_loss)
     logits = (y @ lm_head).astype(jnp.float32)
-    return softmax_cross_entropy(logits, targets, ignore_index)
+    return softmax_cross_entropy(logits, targets, ignore_index,
+                                 z_loss=cfg.z_loss)
 
 
 def make_1f1b_loss(mesh, cfg: Config, n_microbatches: int,
@@ -594,7 +614,8 @@ def make_1f1b_loss(mesh, cfg: Config, n_microbatches: int,
     def head_loss_fn(h, hp, tgt):
         y = rmsnorm(h, hp["final_norm"])
         return vocab_parallel_cross_entropy(
-            y, hp["lm_head"], tgt, axis, ignore_index, reduction="sum")
+            y, hp["lm_head"], tgt, axis, ignore_index, reduction="sum",
+            z_loss=cfg.z_loss)
 
     head_specs = {"final_norm": P(), "lm_head": P(None, axis)}
     if verify_head is None:

@@ -10,11 +10,15 @@ import jax
 import jax.numpy as jnp
 
 
-def softmax_cross_entropy(logits, labels, ignore_index: int | None = None):
-    """Mean token cross entropy.
+def softmax_cross_entropy(logits, labels, ignore_index: int | None = None,
+                          z_loss: float = 0.0):
+    """Mean token cross entropy (+ optional z-loss).
 
     logits: [..., vocab]; labels: [...] int. ``ignore_index`` labels are
-    masked out of the mean (padding).
+    masked out of the mean (padding). ``z_loss`` adds
+    z_loss * mean(logsumexp^2) over the same tokens — the Megatron/PaLM
+    logit-drift regularizer (keeps the softmax normalizer near 1 so bf16
+    logits stay in range over long runs).
     """
     logits = logits.astype(jnp.float32)
     logz = jax.nn.logsumexp(logits, axis=-1)
@@ -22,6 +26,8 @@ def softmax_cross_entropy(logits, labels, ignore_index: int | None = None):
         logits, labels[..., None], axis=-1
     )[..., 0]
     nll = logz - label_logits
+    if z_loss:
+        nll = nll + z_loss * jnp.square(logz)
     if ignore_index is not None:
         mask = (labels != ignore_index).astype(jnp.float32)
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
@@ -33,7 +39,8 @@ def accuracy(logits, labels):
 
 
 def chunked_softmax_cross_entropy(
-    x, lm_head, labels, vocab_chunk: int, ignore_index: int | None = None
+    x, lm_head, labels, vocab_chunk: int, ignore_index: int | None = None,
+    z_loss: float = 0.0, return_z_term: bool = False,
 ):
     """CE straight from hidden states, never materializing [N, vocab].
 
@@ -96,17 +103,23 @@ def chunked_softmax_cross_entropy(
     @jax.custom_vjp
     def nll_fn(xf, w):
         m, s, lab = scan_stats(xf, w)
-        return jnp.log(s) + m - lab
+        logz = jnp.log(s) + m
+        return logz - lab, logz
 
     def nll_fwd(xf, w):
         m, s, lab = scan_stats(xf, w)
-        return jnp.log(s) + m - lab, (xf, w, m, s)
+        logz = jnp.log(s) + m
+        return (logz - lab, logz), (xf, w, m, s)
 
-    def nll_bwd(res, g):
+    def nll_bwd(res, gs):
+        g, gz = gs  # cotangents of (nll, logz) — logz feeds the z-loss
         xf, w, m, s = res
-        # d nll / d logits_c = softmax_c - onehot_c; chunk logits are
-        # recomputed, gradients accumulate chunk by chunk (dx in f32 — a
+        # d nll / d logits_c = softmax_c - onehot_c and
+        # d logz / d logits_c = softmax_c, so the combined per-chunk
+        # cotangent is p*(g+gz) - onehot*g; chunk logits are recomputed,
+        # gradients accumulate chunk by chunk (dx in f32 — a
         # low-precision accumulator would drift over many chunks).
+        gp = g + gz
 
         def body(dx, inp):
             w_c, idx = inp
@@ -121,7 +134,7 @@ def chunked_softmax_cross_entropy(
                  == jnp.arange(vocab_chunk)[None, :])
                 & hit[:, None]
             ).astype(jnp.float32)
-            dlogits = ((p - onehot) * g[:, None]).astype(xf.dtype)
+            dlogits = (p * gp[:, None] - onehot * g[:, None]).astype(xf.dtype)
             dx = dx + (dlogits @ w_c.T).astype(jnp.float32)
             dw = xf.T @ dlogits
             return dx, dw
@@ -133,17 +146,30 @@ def chunked_softmax_cross_entropy(
 
     nll_fn.defvjp(nll_fwd, nll_bwd)
 
-    nll = nll_fn(xf, w)
-    if ignore_index is not None:
-        mask = (yf != ignore_index).astype(jnp.float32)
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-    return jnp.mean(nll)
+    nll, logz = nll_fn(xf, w)
+    z_sq = jnp.square(logz)
+    if z_loss:
+        nll = nll + z_loss * z_sq
+
+    def reduce(v):
+        if ignore_index is not None:
+            mask = (yf != ignore_index).astype(jnp.float32)
+            return jnp.sum(v * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        return jnp.mean(v)
+
+    total = reduce(nll)
+    if return_z_term:
+        # The regularizer's magnitude, reported separately so raw CE
+        # (perplexity) and logit drift stay observable.
+        return total, z_loss * reduce(z_sq)
+    return total
 
 
 def vocab_parallel_cross_entropy(
     y, lm_head_shard, labels, axis: str,
     ignore_index: int | None = None,
     reduction: str = "mean",
+    z_loss: float = 0.0,
 ):
     """Token CE with the LM head VOCAB-SHARDED over mesh ``axis``.
 
@@ -184,6 +210,12 @@ def vocab_parallel_cross_entropy(
     picked = jnp.take_along_axis(z, local_label[..., None], axis=-1)[..., 0]
     label_logits = lax.psum(jnp.where(mine, picked, 0.0), axis)
     nll = logz - label_logits
+    if z_loss:
+        # The z-loss path crosses the SAME single sumexp psum as the CE
+        # (logz is replicated downstream of it), so the sharded-head
+        # gradient contract holds — verified by
+        # verify_sharded_head_contract at make_1f1b_loss build time.
+        nll = nll + z_loss * jnp.square(logz)
     if ignore_index is not None:
         mask = (labels != ignore_index).astype(jnp.float32)
         total = jnp.sum(nll * mask)

@@ -250,9 +250,14 @@ def make_train_step(
                 return loss, (extra, stats)
 
         def eval_stats_fn(params, extra, batch):
-            # llama eval = same forward, no update.
-            loss, _ = loss_fn(params, extra, batch)
-            return {"loss": loss.astype(jnp.float32)}
+            # llama eval = same forward, no update; model telemetry
+            # (moe_drop_frac, z_loss_term) rides along so eval CE stays
+            # comparable across regularizer settings.
+            loss, (_, stats) = loss_fn(params, extra, batch)
+            return {
+                "loss": loss.astype(jnp.float32),
+                **{k: v.astype(jnp.float32) for k, v in stats.items()},
+            }
 
         # Tokens arrive [B, T+1] — the +1 label shift makes the length
         # indivisible by a seq axis, so tokens stay batch-sharded only;
@@ -661,10 +666,13 @@ class Trainer:
                 mfu = fps / dt / peak if peak else 0.0
                 M.TRAIN_MFU.set(mfu)
                 extra_stats = {}
-                if "moe_drop_frac" in stats:
-                    drop = float(stats["moe_drop_frac"])
-                    M.MOE_DROP_FRAC.set(drop)
-                    extra_stats["moe_drop_frac"] = round(drop, 4)
+                for k, v in stats.items():
+                    if k in ("loss", "grad_norm"):
+                        continue
+                    val = float(v)
+                    extra_stats[k] = round(val, 4)
+                    if k == "moe_drop_frac":
+                        M.MOE_DROP_FRAC.set(val)
                 log.info(
                     "step", step=i + 1, loss=round(last_loss, 4),
                     grad_norm=round(float(stats["grad_norm"]), 4),
