@@ -294,16 +294,17 @@ def bench_llama(chain_short: int, chain_long: int, profile_dir: str = "") -> dic
     from oim_tpu.train.state import make_optimizer
     from oim_tpu.train.trainer import peak_flops_per_device
 
-    # Batch 8 with policy-limited remat beats batch 4 without: plain b8
-    # OOMs (22.6G/15.75G), full remat costs ~9% MFU, but saving the matmul
-    # outputs and recomputing only elementwise work measured 0.7425 MFU vs
-    # 0.6916 for the b4 baseline (BASELINE.md r3 sweep).
+    # Batch 10 with policy-limited remat is the measured best (r5 sweep:
+    # same-day A/B b10 0.7372-0.7378 vs b8 0.7160-0.7267, interleaved
+    # runs; b12 fails to compile on 16G). Policy remat (save matmul
+    # outputs, recompute elementwise) is what lets batches past 4 fit at
+    # all — plain b8 OOMs at 22.6G/15.75G (BASELINE.md r3 sweep).
     cfg = llama.Config(
         vocab=32768, dim=2048, n_layers=8, n_heads=16, n_kv_heads=8,
         head_dim=128, mlp_dim=8192, max_seq=2048,
         remat=True, remat_policy="dots_with_no_batch_dims",
     )
-    batch, seq = 8, 2048
+    batch, seq = 10, 2048
     params = llama.init(jax.random.PRNGKey(0), cfg)
     tx = make_optimizer(lr=3e-4, warmup_steps=10, total_steps=100)
     opt_state = tx.init(params)
