@@ -560,6 +560,22 @@ class Test1F1BTrainer:
         assert all(np.isfinite(np.asarray(p)).all()
                    for p in jax.tree.leaves(trainer.state.params))
 
+    def test_trainer_accum_with_1f1b_full_step(self):
+        """Gradient accumulation wraps the 1F1B vg in a lax.scan (the
+        kernel's collectives run inside the scan body): the last
+        untested trainer combination steps and stays finite."""
+        cfg = TrainConfig(
+            model="llama-tiny", rules="pipe", microbatches=2,
+            pipeline_schedule="1f1b", accum_steps=2, batch_size=8,
+            seq_len=32, log_every=1, warmup_steps=1, total_steps=2,
+            model_overrides={"n_layers": 4},
+        )
+        trainer = Trainer(cfg, axes=[("data", 2), ("pipe", 2)])
+        loss = trainer.run(steps=2)
+        assert np.isfinite(loss)
+        assert all(np.isfinite(np.asarray(p)).all()
+                   for p in jax.tree.leaves(trainer.state.params))
+
     def test_unknown_schedule_rejected(self):
         cfg = TrainConfig(
             model="llama-tiny", rules="pipe", pipeline_schedule="2f2b",
