@@ -9,7 +9,7 @@
 PY ?= python
 RUFF := $(shell command -v ruff 2>/dev/null)
 
-.PHONY: test pytest lint drift native tsan demo start stop clean
+.PHONY: test pytest lint drift proto native tsan demo start stop clean
 
 # drift and tsan are standalone conveniences; the full pytest target
 # already runs both (SpecDrift + the TSAN stream test build in-fixture).
@@ -19,6 +19,12 @@ pytest:
 	$(PY) -m pytest tests/ -q
 
 drift:
+	$(PY) -m pytest tests/test_common.py -q -k SpecDrift
+
+# Regenerate oim.proto + oim_pb2.py from spec.md, then prove the tree is
+# drift-free: the one command to run after editing the ```proto block.
+proto:
+	$(PY) scripts/gen_proto.py
 	$(PY) -m pytest tests/test_common.py -q -k SpecDrift
 
 lint:

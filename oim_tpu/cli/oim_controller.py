@@ -34,7 +34,15 @@ def main(argv: list[str] | None = None) -> int:
         "--registry-delay",
         type=float,
         default=60.0,
-        help="re-registration interval seconds (reference -registry-delay)",
+        help="heartbeat/re-registration interval seconds "
+             "(reference -registry-delay)",
+    )
+    parser.add_argument(
+        "--lease-seconds",
+        type=float,
+        default=0.0,
+        help="registry lease TTL; 0 derives 2.5x --registry-delay, "
+             "negative registers permanent (pre-lease) entries",
     )
     parser.add_argument(
         "--backend",
@@ -67,6 +75,7 @@ def main(argv: list[str] | None = None) -> int:
         controller_address=args.controller_address,
         registry_address=args.registry,
         registry_delay=args.registry_delay,
+        lease_seconds=args.lease_seconds,
         mesh_coord=coord,
         tls=tls,
     )

@@ -20,11 +20,21 @@ def main(argv: list[str] | None = None) -> int:
         help="journal the KV DB to this file (survives restarts; default "
              "is the reference's soft-state in-memory DB)",
     )
+    parser.add_argument(
+        "--boot-grace-seconds", type=float, default=150.0,
+        help="lease granted to controller keys replayed from --db-file at "
+             "startup: live controllers renew within one heartbeat, dead "
+             "ones expire after the grace instead of living forever "
+             "(lease state itself cannot survive a restart); 0 disables",
+    )
     add_common_flags(parser)
     args = parser.parse_args(argv)
     setup_logging(args)
     db = FileRegistryDB(args.db_file) if args.db_file else MemRegistryDB()
-    service = RegistryService(db=db, tls=load_tls_flags(args))
+    service = RegistryService(
+        db=db, tls=load_tls_flags(args),
+        boot_grace_seconds=args.boot_grace_seconds if args.db_file else 0.0,
+    )
     server = registry_server(args.endpoint, service)
     try:
         server.wait()

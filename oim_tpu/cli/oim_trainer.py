@@ -256,11 +256,21 @@ def main(argv: list[str] | None = None) -> int:
             # streams keep the replay fallback (their state is not a
             # pure function of the batch index). The factory rebuilds
             # the whole chain INCLUDING the prefetcher, so seek() also
-            # discards any batches decoded ahead of the old position.
+            # discards any batches decoded ahead of the old position —
+            # but every rebuild shares ONE Feeder, whose publish cache
+            # makes MapVolume a one-time cost (a seek repositions in
+            # index space; it must not re-stage the volume).
             from oim_tpu.data.feeds import SeekableFeed
+            from oim_tpu.feeder import Feeder
+
+            feed_feeder = Feeder(
+                registry_address=args.registry,
+                controller_id=args.controller_id,
+                tls=tls,
+            )
 
             def _make_feed(start):
-                d = feeder_batches(args, cfg, tls, start)
+                d = feeder_batches(args, cfg, tls, start, feeder=feed_feeder)
                 if args.prefetch_batches > 0:
                     from oim_tpu.data.prefetch import prefetch_batches
 

@@ -1,4 +1,4 @@
-"""oimctl admin CLI: get/set registry keys over mTLS
+"""oimctl admin CLI: get/set registry keys + cluster health view over mTLS
 (reference cmd/oimctl/main.go)."""
 
 from __future__ import annotations
@@ -8,8 +8,36 @@ import argparse
 import grpc
 
 from oim_tpu.cli.common import add_common_flags, load_tls_flags, setup_logging
+from oim_tpu.common.pathutil import REGISTRY_ADDRESS, REGISTRY_MESH
 from oim_tpu.common.tlsutil import secure_channel
 from oim_tpu.spec import RegistryStub, pb
+
+
+def health_rows(stub: RegistryStub) -> list[tuple[str, str, str, str]]:
+    """(controller, status, address, mesh) per registered controller.
+
+    Status is derived from the lease plane: ALIVE when the address key
+    survives the registry's lease filter, STALE when it only shows up in
+    the ``include_stale`` view (lease expired — the controller stopped
+    heartbeating; the proxy fast-fails it and feeders fail away from it).
+    """
+    live = {
+        v.path
+        for v in stub.GetValues(pb.GetValuesRequest(path=""), timeout=10).values
+    }
+    stale = stub.GetValues(
+        pb.GetValuesRequest(path="", include_stale=True), timeout=10
+    ).values
+    entries = {v.path: v.value for v in stale}
+    rows = []
+    for path in sorted(entries):
+        cid, _, key = path.partition("/")
+        if key != REGISTRY_ADDRESS:
+            continue
+        status = "ALIVE" if path in live else "STALE"
+        mesh = entries.get(f"{cid}/{REGISTRY_MESH}", "")
+        rows.append((cid, status, entries[path], mesh))
+    return rows
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -17,10 +45,20 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--registry", required=True, help="registry address")
     parser.add_argument("--get", default=None, metavar="PATH", help="prefix to read")
     parser.add_argument(
+        "--stale",
+        action="store_true",
+        help="include lease-expired entries in --get output",
+    )
+    parser.add_argument(
         "--set",
         default=None,
         metavar="PATH=VALUE",
         help="key to set (empty VALUE deletes)",
+    )
+    parser.add_argument(
+        "--health",
+        action="store_true",
+        help="controller liveness table from the registry's lease plane",
     )
     add_common_flags(parser)
     args = parser.parse_args(argv)
@@ -40,11 +78,17 @@ def main(argv: list[str] | None = None) -> int:
                 pb.SetValueRequest(value=pb.Value(path=path, value=value)), timeout=10
             )
         if args.get is not None:
-            reply = stub.GetValues(pb.GetValuesRequest(path=args.get), timeout=10)
+            reply = stub.GetValues(
+                pb.GetValuesRequest(path=args.get, include_stale=args.stale),
+                timeout=10,
+            )
             for value in reply.values:
                 print(f"{value.path}={value.value}")
-        if args.set is None and args.get is None:
-            raise SystemExit("nothing to do: pass --get and/or --set")
+        if args.health:
+            for cid, status, address, mesh in health_rows(stub):
+                print(f"{cid}\t{status}\t{address}\t{mesh}")
+        if args.set is None and args.get is None and not args.health:
+            raise SystemExit("nothing to do: pass --get, --set and/or --health")
     finally:
         channel.close()
     return 0

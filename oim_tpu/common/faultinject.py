@@ -1,0 +1,97 @@
+"""Deterministic fault injection for control-plane tests.
+
+The health plane's failure paths (dropped heartbeats, dead controllers,
+dial failures) are exercised in-process: production code calls
+``fire(point, **ctx)`` at named fault points, which is a no-op until a
+test arms the point. Faults are DETERMINISTIC — armed with an exact
+count and optional context match — so tests assert recovery behavior,
+never race a random fault schedule. This is the TPU-repo analog of the
+reference's SPDK error-injection bdevs (test/pkg/spdk, used by the
+ring-2 fault tests): the failure is injected below the API under test,
+and the assertion is that the layer above heals.
+
+Named points wired in this repo:
+
+* ``controller.heartbeat`` — before the controller's Heartbeat RPC
+  (ctx: controller_id). Arming it simulates heartbeats lost on the wire.
+* ``controller.register``  — before register_once's SetValue(s)
+  (ctx: controller_id). Arming it simulates a registry outage.
+* ``proxy.dial``           — before the transparent proxy dials a
+  controller (ctx: controller_id, address).
+* ``feeder.rpc``           — before each remote feeder data-plane RPC
+  (ctx: controller_id, method). Arming it simulates a controller that
+  accepted the publish and then froze.
+
+All state is process-global (the fixture in tests resets it); a
+``fire`` on an unarmed point costs one dict lookup.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+
+class InjectedFault(Exception):
+    """Raised at an armed fault point (when no custom exc is supplied)."""
+
+
+@dataclass
+class _Fault:
+    exc: BaseException | type[BaseException]
+    times: int | None  # None = until disarmed
+    match: dict[str, Any] = field(default_factory=dict)
+    fired: int = 0
+
+
+_faults: dict[str, _Fault] = {}
+_lock = threading.Lock()
+
+
+def arm(point: str, *, exc: BaseException | type[BaseException] | None = None,
+        times: int | None = None, **match: Any) -> None:
+    """Arm ``point``: the next ``times`` matching ``fire`` calls raise
+    ``exc`` (default InjectedFault). ``match`` keys must equal the
+    ``fire`` context for the fault to trigger; non-matching calls pass
+    through untouched (and don't consume ``times``)."""
+    with _lock:
+        _faults[point] = _Fault(
+            exc=exc if exc is not None else InjectedFault(point),
+            times=times, match=dict(match),
+        )
+
+
+def disarm(point: str) -> None:
+    with _lock:
+        _faults.pop(point, None)
+
+
+def reset() -> None:
+    """Disarm everything (test-fixture teardown)."""
+    with _lock:
+        _faults.clear()
+
+
+def fired(point: str) -> int:
+    """How many times ``point`` has triggered since it was armed."""
+    with _lock:
+        fault = _faults.get(point)
+        return fault.fired if fault else 0
+
+
+def fire(point: str, **ctx: Any) -> None:
+    """Production-code hook: raise if ``point`` is armed and ``ctx``
+    matches. No-op (one dict lookup) otherwise."""
+    with _lock:
+        fault = _faults.get(point)
+        if fault is None:
+            return
+        if any(ctx.get(k) != v for k, v in fault.match.items()):
+            return
+        if fault.times is not None:
+            if fault.fired >= fault.times:
+                return
+        fault.fired += 1
+        exc = fault.exc
+    raise exc if not isinstance(exc, type) else exc(point)

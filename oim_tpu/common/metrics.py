@@ -106,6 +106,22 @@ MOE_DROP_FRAC = DEFAULT.gauge(
     "oim_moe_drop_fraction",
     "share of MoE routing assignments dropped for capacity in the most "
     "recent step (mean over layers; the capacity_factor quality signal)")
+# Health plane (registry leases / controller heartbeats / failure recovery).
+LEASE_EXPIRIES = DEFAULT.counter(
+    "oim_lease_expiries_total",
+    "registry entries that crossed from live to expired (counted once per "
+    "expiry, when a read first observes the entry stale)")
+HEARTBEAT_RTT = DEFAULT.gauge(
+    "oim_heartbeat_rtt_seconds",
+    "round-trip time of the controller's most recent registry heartbeat")
+PROXY_FASTFAILS = DEFAULT.counter(
+    "oim_proxy_fastfail_total",
+    "proxied calls refused without dialing because the target controller's "
+    "lease had expired")
+FEEDER_FAILOVERS = DEFAULT.counter(
+    "oim_feeder_failovers_total",
+    "feeder re-targets to a different controller serving the same mesh "
+    "coordinate after the pinned controller became unavailable")
 
 
 class MetricsServer:

@@ -5,19 +5,27 @@
   existing volume with identical params returns the existing placement
   (controller.go:96-125); unmapping an unknown volume succeeds
   (controller.go:202-209).
-* ``Controller`` wraps the service with the self-registration loop
-  (controller.go:411-476): a background thread that (re-)registers
-  ``<id>/address`` and ``<id>/mesh`` into the registry immediately and then
-  every ``registry_delay`` seconds, dialing a fresh channel each attempt so a
-  restarted registry recovers its soft-state DB (README.md:138-143).
+* ``Controller`` wraps the service with the health-plane loop (the
+  reference's self-registration loop, controller.go:411-476, upgraded to
+  leases): a background thread registers ``<id>/address`` and ``<id>/mesh``
+  with a lease TTL, then HEARTBEATS every ``registry_delay`` seconds to renew
+  it (fresh channel per attempt — README.md:138-143). ``known == false`` in a
+  heartbeat reply (registry restarted, lease swept) triggers an immediate
+  full re-registration; registry outages back off exponentially with jitter
+  so a restarting registry isn't thundering-herded by the fleet; a registry
+  without the Heartbeat RPC degrades to the reference's plain re-register-
+  every-delay loop.
 """
 
 from __future__ import annotations
 
+import random
 import threading
+import time
 
 import grpc
 
+from oim_tpu.common import faultinject, metrics as M
 from oim_tpu.common.keymutex import KeyMutex
 from oim_tpu.common.logging import from_context
 from oim_tpu.common.meshcoord import MeshCoord
@@ -196,7 +204,14 @@ class ControllerService(ControllerServicer):
 
 
 class Controller:
-    """Service + registration loop + server wiring (controller.go:379-495)."""
+    """Service + heartbeat loop + server wiring (controller.go:379-495)."""
+
+    # Default lease TTL as a multiple of the heartbeat interval: one lost
+    # heartbeat must not expire a healthy controller, two-and-a-half do.
+    LEASE_FACTOR = 2.5
+    # Backoff bounds for registry outages (seconds). The base also scales
+    # down with registry_delay so short-interval test rigs retry promptly.
+    BACKOFF_MAX = 30.0
 
     def __init__(
         self,
@@ -205,6 +220,7 @@ class Controller:
         controller_address: str = "",
         registry_address: str = "",
         registry_delay: float = 60.0,
+        lease_seconds: float = 0.0,
         mesh_coord: MeshCoord | None = None,
         tls: TLSConfig | None = None,
     ):
@@ -215,17 +231,26 @@ class Controller:
         self.controller_address = controller_address
         self.registry_address = registry_address
         self.registry_delay = registry_delay
+        # 0 = derive from the heartbeat interval; < 0 = no lease (register
+        # permanent entries — the pre-health-plane behavior).
+        if lease_seconds == 0.0:
+            lease_seconds = self.LEASE_FACTOR * registry_delay
+        self.lease_seconds = max(lease_seconds, 0.0)
         self.mesh_coord = mesh_coord
         self.tls = tls
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
-    # -- registration loop ------------------------------------------------
+    # -- heartbeat loop ----------------------------------------------------
+
+    def _registry_channel(self) -> grpc.Channel:
+        return dial(self.registry_address, self.tls, "component.registry")
 
     def register_once(self) -> None:
-        """One registration attempt over a fresh channel
-        (controller.go:448-468)."""
-        channel = dial(self.registry_address, self.tls, "component.registry")
+        """One full registration (address + mesh, with lease) over a fresh
+        channel (controller.go:448-468)."""
+        faultinject.fire("controller.register", controller_id=self.controller_id)
+        channel = self._registry_channel()
         try:
             stub = RegistryStub(channel)
             stub.SetValue(
@@ -233,6 +258,7 @@ class Controller:
                     value=pb.Value(
                         path=f"{self.controller_id}/{REGISTRY_ADDRESS}",
                         value=self.controller_address,
+                        lease_seconds=self.lease_seconds,
                     )
                 ),
                 timeout=10.0,
@@ -243,6 +269,7 @@ class Controller:
                         value=pb.Value(
                             path=f"{self.controller_id}/{REGISTRY_MESH}",
                             value=self.mesh_coord.format(),
+                            lease_seconds=self.lease_seconds,
                         )
                     ),
                     timeout=10.0,
@@ -250,21 +277,86 @@ class Controller:
         finally:
             channel.close()
 
+    def heartbeat_once(self) -> bool:
+        """One lease renewal over a fresh channel. Returns the registry's
+        ``known`` verdict (False = it lost our registration; re-register).
+        Raises grpc.RpcError with UNIMPLEMENTED against a pre-lease
+        registry (the caller degrades to plain re-registration)."""
+        faultinject.fire("controller.heartbeat", controller_id=self.controller_id)
+        channel = self._registry_channel()
+        try:
+            stub = RegistryStub(channel)
+            t0 = time.monotonic()
+            reply = stub.Heartbeat(
+                pb.HeartbeatRequest(
+                    controller_id=self.controller_id,
+                    lease_seconds=self.lease_seconds,
+                ),
+                timeout=10.0,
+            )
+            M.HEARTBEAT_RTT.set(time.monotonic() - t0)
+            return reply.known
+        finally:
+            channel.close()
+
     def start(self) -> None:
-        """Begin periodic self-registration (controller.go:411-446)."""
+        """Begin the register-then-heartbeat loop (controller.go:411-446,
+        plus lease renewal and jittered-backoff outage recovery)."""
         if not self.registry_address:
             return
 
         def loop() -> None:
             log = from_context().with_fields(controller=self.controller_id)
+            registered = False
+            heartbeat_supported = True
+            failures = 0
             while not self._stop.is_set():
                 try:
-                    self.register_once()
-                    log.debug("registered", registry=self.registry_address)
-                except grpc.RpcError as err:
+                    if not registered or not heartbeat_supported:
+                        self.register_once()
+                        registered = True
+                        log.debug("registered", registry=self.registry_address,
+                                  lease_s=self.lease_seconds)
+                    else:
+                        if not self.heartbeat_once():
+                            # Registry forgot us (restart / swept lease):
+                            # re-register NOW, not one interval from now.
+                            log.warning("lease lost; re-registering")
+                            registered = False
+                            continue
+                        log.debug("heartbeat", registry=self.registry_address)
+                    failures = 0
+                except (grpc.RpcError, faultinject.InjectedFault) as err:
+                    if (isinstance(err, grpc.RpcError)
+                            and err.code() == grpc.StatusCode.UNIMPLEMENTED
+                            and heartbeat_supported):
+                        # Pre-lease registry: degrade to the reference's
+                        # plain re-register-every-delay loop.
+                        heartbeat_supported = False
+                        log.warning(
+                            "registry has no Heartbeat RPC; falling back to "
+                            "periodic re-registration"
+                        )
+                        continue
+                    failures += 1
+                    detail = (err.details() or str(err.code())
+                              if isinstance(err, grpc.RpcError) else str(err))
+                    # Jittered exponential backoff: a restarting registry
+                    # must not be hit by the whole fleet in lockstep.
+                    base = min(1.0, self.registry_delay)
+                    delay = min(base * 2 ** (failures - 1), self.BACKOFF_MAX)
+                    delay *= 0.5 + random.random()  # noqa: S311 - jitter
                     log.warning(
-                        "registration failed", error=err.details() or str(err.code())
+                        "registry unreachable; backing off",
+                        error=detail, attempt=failures,
+                        retry_s=round(delay, 3),
                     )
+                    # Conservatively assume the lease may lapse during the
+                    # outage: re-register (idempotent) on recovery.
+                    registered = False
+                    if self._stop.wait(delay):
+                        return
+                    continue
                 if self._stop.wait(self.registry_delay):
                     return
 

@@ -45,7 +45,8 @@ def eval_feed_args(args):
     })
 
 
-def feeder_batches(args, cfg: TrainConfig, tls, start_batch: int = 0):
+def feeder_batches(args, cfg: TrainConfig, tls, start_batch: int = 0,
+                   feeder=None):
     """Batches from a feeder-published volume.
 
     Default (--feed-window-bytes > 0): a WINDOWED stream — only one window
@@ -54,15 +55,20 @@ def feeder_batches(args, cfg: TrainConfig, tls, start_batch: int = 0):
     the hot-path rule of SURVEY §3.5 applied to the feed. With
     --feed-window-bytes 0 the whole volume is materialized once and batches
     are views (config-3 style, fine for small volumes).
+
+    ``feeder`` shares one Feeder across rebuilds (SeekableFeed seeks):
+    its publish cache makes the volume's MapVolume a one-time cost —
+    a seek re-enters this generator but never re-issues the RPC chain.
     """
     from oim_tpu.feeder import Feeder
     from oim_tpu.spec import pb
 
-    feeder = Feeder(
-        registry_address=args.registry,
-        controller_id=args.controller_id,
-        tls=tls,
-    )
+    if feeder is None:
+        feeder = Feeder(
+            registry_address=args.registry,
+            controller_id=args.controller_id,
+            tls=tls,
+        )
     req = pb.MapVolumeRequest(volume_id=args.volume)
     if getattr(args, "volume_webdataset", ""):
         req.webdataset.shard_urls.extend(
@@ -218,20 +224,33 @@ class SeekableFeed:
     rebuilds the feed positioned at batch n, so a deep resume costs one
     repositioned rebuild (index arithmetic for cycle feeds) instead of
     O(start_step) replayed host decode (the Trainer falls back to
-    replaying ``next()`` for feeds without this hook)."""
+    replaying ``next()`` for feeds without this hook).
+
+    Construction and ``seek`` are both LAZY: the factory runs at the
+    first ``next()`` after them, so the resume sequence "build feed,
+    then seek(start_step)" never materializes the position-0 iterator
+    (publish RPCs, prefetch threads, decode-ahead) just to discard it.
+    Pair with ``feeder_batches(feeder=...)`` so repeated factory runs
+    share one Feeder and its publish cache."""
 
     def __init__(self, make, start: int = 0):
         self._make = make
-        self._it = iter(make(start))
+        self._start = start
+        self._it = None
 
     def __iter__(self):
         return self
 
     def __next__(self):
+        if self._it is None:
+            self._it = iter(self._make(self._start))
         return next(self._it)
 
     def seek(self, batch_index: int) -> None:
-        self._it = iter(self._make(batch_index))
+        # Drop any live iterator (and its prefetch lookahead) without
+        # building the replacement yet.
+        self._start = batch_index
+        self._it = None
 
 
 def _shuffle_seed(args) -> int | None:

@@ -26,10 +26,11 @@ from typing import Any, Mapping
 
 import grpc
 
+from oim_tpu.common import faultinject, metrics as M
 from oim_tpu.common.keymutex import KeyMutex
 from oim_tpu.common.logging import from_context
 from oim_tpu.common.meshcoord import MeshCoord
-from oim_tpu.common.pathutil import REGISTRY_MESH
+from oim_tpu.common.pathutil import REGISTRY_ADDRESS, REGISTRY_MESH
 from oim_tpu.common.tlsutil import TLSConfig, dial
 from oim_tpu.controller.controller import ControllerService
 from oim_tpu.feeder.emulation import map_volume_params
@@ -99,6 +100,19 @@ class Feeder:
         oim-driver.go:219-232)."""
         return dial(self.registry_address, self.tls, "component.registry")
 
+    def _fire_rpc_fault(self, method: str) -> None:
+        """Fault point for the remote data plane: an armed ``feeder.rpc``
+        presents as the controller answering UNAVAILABLE — the frozen/dead
+        controller scenario, injected deterministically."""
+        try:
+            faultinject.fire(
+                "feeder.rpc", controller_id=self.controller_id, method=method
+            )
+        except faultinject.InjectedFault as err:
+            raise PublishError(
+                f"UNAVAILABLE: injected {method} fault", code="UNAVAILABLE"
+            ) from err
+
     def _default_mesh(self, registry: RegistryStub) -> MeshCoord:
         reply = registry.GetValues(
             pb.GetValuesRequest(path=f"{self.controller_id}/{REGISTRY_MESH}"),
@@ -110,6 +124,73 @@ class Feeder:
             except ValueError:
                 pass
         return MeshCoord()
+
+    # -- failure recovery: re-resolve + failover ---------------------------
+
+    def _registry_entries(self, include_stale: bool = False) -> dict[str, str]:
+        channel = self._registry_channel()
+        try:
+            reply = RegistryStub(channel).GetValues(
+                pb.GetValuesRequest(path="", include_stale=include_stale),
+                timeout=10.0,
+            )
+            return {v.path: v.value for v in reply.values}
+        finally:
+            channel.close()
+
+    def _failover_target(self) -> str | None:
+        """A LIVE controller registered at the same mesh coordinate as the
+        (presumed dead) pinned one, or None.
+
+        The dead controller's coordinate comes from the stale registry
+        view — its lease has typically expired, which is exactly why we
+        are here — and candidates from the live view, so a controller
+        whose own lease lapsed is never elected. A controller with no
+        registered mesh coordinate has no provable replica, so no
+        failover (placing data at an unknown coordinate would be worse
+        than failing)."""
+        try:
+            live = self._registry_entries()
+            stale = self._registry_entries(include_stale=True)
+        except grpc.RpcError:
+            return None  # registry itself unreachable; the caller backs off
+        mesh_key = f"{self.controller_id}/{REGISTRY_MESH}"
+        if mesh_key not in stale:
+            return None
+        try:
+            coord = MeshCoord.parse(stale[mesh_key])
+        except ValueError:
+            return None
+        for path in sorted(live):
+            cid, _, key = path.partition("/")
+            if cid == self.controller_id or key != REGISTRY_MESH:
+                continue
+            try:
+                same = MeshCoord.parse(live[path]) == coord
+            except ValueError:
+                continue
+            if same and live.get(f"{cid}/{REGISTRY_ADDRESS}"):
+                return cid
+        return None
+
+    def _fail_over(self, volume_id: str, reason: str) -> bool:
+        """Re-target the feeder to a healthy replica of the pinned
+        controller's mesh coordinate. Returns False when none exists.
+        The switch alone suffices: per-RPC re-resolution (fresh proxy
+        dial per operation) picks up the new id, and a volume missing on
+        the replica restages through the NOT_FOUND heal path using
+        MapVolume's documented idempotency."""
+        target = self._failover_target()
+        if target is None:
+            return False
+        from_context().warning(
+            "failing over to replica controller",
+            volume=volume_id, dead=self.controller_id, target=target,
+            reason=reason,
+        )
+        M.FEEDER_FAILOVERS.inc()
+        self.controller_id = target
+        return True
 
     class _LocalContext:
         """Adapts grpc abort() to exceptions for in-process calls."""
@@ -145,7 +226,19 @@ class Feeder:
             if self.controller is not None:
                 published = self._publish_local(request, deadline)
             else:
-                published = self._publish_remote(request, deadline)
+                try:
+                    published = self._publish_remote(request, deadline)
+                except PublishError as err:
+                    # Retry-with-re-resolve: the pinned controller is
+                    # unreachable/expired — if a live replica serves the
+                    # same mesh coordinate, publish there instead
+                    # (MapVolume is idempotent, so a replica that already
+                    # holds the volume just returns its placement). No
+                    # replica -> the original fast failure stands.
+                    if err.code != "UNAVAILABLE" or not self._fail_over(
+                            request.volume_id, reason=str(err)):
+                        raise
+                    published = self._publish_remote(request, deadline)
             published.params_key = params_key
             published.request = request
             with self._lock:
@@ -202,6 +295,7 @@ class Feeder:
             # (nodeserver.go:230-251).
             stub = ControllerStub(channel)
             metadata = [(CONTROLLER_ID_META, self.controller_id)]
+            self._fire_rpc_fault("MapVolume")
             try:
                 reply = stub.MapVolume(
                     request,
@@ -237,7 +331,8 @@ class Feeder:
                 if err.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
                     raise DeadlineExceeded(err.details()) from err
                 raise PublishError(
-                    f"{err.code().name}: {err.details()}"
+                    f"{err.code().name}: {err.details()}",
+                    code=err.code().name,
                 ) from err
             # Merge returned coordinate with the registry default, exactly
             # CompletePCIAddress (nodeserver.go:253-273, pci.go:51-65).
@@ -327,6 +422,7 @@ class Feeder:
             return self._fetch_window_once(volume_id, offset, length, timeout)
         deadline = time.monotonic() + timeout
         delay = 0.2
+        just_failed_over = False
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -365,8 +461,21 @@ class Feeder:
                         # backing off toward the deadline.
                         with self._lock:
                             self._published.setdefault(volume_id, pub)
+                elif not just_failed_over and self._fail_over(
+                        volume_id, reason=str(err)):
+                    # UNAVAILABLE with a live replica at the same mesh
+                    # coordinate: re-target and retry immediately. The
+                    # replica answers NOT_FOUND if it never staged this
+                    # volume, which the branch above heals by re-publish
+                    # — restaging from source on the new controller.
+                    # Consecutive failovers pace through the backoff
+                    # below: two dead replicas pinned as each other's
+                    # candidates must not ping-pong in a busy loop.
+                    just_failed_over = True
+                    continue
                 time.sleep(min(delay, max(deadline - time.monotonic(), 0)))
                 delay = min(delay * 2, 5.0)
+                just_failed_over = False
 
     def _fetch_window_once(self, volume_id: str, offset: int, length: int,
                            timeout: float):
@@ -388,6 +497,7 @@ class Feeder:
             host = np.asarray(arr.reshape(-1)[e0:e1])
             raw = host.view(np.uint8)[offset - e0 * itemsize:end - e0 * itemsize]
             return raw, total, volume.spec
+        self._fire_rpc_fault("ReadVolume")
         channel = self._registry_channel()
         try:
             stub = ControllerStub(channel)

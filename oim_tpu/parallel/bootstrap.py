@@ -74,17 +74,40 @@ def wait_for_hosts(
     registry_stub, expected_hosts: int, timeout: float = 300.0,
     poll: float = 1.0,
 ) -> dict[str, str]:
-    """Poll GetValues("") until ``expected_hosts`` controllers registered."""
+    """Poll GetValues("") until ``expected_hosts`` controllers registered.
+
+    Under the health plane the default read is lease-filtered, so only
+    controllers with LIVE leases count toward assembly — a host that
+    registered and then died before the slice assembled can no longer
+    wedge ``jax.distributed.initialize`` with a stale address. Transient
+    registry unavailability (restart mid-bootstrap) is retried until the
+    deadline rather than aborting the whole slice."""
+    import grpc
+
     from oim_tpu.spec import pb
 
     deadline = time.monotonic() + timeout
+    n, last_err = 0, None
     while True:
-        reply = registry_stub.GetValues(pb.GetValuesRequest(path=""), timeout=10.0)
-        entries = {v.path: v.value for v in reply.values}
-        n = sum(1 for p in entries if p.endswith(f"/{REGISTRY_ADDRESS}"))
-        if n >= expected_hosts:
-            return entries
+        try:
+            reply = registry_stub.GetValues(
+                pb.GetValuesRequest(path=""), timeout=10.0)
+        except grpc.RpcError as err:
+            if err.code() != grpc.StatusCode.UNAVAILABLE:
+                raise
+            last_err = err  # registry restarting; soft state heals itself
+        else:
+            last_err = None
+            entries = {v.path: v.value for v in reply.values}
+            n = sum(1 for p in entries if p.endswith(f"/{REGISTRY_ADDRESS}"))
+            if n >= expected_hosts:
+                return entries
         if time.monotonic() > deadline:
+            if last_err is not None:
+                raise BootstrapError(
+                    f"registry unavailable through bootstrap timeout: "
+                    f"{last_err.details()}"
+                ) from last_err
             raise BootstrapError(
                 f"only {n}/{expected_hosts} hosts registered before timeout"
             )

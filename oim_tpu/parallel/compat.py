@@ -1,0 +1,31 @@
+"""JAX version compatibility shims.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+``jax`` namespace (and renamed ``check_rep`` to ``check_vma``) around
+jax 0.6. The parallel subsystem is written against the graduated API;
+this shim lets the same call sites run on images that ship the
+pre-graduation jax (0.4.x) where only the experimental module exists.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map
+
+    _LEGACY = False
+except ImportError:  # pre-graduation jax: experimental module, check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _LEGACY = True
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    if _LEGACY:
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=check_vma,
+    )

@@ -8,4 +8,5 @@ hosts.
 """
 
 from oim_tpu.registry.db import MemRegistryDB, RegistryDB  # noqa: F401
+from oim_tpu.registry.leases import LeaseTable  # noqa: F401
 from oim_tpu.registry.registry import RegistryService, registry_server  # noqa: F401

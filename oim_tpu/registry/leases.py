@@ -1,0 +1,115 @@
+"""Registry lease table: TTLs over the soft-state KV store.
+
+The reference's registry trusts a controller's one-time registration
+forever (pkg/oim-controller registration loop, SURVEY §L3'): a dead
+controller leaves a stale ``<id>/address`` that the transparent proxy
+happily dials. The lease table is the etcd-TTL / GFS-chunkserver-
+heartbeat layer on top of the same KV store: an entry written with
+``lease_seconds > 0`` is *live* only until its deadline, renewed by
+controller heartbeats; expired entries are hidden from ``GetValues``
+(opt-in ``include_stale`` keeps them inspectable for debugging) and the
+proxy fast-fails instead of dialing a dead address.
+
+Time is ``time.monotonic`` — wall-clock jumps (NTP steps) must not mass-
+expire a healthy fleet. The table never deletes from the backing DB: the
+DB stays the record of last-known state, the lease table is the liveness
+overlay (both soft state, rebuilt by the heartbeat loop after a registry
+restart).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+
+class Lease:
+    __slots__ = ("deadline", "ttl", "expiry_counted")
+
+    def __init__(self, deadline: float, ttl: float):
+        self.deadline = deadline
+        self.ttl = ttl
+        # Expiry is COUNTED (metrics) at most once per live->expired
+        # transition, at the first read that observes it stale.
+        self.expiry_counted = False
+
+
+class LeaseTable:
+    """Per-path leases on a monotonic clock.
+
+    Paths without a lease are permanent (the pre-lease contract — admin
+    keys, tests). ``clock`` is injectable so tests expire leases without
+    sleeping.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._leases: dict[str, Lease] = {}
+        self._lock = threading.Lock()
+
+    def grant(self, path: str, ttl_seconds: float) -> None:
+        """Attach (or refresh) a lease. ttl <= 0 removes any lease,
+        making the entry permanent."""
+        with self._lock:
+            if ttl_seconds <= 0:
+                self._leases.pop(path, None)
+                return
+            self._leases[path] = Lease(
+                self._clock() + ttl_seconds, ttl_seconds)
+
+    def drop(self, path: str) -> None:
+        """Forget the lease (entry deleted from the DB)."""
+        with self._lock:
+            self._leases.pop(path, None)
+
+    def renew(self, prefix: str, ttl_seconds: float = 0.0) -> int:
+        """Extend every lease on ``prefix`` or nested under it
+        (component-wise, matching the DB's prefix semantics). ttl 0 keeps
+        each lease's granted TTL. Returns the number of leases renewed —
+        an expired-but-unswept lease renews too (the controller came back
+        within the stale-visibility window; its entry simply goes live
+        again, same as a re-register)."""
+        parts = prefix.split("/")
+        now = self._clock()
+        renewed = 0
+        with self._lock:
+            for path, lease in self._leases.items():
+                if path.split("/")[: len(parts)] != parts:
+                    continue
+                ttl = ttl_seconds if ttl_seconds > 0 else lease.ttl
+                lease.deadline = now + ttl
+                lease.ttl = ttl
+                lease.expiry_counted = False
+                renewed += 1
+        return renewed
+
+    def alive(self, path: str) -> bool:
+        """True when the path has no lease or an unexpired one."""
+        return self.expired_for(path) is None
+
+    def expired_for(self, path: str) -> float | None:
+        """Seconds since expiry, or None when live/permanent. Counts the
+        live->expired transition exactly once (LEASE_EXPIRIES)."""
+        with self._lock:
+            lease = self._leases.get(path)
+            if lease is None:
+                return None
+            overdue = self._clock() - lease.deadline
+            if overdue <= 0:
+                return None
+            if not lease.expiry_counted:
+                lease.expiry_counted = True
+                from oim_tpu.common import metrics as M
+
+                M.LEASE_EXPIRIES.inc()
+            return overdue
+
+    def remaining(self, path: str) -> float | None:
+        """Seconds until expiry; None for permanent entries. Negative
+        when already expired (how stale the entry is)."""
+        with self._lock:
+            lease = self._leases.get(path)
+            if lease is None:
+                return None
+            return lease.deadline - self._clock()

@@ -23,6 +23,7 @@ from typing import Callable
 
 import grpc
 
+from oim_tpu.common import faultinject, metrics as M
 from oim_tpu.common.logging import from_context
 from oim_tpu.common.pathutil import (
     REGISTRY_ADDRESS,
@@ -33,6 +34,7 @@ from oim_tpu.common.server import NonBlockingGRPCServer
 from oim_tpu.common.interceptors import LogServerInterceptor
 from oim_tpu.common.tlsutil import TLSConfig, dial, peer_common_name
 from oim_tpu.registry.db import MemRegistryDB, RegistryDB, get_registry_entries
+from oim_tpu.registry.leases import LeaseTable
 from oim_tpu.spec import (
     REGISTRY_SERVICE,
     RegistryServicer,
@@ -44,9 +46,31 @@ CONTROLLER_ID_META = "controllerid"
 
 
 class RegistryService(RegistryServicer):
-    def __init__(self, db: RegistryDB | None = None, tls: TLSConfig | None = None):
+    def __init__(
+        self,
+        db: RegistryDB | None = None,
+        tls: TLSConfig | None = None,
+        leases: LeaseTable | None = None,
+        boot_grace_seconds: float = 0.0,
+    ):
         self.db: RegistryDB = db if db is not None else MemRegistryDB()
         self.tls = tls
+        # The liveness overlay (registry/leases.py): entries written with
+        # lease_seconds stay visible only while heartbeats renew them.
+        self.leases = leases if leases is not None else LeaseTable()
+        if boot_grace_seconds > 0:
+            # A pre-populated DB (FileRegistryDB journal replay) carries no
+            # lease state — monotonic deadlines cannot survive a restart.
+            # Grace-lease every replayed controller key: live controllers
+            # renew (or re-register) within one heartbeat; dead ones expire
+            # after the grace instead of being resurrected as permanent —
+            # the exact stale-registration wedge the lease plane removes.
+            # Admin keys under other layouts stay permanent.
+            for path in get_registry_entries(self.db, ""):
+                parts = path.split("/")
+                if len(parts) == 2 and parts[1] in (REGISTRY_ADDRESS,
+                                                    REGISTRY_MESH):
+                    self.leases.grant(path, boot_grace_seconds)
 
     # -- authorization ----------------------------------------------------
 
@@ -87,11 +111,21 @@ class RegistryService(RegistryServicer):
                 f"{peer!r} may not set {request.value.path!r}",
             )
         self.db.set(request.value.path, request.value.value)
+        if request.value.value == "":
+            # Deleted entries carry no lease; a later permanent re-write
+            # must not inherit a stale deadline.
+            self.leases.drop(request.value.path)
+        else:
+            # lease_seconds > 0 grants/refreshes; 0 (proto default) writes
+            # a permanent entry — the pre-lease behavior and the admin
+            # override path (oimctl --set pins a key past lease filtering).
+            self.leases.grant(request.value.path, request.value.lease_seconds)
         return pb.SetValueReply()
 
     def GetValues(self, request, context):
         # Reads need any authenticated identity; prefix-match semantics
-        # (registry.go:129-144).
+        # (registry.go:129-144). Lease-expired entries are invisible unless
+        # the caller opts into stale reads (oimctl debugging).
         self._peer(context)
         if request.path:
             try:
@@ -100,8 +134,44 @@ class RegistryService(RegistryServicer):
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(err))
         entries = get_registry_entries(self.db, request.path)
         return pb.GetValuesReply(
-            values=[pb.Value(path=k, value=v) for k, v in sorted(entries.items())]
+            values=[
+                pb.Value(path=k, value=v)
+                for k, v in sorted(entries.items())
+                if request.include_stale or self.leases.alive(k)
+            ]
         )
+
+    def Heartbeat(self, request, context):
+        """Renew the leases on every ``<controller_id>/...`` key (the
+        etcd-KeepAlive analog). Authorization mirrors SetValue: a
+        controller may heartbeat only itself."""
+        peer = self._peer(context)
+        if not request.controller_id:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "empty controller_id")
+        try:
+            parts = split_registry_path(request.controller_id)
+        except ValueError as err:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(err))
+        if len(parts) != 1:
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"controller_id {request.controller_id!r} is a path, not an id",
+            )
+        if not (peer == "user.admin"
+                or peer == f"controller.{request.controller_id}"):
+            context.abort(
+                grpc.StatusCode.PERMISSION_DENIED,
+                f"{peer!r} may not heartbeat {request.controller_id!r}",
+            )
+        renewed = self.leases.renew(request.controller_id, request.lease_seconds)
+        # known == False tells the controller to re-register in full. Two
+        # causes: the registry has no address for it (restart, lost soft
+        # state), or the address exists WITHOUT a lease to renew (journal
+        # replay after a --db-file restart) — re-registering re-grants the
+        # lease from the controller, the source of truth for its TTL.
+        known = renewed > 0 and bool(
+            self.db.get(f"{request.controller_id}/{REGISTRY_ADDRESS}"))
+        return pb.HeartbeatReply(known=known)
 
 
 _IDENTITY = lambda b: b  # noqa: E731 - bytes pass-through serdes for proxying
@@ -163,11 +233,31 @@ class TransparentProxy(grpc.GenericRpcHandler):
                     grpc.StatusCode.PERMISSION_DENIED,
                     f"{peer!r} may not access controller {controller_id!r}",
                 )
-        address = self._service.db.get(f"{controller_id}/{REGISTRY_ADDRESS}")
+        address_key = f"{controller_id}/{REGISTRY_ADDRESS}"
+        address = self._service.db.get(address_key)
         if not address:
             context.abort(
                 grpc.StatusCode.UNAVAILABLE,
                 f"no address registered for controller {controller_id!r}",
+            )
+        overdue = self._service.leases.expired_for(address_key)
+        if overdue is not None:
+            # Fast-fail instead of dialing a dead address and hanging the
+            # caller until its deadline (health plane; cf. etcd lease TTLs).
+            M.PROXY_FASTFAILS.inc()
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                f"controller lease expired: {controller_id!r} last renewed "
+                f"{overdue:.1f}s past its lease",
+            )
+        try:
+            faultinject.fire("proxy.dial", controller_id=controller_id,
+                             address=address)
+        except faultinject.InjectedFault:
+            # An armed dial fault presents exactly as a dead controller.
+            context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                f"injected dial failure for controller {controller_id!r}",
             )
         log.debug("proxying", method=method, controller=controller_id, address=address)
         # Per-call dialing with pinned far-end identity (registry.go:191-210).

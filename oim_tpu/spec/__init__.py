@@ -2,9 +2,10 @@
 
 The .proto is extracted from the repo-root ``spec.md`` (the single source of truth,
 mirroring the reference's spec-as-markdown discipline, /root/reference/Makefile:78-103)
-by ``scripts/gen_proto.py``. Service stubs/servicers are hand-written in
-``services.py`` because the image ships ``protoc`` without the grpc python plugin —
-they are the same thin wrappers grpc_tools would emit.
+by ``scripts/gen_proto.py``, which compiles it with its own deterministic
+descriptor compiler (``make proto``; protoc is not required and not used). Service
+stubs/servicers are hand-written in ``services.py`` because no grpc python plugin
+is available — they are the same thin wrappers grpc_tools would emit.
 """
 
 from oim_tpu.spec import oim_pb2 as pb  # noqa: F401

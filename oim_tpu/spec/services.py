@@ -21,6 +21,7 @@ FEEDER_SERVICE = "oim.v1.Feeder"
 REGISTRY_METHODS = {
     "SetValue": (pb.SetValueRequest, pb.SetValueReply),
     "GetValues": (pb.GetValuesRequest, pb.GetValuesReply),
+    "Heartbeat": (pb.HeartbeatRequest, pb.HeartbeatReply),
 }
 
 CONTROLLER_METHODS = {
@@ -112,6 +113,9 @@ class RegistryServicer:
 
     def GetValues(self, request, context):
         context.abort(grpc.StatusCode.UNIMPLEMENTED, "GetValues not implemented")
+
+    def Heartbeat(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "Heartbeat not implemented")
 
 
 class ControllerServicer:

@@ -423,6 +423,17 @@ def make_train_step(
     return jitted, state_shardings, init_fn, eval_fn
 
 
+def _norm_spec(spec, ndim: int):
+    """PartitionSpec -> rank-padded tuple-of-tuples for EQUIVALENCE
+    comparison: P('data'), P('data', None) and P(('data',), None) all
+    shard identically at a given rank but compare unequal as objects."""
+    parts = tuple(spec) + (None,) * (ndim - len(tuple(spec)))
+    return tuple(
+        () if p is None else tuple(p) if isinstance(p, (tuple, list)) else (p,)
+        for p in parts
+    )
+
+
 def synthetic_batches(cfg: TrainConfig) -> Iterator[dict]:
     """Deterministic host-side batches for smoke runs and benchmarks."""
     rng = np.random.RandomState(cfg.seed)
@@ -481,6 +492,7 @@ class Trainer:
          self.eval_fn) = make_train_step(cfg, mesh, self.tx)
         self.state: TrainState | None = None
         self.last_eval_stats: dict[str, float] = {}
+        self._sharding_warned: set[str] = set()
         self.checkpointer = None
         if cfg.checkpoint_dir:
             from oim_tpu.train.checkpoint import Checkpointer
@@ -511,6 +523,10 @@ class Trainer:
         from jax.sharding import NamedSharding
 
         for k, v in batch.items():
+            axes = (BATCH,) + (None,) * (np.ndim(v) - 1)
+            if k == "tokens":
+                axes = (BATCH, None)  # seq dim of the (T+1) batch stays host-split
+            sharding = logical_sharding(self.mesh, rules, axes)
             if (isinstance(v, jax.Array)
                     and isinstance(v.sharding, NamedSharding)
                     and v.sharding.mesh == self.mesh):
@@ -521,12 +537,36 @@ class Trainer:
                 # is impossible for a multi-host global array anyway).
                 # Anything else (host arrays, stray single-device
                 # device_puts) still goes through normal placement.
-                out[k] = v
+                # Trust is VERIFIED, not assumed: a feed sharded
+                # differently from the step's expected (BATCH, None, ...)
+                # spec would force XLA to insert a silent (and on the
+                # wrong axis, wrong-result-free but slow) reshard every
+                # step — or worse, feed a batch-split step replicated
+                # data. Warn and reshard here, once, visibly.
+                if _norm_spec(v.sharding.spec, np.ndim(v)) == _norm_spec(
+                        sharding.spec, np.ndim(v)):
+                    out[k] = v
+                    continue
+                # Warn ONCE per key: place_batch is per-step hot-loop
+                # code — a persistently mis-sharded feed must not flood
+                # the log at steps/sec rate (the reshard below still
+                # runs every step; that cost is the bug being flagged).
+                if k not in self._sharding_warned:
+                    self._sharding_warned.add(k)
+                    from_context().warning(
+                        "device-resident feed sharding mismatch"
+                        + ("" if multihost else "; resharding every step"),
+                        key=k, got=str(v.sharding.spec),
+                        want=str(sharding.spec),
+                    )
+                if multihost:
+                    # A cross-process reshard of a global array would
+                    # need collectives this loop doesn't own; let jit's
+                    # in_shardings handle it.
+                    out[k] = v
+                else:
+                    out[k] = jax.device_put(v, sharding)
                 continue
-            axes = (BATCH,) + (None,) * (np.ndim(v) - 1)
-            if k == "tokens":
-                axes = (BATCH, None)  # seq dim of the (T+1) batch stays host-split
-            sharding = logical_sharding(self.mesh, rules, axes)
             if multihost:
                 # The mesh spans processes: each host holds the GLOBAL batch
                 # (every feed is deterministic per volume) and contributes

@@ -177,6 +177,21 @@ class TestSpecDrift:
 
         assert gen.main(check=True) == 0
 
+    def test_pb2_matches_proto(self):
+        """The committed oim_pb2.py descriptor must be exactly what the
+        builtin compiler produces from the committed oim.proto — the
+        generated-code half of the drift gate (`make proto` keeps both in
+        lockstep). Serialized-descriptor equality also pins the builtin
+        compiler to protoc's byte-for-byte output format."""
+        import scripts.gen_proto as gen
+        from oim_tpu.spec import pb
+
+        compiled = gen.compile_proto(gen.PROTO.read_text())
+        assert pb.DESCRIPTOR.serialized_pb == compiled.SerializeToString(), (
+            "oim_pb2.py drifted from oim.proto; run scripts/gen_proto.py "
+            "(or `make proto`)"
+        )
+
 
 class TestProfiling:
     def test_profile_trace_writes_a_trace(self, tmp_path):

@@ -195,7 +195,9 @@ class TestRegistrationLoop:
         controller.start()
         try:
             assert wait_for(lambda: service.db.get("host-0/address") == "tcp://c0:1234")
-            assert service.db.get("host-0/mesh") == "1,2,3"
+            # address and mesh are two separate SetValue RPCs: wait for
+            # the second too instead of racing the window between them.
+            assert wait_for(lambda: service.db.get("host-0/mesh") == "1,2,3")
             # Soft-state recovery: delete the entry, it must come back
             # (controller_test.go:107-127, README.md:138-143).
             service.db.set("host-0/address", "")

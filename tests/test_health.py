@@ -1,0 +1,672 @@
+"""Health-plane tests: registry leases, controller heartbeats, proxy
+fast-fail, and feeder failover.
+
+The lease/heartbeat layer is what every production control plane builds
+on its KV store (etcd TTL leases, GFS chunkserver heartbeats); the
+reference has none (controllers self-register once and are trusted
+forever, SURVEY §L3'). Ring 0: everything here runs in-process on the
+CPU mesh, with deterministic fault injection (common/faultinject.py) and
+an injectable lease clock — no sleeps against real TTLs except the
+2-controller acceptance test, whose TTLs are real-but-short by design
+(the acceptance criterion is wall-clock convergence within one TTL).
+"""
+
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from oim_tpu.common import faultinject, metrics as M
+from oim_tpu.controller import Controller, ControllerService, MallocBackend
+from oim_tpu.controller.controller import controller_server
+from oim_tpu.feeder import Feeder
+from oim_tpu.feeder.driver import PublishError
+from oim_tpu.registry import MemRegistryDB, RegistryService
+from oim_tpu.registry.leases import LeaseTable
+from oim_tpu.registry.registry import CONTROLLER_ID_META, registry_server
+from oim_tpu.spec import ControllerStub, RegistryStub, pb
+
+
+def wait_for(predicate, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+class TestLeaseTable:
+    def test_permanent_without_lease(self):
+        t = LeaseTable(clock=FakeClock())
+        assert t.alive("a/b")
+        assert t.remaining("a/b") is None
+
+    def test_grant_expire_renew(self):
+        clock = FakeClock()
+        t = LeaseTable(clock=clock)
+        t.grant("h/address", 5.0)
+        assert t.alive("h/address")
+        clock.now = 4.9
+        assert t.alive("h/address")
+        clock.now = 5.1
+        assert not t.alive("h/address")
+        assert t.expired_for("h/address") == pytest.approx(0.1)
+        # Renewal revives an expired-but-unswept lease (controller came
+        # back inside the stale window — same as a re-register).
+        assert t.renew("h") == 1
+        assert t.alive("h/address")
+        assert t.remaining("h/address") == pytest.approx(5.0)
+
+    def test_renew_is_component_prefix_scoped(self):
+        clock = FakeClock()
+        t = LeaseTable(clock=clock)
+        t.grant("host-0/address", 1.0)
+        t.grant("host-0/mesh", 1.0)
+        t.grant("host-10/address", 1.0)
+        clock.now = 0.5
+        assert t.renew("host-0") == 2  # host-10 must NOT match host-0
+        assert t.remaining("host-0/address") == pytest.approx(1.0)
+        assert t.remaining("host-10/address") == pytest.approx(0.5)
+
+    def test_grant_zero_removes_lease(self):
+        clock = FakeClock()
+        t = LeaseTable(clock=clock)
+        t.grant("a/b", 1.0)
+        t.grant("a/b", 0.0)  # back to permanent
+        clock.now = 100.0
+        assert t.alive("a/b")
+
+    def test_renew_custom_ttl_sticks(self):
+        clock = FakeClock()
+        t = LeaseTable(clock=clock)
+        t.grant("a/b", 1.0)
+        t.renew("a", 10.0)
+        clock.now = 5.0
+        assert t.alive("a/b")
+        # The new TTL becomes the granted TTL for later 0-TTL renewals.
+        t.renew("a")
+        assert t.remaining("a/b") == pytest.approx(10.0)
+
+    def test_expiry_counted_once(self):
+        clock = FakeClock()
+        t = LeaseTable(clock=clock)
+        t.grant("a/b", 1.0)
+        clock.now = 2.0
+        before = M.LEASE_EXPIRIES.value
+        assert not t.alive("a/b")
+        assert not t.alive("a/b")  # second read: no double count
+        assert M.LEASE_EXPIRIES.value == before + 1
+
+
+@pytest.fixture
+def leased_registry():
+    """Insecure registry with an injectable lease clock."""
+    clock = FakeClock()
+    db = MemRegistryDB()
+    service = RegistryService(db=db, leases=LeaseTable(clock=clock))
+    server = registry_server("tcp://localhost:0", service)
+    channel = grpc.insecure_channel(server.addr)
+    yield clock, db, service, RegistryStub(channel)
+    channel.close()
+    server.force_stop()
+
+
+class TestRegistryLeases:
+    def test_expiry_hides_entries_from_getvalues(self, leased_registry):
+        clock, _, _, stub = leased_registry
+        stub.SetValue(pb.SetValueRequest(value=pb.Value(
+            path="host-0/address", value="a:1", lease_seconds=5)))
+        stub.SetValue(pb.SetValueRequest(value=pb.Value(
+            path="admin/pin", value="x")))  # permanent (no lease)
+        paths = lambda **kw: [  # noqa: E731
+            v.path for v in stub.GetValues(
+                pb.GetValuesRequest(path="", **kw)).values]
+        assert paths() == ["admin/pin", "host-0/address"]
+        clock.now = 6.0
+        assert paths() == ["admin/pin"]
+        # The stale view keeps the dead controller's last-known state
+        # inspectable (oimctl --stale / --health).
+        assert paths(include_stale=True) == ["admin/pin", "host-0/address"]
+
+    def test_heartbeat_renews_and_reports_known(self, leased_registry):
+        clock, _, _, stub = leased_registry
+        stub.SetValue(pb.SetValueRequest(value=pb.Value(
+            path="host-0/address", value="a:1", lease_seconds=5)))
+        clock.now = 4.0
+        assert stub.Heartbeat(
+            pb.HeartbeatRequest(controller_id="host-0")).known
+        clock.now = 8.0  # original lease would be dead; renewal carried it
+        assert [v.path for v in stub.GetValues(
+            pb.GetValuesRequest(path="")).values] == ["host-0/address"]
+        # Unknown controller: heartbeat says so (triggers re-register).
+        assert not stub.Heartbeat(
+            pb.HeartbeatRequest(controller_id="ghost")).known
+
+    def test_heartbeat_validates_id(self, leased_registry):
+        _, _, _, stub = leased_registry
+        for bad in ("", "a/b", ".."):
+            with pytest.raises(grpc.RpcError) as err:
+                stub.Heartbeat(pb.HeartbeatRequest(controller_id=bad))
+            assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    def test_heartbeat_authorization(self):
+        """controller.<id> may heartbeat only itself (SetValue's trust
+        boundary). Exercised at the servicer layer: the mTLS handshake
+        matrix is test_registry's job; here only the CN decision is new."""
+        service = RegistryService(db=MemRegistryDB())
+        service._peer = lambda context: "controller.host-1"
+
+        class Ctx:
+            def abort(self, code, details):
+                raise PermissionError(f"{code}: {details}")
+
+        with pytest.raises(PermissionError):
+            service.Heartbeat(
+                pb.HeartbeatRequest(controller_id="host-0"), Ctx())
+        service.Heartbeat(pb.HeartbeatRequest(controller_id="host-1"), Ctx())
+
+    def test_journal_replay_gets_boot_grace_not_immortality(self, tmp_path):
+        """A --db-file registry restart replays addresses with NO lease
+        state (monotonic deadlines cannot persist). boot_grace_seconds
+        leases every replayed controller key: live controllers renew
+        within one heartbeat; dead ones expire after the grace instead
+        of being resurrected as permanent stale registrations."""
+        from oim_tpu.registry.db import FileRegistryDB
+
+        path = str(tmp_path / "reg.journal")
+        db1 = FileRegistryDB(path)
+        db1.set("host-0/address", "a:1")  # dead controller's last state
+        db1.set("host-1/address", "b:1")  # live controller
+        db1.set("admin/pin", "x")  # non-controller layout: stays permanent
+        db1.close()
+
+        clock = FakeClock()
+        service = RegistryService(
+            db=FileRegistryDB(path), leases=LeaseTable(clock=clock),
+            boot_grace_seconds=5.0)
+        server = registry_server("tcp://localhost:0", service)
+        try:
+            with grpc.insecure_channel(server.addr) as ch:
+                stub = RegistryStub(ch)
+                paths = lambda: [  # noqa: E731
+                    v.path for v in stub.GetValues(
+                        pb.GetValuesRequest(path="")).values]
+                assert paths() == [
+                    "admin/pin", "host-0/address", "host-1/address"]
+                clock.now = 4.0
+                assert stub.Heartbeat(pb.HeartbeatRequest(
+                    controller_id="host-1")).known  # renews the grace lease
+                clock.now = 6.0  # past the grace; host-1 renewed at t=4
+                assert paths() == ["admin/pin", "host-1/address"]
+        finally:
+            server.force_stop()
+
+    def test_heartbeat_without_lease_demands_reregistration(
+            self, leased_registry):
+        """An address in the DB but NO lease to renew (journal replay
+        with grace disabled): known=False so the controller re-registers
+        and re-grants its lease — the lease plane must not silently
+        disable after a restart."""
+        _, db, _, stub = leased_registry
+        db.set("host-0/address", "a:1")  # direct write: no lease
+        assert not stub.Heartbeat(
+            pb.HeartbeatRequest(controller_id="host-0")).known
+        # The re-register (SetValue with lease) restores known=True.
+        stub.SetValue(pb.SetValueRequest(value=pb.Value(
+            path="host-0/address", value="a:1", lease_seconds=5)))
+        assert stub.Heartbeat(
+            pb.HeartbeatRequest(controller_id="host-0")).known
+
+    def test_delete_drops_lease(self, leased_registry):
+        clock, _, service, stub = leased_registry
+        stub.SetValue(pb.SetValueRequest(value=pb.Value(
+            path="host-0/address", value="a:1", lease_seconds=5)))
+        stub.SetValue(pb.SetValueRequest(value=pb.Value(
+            path="host-0/address", value="")))  # delete
+        stub.SetValue(pb.SetValueRequest(value=pb.Value(
+            path="host-0/address", value="b:1")))  # re-created permanent
+        clock.now = 100.0
+        assert service.leases.alive("host-0/address")
+
+
+class TestProxyFastFail:
+    def test_expired_lease_fast_fails_without_dialing(self):
+        clock = FakeClock()
+        db = MemRegistryDB()
+        service = RegistryService(db=db, leases=LeaseTable(clock=clock))
+        dialed = []
+
+        def recording_dial(address, peer_name):
+            dialed.append(address)
+            return grpc.insecure_channel(address)
+
+        server = registry_server("tcp://localhost:0", service,
+                                 dial=recording_dial)
+        mock = ControllerService(MallocBackend())
+        controller = controller_server("tcp://localhost:0", mock)
+        try:
+            db.set("host-0/address", controller.addr)
+            service.leases.grant("host-0/address", 5.0)
+            with grpc.insecure_channel(server.addr) as ch:
+                stub = ControllerStub(ch)
+                meta = [(CONTROLLER_ID_META, "host-0")]
+                mock.backend.provision("v", 64)
+                stub.MapVolume(pb.MapVolumeRequest(
+                    volume_id="v", malloc=pb.MallocParams()),
+                    metadata=meta, timeout=10)
+                assert dialed  # live lease: proxied normally
+                dialed.clear()
+                clock.now = 6.0
+                before = M.PROXY_FASTFAILS.value
+                with pytest.raises(grpc.RpcError) as err:
+                    stub.MapVolume(pb.MapVolumeRequest(
+                        volume_id="v", malloc=pb.MallocParams()),
+                        metadata=meta, timeout=10)
+                assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+                assert "lease expired" in err.value.details()
+                assert not dialed  # fast-fail: the dead address never dialed
+                assert M.PROXY_FASTFAILS.value == before + 1
+        finally:
+            controller.force_stop()
+            server.force_stop()
+
+    def test_injected_dial_fault_presents_unavailable(self):
+        db = MemRegistryDB()
+        service = RegistryService(db=db)
+        server = registry_server("tcp://localhost:0", service)
+        try:
+            db.set("host-0/address", "localhost:1")
+            faultinject.arm("proxy.dial", controller_id="host-0")
+            with grpc.insecure_channel(server.addr) as ch:
+                with pytest.raises(grpc.RpcError) as err:
+                    ControllerStub(ch).MapVolume(
+                        pb.MapVolumeRequest(volume_id="v"),
+                        metadata=[(CONTROLLER_ID_META, "host-0")], timeout=5)
+                assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+                assert "injected" in err.value.details()
+        finally:
+            server.force_stop()
+
+
+class TestHeartbeatLoop:
+    @pytest.fixture
+    def registry(self):
+        service = RegistryService(db=MemRegistryDB())
+        server = registry_server("tcp://localhost:0", service)
+        yield server, service
+        server.force_stop()
+
+    def make_controller(self, server, delay=0.05):
+        return Controller(
+            controller_id="host-0",
+            backend=MallocBackend(),
+            controller_address="tcp://c0:1234",
+            registry_address=server.addr,
+            registry_delay=delay,
+            mesh_coord=None,
+        )
+
+    def test_registration_carries_lease(self, registry):
+        server, service = registry
+        controller = self.make_controller(server)
+        assert controller.lease_seconds == pytest.approx(0.125)  # 2.5x
+        controller.start()
+        try:
+            assert wait_for(
+                lambda: service.db.get("host-0/address") == "tcp://c0:1234")
+            assert service.leases.remaining("host-0/address") is not None
+        finally:
+            controller.stop()
+
+    def test_heartbeats_keep_lease_alive(self, registry):
+        """With heartbeats flowing, the entry stays visible well past its
+        TTL — the lease is being renewed, not re-granted by re-register."""
+        server, service = registry
+        controller = self.make_controller(server)
+        controller.start()
+        try:
+            assert wait_for(lambda: bool(service.db.get("host-0/address")))
+            time.sleep(controller.lease_seconds * 4)
+            # wait_for (not a bare assert): on a loaded CI box the
+            # heartbeat thread can stall past one TTL — renewal then
+            # revives the lease, which is the property under test.
+            assert wait_for(
+                lambda: service.leases.alive("host-0/address"), timeout=2.0)
+        finally:
+            controller.stop()
+
+    def test_reregisters_after_registry_outage(self, registry):
+        """Drop N heartbeats (simulated registry outage): the loop backs
+        off, then recovers and RE-REGISTERS in full (conservative: the
+        lease may have lapsed mid-outage)."""
+        server, service = registry
+        controller = self.make_controller(server)
+        controller.start()
+        try:
+            assert wait_for(lambda: bool(service.db.get("host-0/address")))
+            # Outage: both heartbeat and register attempts fail for a while.
+            faultinject.arm("controller.heartbeat", times=3)
+            faultinject.arm("controller.register", times=3)
+            assert wait_for(lambda: faultinject.fired("controller.heartbeat")
+                            + faultinject.fired("controller.register") >= 3)
+            # Wipe the registry mid-outage (restart with empty soft state).
+            service.db.set("host-0/address", "")
+            service.leases.drop("host-0/address")
+            # Recovery: the loop must re-register without intervention.
+            assert wait_for(
+                lambda: service.db.get("host-0/address") == "tcp://c0:1234")
+            assert service.leases.remaining("host-0/address") is not None
+        finally:
+            controller.stop()
+
+    def test_lease_loss_triggers_immediate_reregister(self, registry):
+        """known=False from a heartbeat (registry restarted between two
+        heartbeats) re-registers on the spot, not one interval later."""
+        server, service = registry
+        controller = self.make_controller(server, delay=0.05)
+        controller.start()
+        try:
+            assert wait_for(lambda: bool(service.db.get("host-0/address")))
+            service.db.set("host-0/address", "")  # registry forgot us
+            assert wait_for(
+                lambda: service.db.get("host-0/address") == "tcp://c0:1234")
+        finally:
+            controller.stop()
+
+    def test_degrades_against_pre_lease_registry(self):
+        """A registry without the Heartbeat RPC: the controller falls back
+        to the reference's plain re-register-every-delay loop."""
+        from oim_tpu.spec import RegistryServicer
+
+        class OldRegistry(RegistryServicer):
+            tls = None  # registry_server reads service.tls
+
+            def __init__(self):
+                self.values = {}
+
+            def SetValue(self, request, context):
+                self.values[request.value.path] = request.value.value
+                return pb.SetValueReply()
+
+            # GetValues unimplemented too: register_once never calls it.
+
+        old = OldRegistry()
+        server = registry_server("tcp://localhost:0", old)
+        controller = Controller(
+            controller_id="host-0", backend=MallocBackend(),
+            controller_address="a:1", registry_address=server.addr,
+            registry_delay=0.05,
+        )
+        controller.start()
+        try:
+            assert wait_for(lambda: old.values.get("host-0/address") == "a:1")
+            # Soft-state recovery still works through the fallback path.
+            old.values.clear()
+            assert wait_for(lambda: old.values.get("host-0/address") == "a:1")
+        finally:
+            controller.stop()
+            server.force_stop()
+
+
+class TestFeederFailover:
+    """The acceptance scenario: a 2-controller in-process cluster serving
+    the same mesh coordinate; killing one mid-stream must (a) fail
+    Feeder.fetch_window over to the survivor without intervention and
+    (b) drop the dead controller out of GetValues within one lease TTL."""
+
+    def _cluster(self):
+        db = MemRegistryDB()
+        registry = registry_server("tcp://localhost:0",
+                                   RegistryService(db=db))
+        svcs, servers = [], []
+        for _ in range(2):
+            svc = ControllerService(MallocBackend())
+            svcs.append(svc)
+            servers.append(controller_server("tcp://localhost:0", svc))
+        return db, registry, svcs, servers
+
+    def test_killed_controller_mid_stream_fails_over(self, tmp_path):
+        # Real heartbeat loops with short real TTLs: host-0 and host-1
+        # both serve mesh coordinate 1,2,3 (replicas).
+        from oim_tpu.common.meshcoord import MeshCoord
+
+        db = MemRegistryDB()
+        registry = registry_server("tcp://localhost:0",
+                                   RegistryService(db=db))
+        controllers = [
+            Controller(
+                controller_id=f"host-{i}", backend=MallocBackend(),
+                controller_address="pending",
+                registry_address=registry.addr,
+                registry_delay=0.1,  # lease TTL = 0.25s
+                mesh_coord=MeshCoord.parse("1,2,3"),
+            )
+            for i in range(2)
+        ]
+        svcs = [c.service for c in controllers]
+        servers = [
+            controller_server("tcp://localhost:0", svc) for svc in svcs
+        ]
+        for c, s in zip(controllers, servers):
+            c.controller_address = s.addr
+        try:
+            for c in controllers:
+                c.start()
+            with grpc.insecure_channel(registry.addr) as ch:
+                stub = RegistryStub(ch)
+
+                def live_controllers():
+                    return sorted(
+                        v.path.split("/")[0]
+                        for v in stub.GetValues(
+                            pb.GetValuesRequest(path="")).values
+                        if v.path.endswith("/address")
+                    )
+
+                assert wait_for(
+                    lambda: live_controllers() == ["host-0", "host-1"])
+
+                data = np.random.RandomState(7).bytes(60_000)
+                path = tmp_path / "vol.bin"
+                path.write_bytes(data)
+                feeder = Feeder(registry_address=registry.addr,
+                                controller_id="host-0")
+                feeder.publish(pb.MapVolumeRequest(
+                    volume_id="vol-f",
+                    file=pb.FileParams(path=str(path), format="raw"),
+                ))
+                w, total, _ = feeder.fetch_window("vol-f", 0, 20_000,
+                                                  heal=True)
+                assert w.tobytes() == data[:20_000] and total == len(data)
+
+                # KILL host-0 mid-stream: server down, heartbeats stop.
+                controllers[0].stop()
+                servers[0].force_stop()
+                t_kill = time.monotonic()
+
+                failovers_before = M.FEEDER_FAILOVERS.value
+                w2, total2, _ = feeder.fetch_window(
+                    "vol-f", 20_000, 20_000, timeout=30, heal=True)
+                assert w2.tobytes() == data[20_000:40_000]
+                assert total2 == len(data)
+                assert feeder.controller_id == "host-1"
+                assert M.FEEDER_FAILOVERS.value == failovers_before + 1
+                # Healed by restaging on the survivor, not from a cache.
+                assert svcs[1].get_volume("vol-f") is not None
+
+                # (b) the dead controller leaves GetValues within one TTL
+                # (+ scheduling slack).
+                ttl = controllers[0].lease_seconds
+                assert wait_for(
+                    lambda: live_controllers() == ["host-1"],
+                    timeout=max(0.0, ttl - (time.monotonic() - t_kill)) + 2.0,
+                )
+        finally:
+            for c in controllers:
+                c.stop()
+            for s in servers[1:]:
+                s.force_stop()
+            registry.force_stop()
+
+    def test_publish_fails_over_to_replica(self, tmp_path):
+        """publish() itself re-resolves: pointing at a dead controller
+        with a live replica at the same coordinate publishes there."""
+        db, registry, svcs, servers = self._cluster()
+        db.set("host-0/address", "localhost:1")  # dead from the start
+        db.set("host-0/mesh", "4,5,6")
+        db.set("host-1/address", servers[1].addr)
+        db.set("host-1/mesh", "4,5,6")
+        try:
+            data = np.arange(1000, dtype=np.int32)
+            path = tmp_path / "v.npy"
+            np.save(path, data)
+            feeder = Feeder(registry_address=registry.addr,
+                            controller_id="host-0")
+            pub = feeder.publish(pb.MapVolumeRequest(
+                volume_id="v",
+                file=pb.FileParams(path=str(path), format="npy"),
+            ), timeout=30)
+            assert feeder.controller_id == "host-1"
+            assert pub.bytes == data.nbytes
+            assert svcs[1].get_volume("v") is not None
+        finally:
+            for s in servers:
+                s.force_stop()
+            registry.force_stop()
+
+    def test_no_replica_means_original_failure(self):
+        """No controller at the same coordinate: UNAVAILABLE propagates
+        (failing over to a DIFFERENT coordinate would misplace data)."""
+        db = MemRegistryDB()
+        registry = registry_server("tcp://localhost:0",
+                                   RegistryService(db=db))
+        db.set("host-0/address", "localhost:1")
+        db.set("host-0/mesh", "1,1,1")
+        db.set("host-1/address", "localhost:1")
+        db.set("host-1/mesh", "2,2,2")  # different coordinate: not a replica
+        try:
+            feeder = Feeder(registry_address=registry.addr,
+                            controller_id="host-0")
+            with pytest.raises(PublishError) as err:
+                feeder.publish(pb.MapVolumeRequest(
+                    volume_id="v", malloc=pb.MallocParams()), timeout=5)
+            assert err.value.code == "UNAVAILABLE"
+            assert feeder.controller_id == "host-0"  # never re-targeted
+        finally:
+            registry.force_stop()
+
+    def test_injected_freeze_triggers_failover_without_killing(self,
+                                                               tmp_path):
+        """Deterministic variant: the pinned controller is healthy but its
+        data-plane RPCs are fault-injected UNAVAILABLE (frozen process) —
+        the feeder must still fail over."""
+        db, registry, svcs, servers = self._cluster()
+        for i in range(2):
+            db.set(f"host-{i}/address", servers[i].addr)
+            db.set(f"host-{i}/mesh", "0,0,0")
+        try:
+            data = np.random.RandomState(3).bytes(10_000)
+            path = tmp_path / "f.bin"
+            path.write_bytes(data)
+            feeder = Feeder(registry_address=registry.addr,
+                            controller_id="host-0")
+            feeder.publish(pb.MapVolumeRequest(
+                volume_id="vz",
+                file=pb.FileParams(path=str(path), format="raw"),
+            ))
+            faultinject.arm("feeder.rpc", controller_id="host-0")
+            w, total, _ = feeder.fetch_window("vz", 0, 5_000, timeout=30,
+                                              heal=True)
+            assert w.tobytes() == data[:5_000]
+            assert feeder.controller_id == "host-1"
+        finally:
+            for s in servers:
+                s.force_stop()
+            registry.force_stop()
+
+
+class TestHealthView:
+    def test_oimctl_health_rows(self):
+        from oim_tpu.cli.oimctl import health_rows
+
+        clock = FakeClock()
+        service = RegistryService(db=MemRegistryDB(),
+                                  leases=LeaseTable(clock=clock))
+        server = registry_server("tcp://localhost:0", service)
+        try:
+            with grpc.insecure_channel(server.addr) as ch:
+                stub = RegistryStub(ch)
+                stub.SetValue(pb.SetValueRequest(value=pb.Value(
+                    path="host-0/address", value="a:1", lease_seconds=5)))
+                stub.SetValue(pb.SetValueRequest(value=pb.Value(
+                    path="host-0/mesh", value="1,2,3", lease_seconds=5)))
+                stub.SetValue(pb.SetValueRequest(value=pb.Value(
+                    path="host-1/address", value="b:1")))
+                assert health_rows(stub) == [
+                    ("host-0", "ALIVE", "a:1", "1,2,3"),
+                    ("host-1", "ALIVE", "b:1", ""),
+                ]
+                clock.now = 6.0
+                assert health_rows(stub) == [
+                    ("host-0", "STALE", "a:1", "1,2,3"),
+                    ("host-1", "ALIVE", "b:1", ""),
+                ]
+        finally:
+            server.force_stop()
+
+
+class TestBootstrapResilience:
+    def test_wait_for_hosts_rides_out_registry_restart(self):
+        """GetValues UNAVAILABLE mid-bootstrap (registry restarting) is
+        retried until the deadline instead of aborting the slice."""
+        from oim_tpu.parallel.bootstrap import wait_for_hosts
+
+        service = RegistryService(db=MemRegistryDB())
+        server = registry_server("tcp://localhost:0", service)
+        addr = server.addr
+        server.force_stop()  # registry is DOWN when the wait starts
+
+        import threading
+
+        state = {}
+
+        def revive():
+            time.sleep(0.4)
+            svc2 = RegistryService(db=MemRegistryDB())
+            svc2.db.set("host-0/address", "a:1")
+            state["server"] = registry_server(f"tcp://{addr}", svc2)
+
+        t = threading.Thread(target=revive)
+        t.start()
+        try:
+            with grpc.insecure_channel(addr) as ch:
+                entries = wait_for_hosts(RegistryStub(ch), 1, timeout=15,
+                                         poll=0.05)
+            assert entries == {"host-0/address": "a:1"}
+        finally:
+            t.join()
+            state["server"].force_stop()
+
+    def test_wait_for_hosts_times_out_when_down(self):
+        from oim_tpu.parallel.bootstrap import BootstrapError, wait_for_hosts
+
+        with grpc.insecure_channel("localhost:1") as ch:
+            with pytest.raises(BootstrapError):
+                wait_for_hosts(RegistryStub(ch), 1, timeout=0.5, poll=0.05)

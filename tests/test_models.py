@@ -95,6 +95,7 @@ def test_llama_overfits_tiny_batch():
     assert float(loss) < first * 0.5, (first, float(loss))
 
 
+@pytest.mark.slow
 def test_llama_sharded_tp_sp_matches_single_device():
     cfg = llama.tiny()
     params = llama.init(jax.random.PRNGKey(0), cfg)

@@ -86,6 +86,7 @@ def test_shard_batch_places_on_mesh():
 
 @pytest.mark.parametrize("kind", ["ring", "ulysses"])
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow
 def test_sequence_parallel_attention_matches_reference(kind, causal):
     mesh = build_mesh([("data", 2), ("fsdp", 1), ("seq", 4)])
     rng = np.random.RandomState(0)
@@ -99,6 +100,7 @@ def test_sequence_parallel_attention_matches_reference(kind, causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_sequence_parallel_custom_mesh_axes():
     # A mesh without an "fsdp" axis must work: batch axes are derived from
     # the mesh itself.
@@ -113,6 +115,7 @@ def test_sequence_parallel_custom_mesh_axes():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_long_context_gradients():
     mesh = build_mesh([("data", 1), ("fsdp", 1), ("seq", 8)])
     rng = np.random.RandomState(1)
@@ -128,6 +131,7 @@ def test_ring_attention_long_context_gradients():
 
 @pytest.mark.parametrize("kind", ["ring", "ulysses", "zigzag"])
 @pytest.mark.parametrize("hkv", [2, 1])
+@pytest.mark.slow
 def test_sequence_parallel_attention_gqa(kind, hkv):
     """GQA rides sequence parallelism without K/V head expansion: ring keeps
     kv-width shards on the ring; ulysses all_to_alls them at kv width when
@@ -144,6 +148,7 @@ def test_sequence_parallel_attention_gqa(kind, hkv):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_gqa_gradients():
     mesh = build_mesh([("data", 1), ("seq", 4)])
     rng = np.random.RandomState(5)
@@ -157,6 +162,7 @@ def test_ring_attention_gqa_gradients():
     np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_ulysses_gqa_native_width():
     # hkv divides the seq axis: K/V ride the all_to_all at kv width.
     mesh = build_mesh([("data", 4), ("seq", 2)])
@@ -209,6 +215,7 @@ class TestZigzag:
         assert perm[:4].tolist() == [0, 1, 2, 3]
         assert perm[4:8].tolist() == [28, 29, 30, 31]
 
+    @pytest.mark.slow
     def test_gradients_match_dense(self):
         mesh = build_mesh([("data", 1), ("seq", 4)])
         rng = np.random.RandomState(7)
@@ -225,6 +232,7 @@ class TestZigzag:
             np.testing.assert_allclose(
                 np.asarray(g), np.asarray(g_ref), atol=1e-4)
 
+    @pytest.mark.slow
     def test_long_context_eight_way(self):
         mesh = build_mesh([("data", 1), ("seq", 8)])
         rng = np.random.RandomState(8)
@@ -236,6 +244,7 @@ class TestZigzag:
         ref = mha_reference(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
+    @pytest.mark.slow
     def test_trainer_opt_in(self):
         """rules=tp_sp + seq_parallel=zigzag trains end to end."""
         from oim_tpu.train import TrainConfig, Trainer

@@ -34,6 +34,7 @@ class TestPipeline:
         out, _ = jax.lax.scan(body, x, params)
         return out
 
+    @pytest.mark.slow
     def test_pipeline_matches_sequential(self):
         mesh = build_mesh([("data", 2), ("pipe", 4)])
         layer_fn = self._layer_fn()
@@ -48,6 +49,7 @@ class TestPipeline:
         )
         np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-5)
 
+    @pytest.mark.slow
     def test_pipeline_gradients_match(self):
         mesh = build_mesh([("data", 1), ("pipe", 4)])
         layer_fn = self._layer_fn()
@@ -78,6 +80,7 @@ class TestLlamaPipeline:
     llama decoder body sharded over a "pipe" axis must reproduce the
     sequential (scan-over-layers) loss and gradients exactly."""
 
+    @pytest.mark.slow
     def test_pipelined_loss_matches_sequential(self):
         cfg = llama.tiny(n_layers=4)
         mesh = build_mesh([("data", 2), ("pipe", 4)])
@@ -89,6 +92,7 @@ class TestLlamaPipeline:
         got = float(pipe_loss(params, tokens))
         np.testing.assert_allclose(got, expected, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_pipelined_grads_match_sequential(self):
         cfg = llama.tiny(n_layers=4)
         mesh = build_mesh([("data", 1), ("pipe", 4)])
@@ -108,6 +112,7 @@ class TestLlamaPipeline:
             atol=2e-5,
         )
 
+    @pytest.mark.slow
     def test_trainer_pipe_rules_full_step(self):
         # DP x PP: 2-way data, 2-way pipe; llama-tiny's 2 layers → 1/stage.
         cfg = TrainConfig(
@@ -133,6 +138,7 @@ class TestLlamaPipeline:
         assert shardings["embed"].spec[0] == "pipe"
         assert shardings["lm_head"].spec[1] == "pipe"
 
+    @pytest.mark.slow
     def test_pipelined_chunked_ce_matches_sequential(self):
         """cfg.vocab_chunk routes the pipelined loss through the chunked-
         vocab CE: same value/grads as the materialized-logits path."""
@@ -168,6 +174,7 @@ class TestLlamaPipeline:
         assert mcfg.vocab == 128256 and mcfg.vocab_chunk == 16384
         assert mcfg.n_layers == 2 and mcfg.dim == 256
 
+    @pytest.mark.slow
     def test_pipelined_moe_loss_matches_sequential(self):
         # Generous capacity so no tokens drop: the model OUTPUT (hence the
         # CE term) must match the sequential path exactly. The aux term is
@@ -198,6 +205,7 @@ class TestLlamaPipeline:
         assert abs(got_w - exp_w) < 0.05, (got_w, exp_w)
         assert got_w > got  # aux is positive, not masked-out garbage
 
+    @pytest.mark.slow
     def test_trainer_pipe_moe_full_step(self):
         cfg = TrainConfig(
             model="llama-tiny-moe", rules="pipe", batch_size=4, seq_len=16,
@@ -213,6 +221,7 @@ class TestLlamaPipeline:
         with pytest.raises(ValueError, match="pipe' axis"):
             Trainer(cfg)  # default mesh is data-only
 
+    @pytest.mark.slow
     def test_pipe_composes_with_ring_sequence_parallelism(self):
         # PP x SP: the sequence dim shards over "seq" INSIDE the pipeline's
         # shard_map (raw ring attention + offset RoPE); the loss must match
@@ -228,6 +237,7 @@ class TestLlamaPipeline:
         got = float(pipe_loss(params, tokens))
         np.testing.assert_allclose(got, expected, rtol=2e-5)
 
+    @pytest.mark.slow
     def test_trainer_pipe_seq_data_full_step(self):
         # DP x SP x PP in one jitted step.
         cfg = TrainConfig(
@@ -313,6 +323,7 @@ class TestMoE:
     @pytest.mark.parametrize("rules,schedule", [
         ("tp_sp", None), ("pipe", "gpipe"), ("pipe", "1f1b"),
     ])
+    @pytest.mark.slow
     def test_trainer_step_reports_drop_frac(self, rules, schedule):
         """Every schedule's step stats carry moe_drop_frac — the
         telemetry rides the aux channel through dense, GPipe, and 1F1B
@@ -406,6 +417,7 @@ class Test1F1B:
 
         return mesh, stacked, head, x, tgt, layer_fn, head_loss
 
+    @pytest.mark.slow
     def test_loss_and_grads_match_gpipe(self):
         """Same scalar, two schedules: GPipe (jax.grad over the
         microbatched apply) and 1F1B (manual interleaved vjp) must agree
@@ -441,6 +453,7 @@ class Test1F1B:
                     np.asarray(u), np.asarray(v), atol=1e-5,
                     err_msg=f"1F1B {name} grad diverges from GPipe")
 
+    @pytest.mark.slow
     def test_single_stage_degenerates_to_sequential(self):
         from oim_tpu.parallel.pipeline_1f1b import make_1f1b_value_and_grad
 
@@ -523,12 +536,14 @@ class Test1F1BTrainer:
         trainer = Trainer(cfg, axes=[("data", 2), ("pipe", 2)])
         return trainer.run(steps=steps)
 
+    @pytest.mark.slow
     def test_matches_gpipe_trajectory(self):
         loss_g = self._run("gpipe")
         loss_f = self._run("1f1b")
         assert np.isfinite(loss_f)
         np.testing.assert_allclose(loss_f, loss_g, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_moe_full_step(self):
         # MoE under 1F1B (the r4 "use GPipe for MoE" restriction is gone):
         # aux loss rides the backward vjp per (stage, microbatch).
@@ -544,6 +559,7 @@ class Test1F1BTrainer:
         assert all(np.isfinite(np.asarray(p)).all()
                    for p in jax.tree.leaves(trainer.state.params))
 
+    @pytest.mark.slow
     def test_seq_axis_full_step(self):
         # DP x SP x PP under 1F1B: ring attention INSIDE the pipe (the r4
         # headline gap — the memory-bounded schedule now serves the
@@ -560,6 +576,7 @@ class Test1F1BTrainer:
         assert all(np.isfinite(np.asarray(p)).all()
                    for p in jax.tree.leaves(trainer.state.params))
 
+    @pytest.mark.slow
     def test_trainer_accum_with_1f1b_full_step(self):
         """Gradient accumulation wraps the 1F1B vg in a lax.scan (the
         kernel's collectives run inside the scan body): the last
@@ -590,6 +607,7 @@ class Test1F1BShardedHead:
     stage persisting only its vocab/P slice — the full head is never
     all-gathered and the [.., V] logits never exist on any device."""
 
+    @pytest.mark.slow
     def test_8b_vocab_config_trains_with_sharded_head(self):
         cfg = TrainConfig(
             model="llama3-8b", rules="pipe", microbatches=4,
@@ -621,6 +639,7 @@ class Test1F1BLlamaGradEquivalence:
     near-zero-lr trajectories cannot."""
 
     @pytest.mark.parametrize("pp,data", [(2, 2), (4, 2)])
+    @pytest.mark.slow
     def test_all_grads_match_gpipe(self, pp, data):
         mesh = build_mesh([("data", data), ("pipe", pp)])
         cfg = llama.Config(
@@ -645,7 +664,7 @@ class Test1F1BLlamaGradEquivalence:
         flat_f, tree_f = jax.tree.flatten(grads_f)
         flat_g, tree_g = jax.tree.flatten(grads_g)
         assert tree_f == tree_g
-        paths = [p for p, _ in jax.tree.flatten_with_path(grads_f)[0]]
+        paths = [p for p, _ in jax.tree_util.tree_flatten_with_path(grads_f)[0]]
         for path, a, b in zip(paths, flat_f, flat_g):
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), atol=2e-5,
@@ -656,7 +675,7 @@ def _assert_grads_equal(grads_f, grads_g, atol, label):
     flat_f, tree_f = jax.tree.flatten(grads_f)
     flat_g, tree_g = jax.tree.flatten(grads_g)
     assert tree_f == tree_g
-    paths = [p for p, _ in jax.tree.flatten_with_path(grads_f)[0]]
+    paths = [p for p, _ in jax.tree_util.tree_flatten_with_path(grads_f)[0]]
     for path, a, b in zip(paths, flat_f, flat_g):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=atol,
@@ -695,6 +714,7 @@ class Test1F1BComposition:
         return float(loss_f), params
 
     @pytest.mark.parametrize("pp,sp,data", [(2, 2, 2), (4, 2, 1)])
+    @pytest.mark.slow
     def test_seq_ring_matches_gpipe(self, pp, sp, data):
         """1F1B x ring sequence parallelism inside the pipe: loss and
         every gradient equal GPipe's PP x SP path (which itself matches
@@ -707,6 +727,7 @@ class Test1F1BComposition:
         mesh = build_mesh([("data", data), ("seq", sp), ("pipe", pp)])
         self._compare(mesh, cfg, m, tokens, seq_axis="seq")
 
+    @pytest.mark.slow
     def test_seq_ulysses_matches_gpipe(self):
         """1F1B x Ulysses (all-to-all) sequence parallelism inside the
         pipe: the third seq-parallel kind through the unconditional tick
@@ -720,6 +741,7 @@ class Test1F1BComposition:
         self._compare(mesh, cfg, m, tokens, seq_axis="seq",
                       seq_parallel="ulysses")
 
+    @pytest.mark.slow
     def test_seq_zigzag_matches_gpipe_and_dense(self):
         """Zigzag INSIDE the pipeline (r4 weak #3): the permuted layout
         with its static RoPE position table must reproduce the dense
@@ -739,6 +761,7 @@ class Test1F1BComposition:
         np.testing.assert_allclose(loss_zz, loss_dense, rtol=2e-5)
 
     @pytest.mark.parametrize("pp", [2, 4])
+    @pytest.mark.slow
     def test_moe_aux_matches_gpipe(self, pp):
         """1F1B x MoE: the load-balance aux (and its gradient through the
         router) rides the 1F1B backward at GPipe's exact per-microbatch
@@ -751,6 +774,7 @@ class Test1F1BComposition:
         mesh = build_mesh([("data", 2), ("pipe", pp)])
         self._compare(mesh, cfg, m, tokens)
 
+    @pytest.mark.slow
     def test_seq_ring_with_remat_matches_gpipe(self):
         """remat (jax.checkpoint around the collective-bearing stage
         body) inside the unconditional 1F1B tick loop: the recompute
@@ -765,6 +789,7 @@ class Test1F1BComposition:
         mesh = build_mesh([("data", 2), ("seq", 2), ("pipe", 2)])
         self._compare(mesh, cfg, m, tokens, seq_axis="seq")
 
+    @pytest.mark.slow
     def test_moe_and_seq_together(self):
         """The full composition: DP x SP x PP x MoE under 1F1B — ring
         attention collectives AND the aux accumulator in one unconditional
@@ -776,6 +801,7 @@ class Test1F1BComposition:
         mesh = build_mesh([("data", 2), ("seq", 2), ("pipe", 2)])
         self._compare(mesh, cfg, m, tokens, seq_axis="seq")
 
+    @pytest.mark.slow
     def test_z_loss_matches_gpipe_and_passes_contract(self):
         """cfg.z_loss through the vocab-parallel 1F1B head: the new
         gradient path (logz^2 through the sumexp psum) passes the
@@ -797,7 +823,29 @@ class Test1F1BComposition:
         plain = dataclasses.replace(cfg, z_loss=0.0)
         assert loss_z > float(llama.loss_fn(params, tokens, plain))
 
+    def test_z_loss_term_stat_reported_by_gpipe(self):
+        """stats['z_loss_term'] telemetry is schedule-independent where
+        reported: the GPipe pipelined loss returns the same separately-
+        reported regularizer term as the sequential loss_and_stats
+        (ADVICE r5; the 1F1B gap is documented at Config.z_loss)."""
+        import dataclasses
+
+        cfg = dataclasses.replace(self._cfg(n_layers=4), z_loss=1e-3)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(12), (8, 17), 0, cfg.vocab, jnp.int32)
+        mesh = build_mesh([("data", 2), ("pipe", 2)])
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+        pipe = llama.make_pipelined_loss(mesh, cfg, 4, with_stats=True)
+        loss_p, stats_p = jax.jit(pipe)(params, tokens)
+        loss_s, stats_s = llama.loss_and_stats(params, tokens, cfg)
+        np.testing.assert_allclose(float(loss_p), float(loss_s), rtol=2e-5)
+        np.testing.assert_allclose(
+            float(stats_p["z_loss_term"]), float(stats_s["z_loss_term"]),
+            rtol=2e-5)
+        assert float(stats_p["z_loss_term"]) > 0.0
+
     @pytest.mark.parametrize("pp,data", [(2, 1), (4, 2)])
+    @pytest.mark.slow
     def test_ragged_padding_token_exact(self, pp, data):
         """Token-exact loss parity (r4 weak #1): with ignore_index
         padding spread UNEVENLY across microbatches, 1F1B's scalar (CE
@@ -865,6 +913,7 @@ class TestInterleaved1F1B:
         assert perm[inv].tolist() == list(range(8))
 
     @pytest.mark.parametrize("p,v", [(2, 2), (4, 2), (2, 4)])
+    @pytest.mark.slow
     def test_generic_kernel_matches_gpipe(self, p, v):
         """Loss + every gradient of the interleaved kernel == GPipe
         (same scalar, v-times-smaller bubble)."""
@@ -913,6 +962,7 @@ class TestInterleaved1F1B:
                                    rtol=1e-5)
         _assert_grads_equal((d_st, d_hd, d_x), ref, 1e-5, f"v={v}")
 
+    @pytest.mark.slow
     def test_llama_sharded_head_matches_gpipe_at_v2(self):
         """The full llama path (vocab-parallel sharded head, embed vjp)
         under interleaved 1F1B: loss + every gradient == GPipe."""
@@ -937,6 +987,7 @@ class TestInterleaved1F1B:
         np.testing.assert_allclose(float(loss_f), float(loss_g), rtol=1e-5)
         _assert_grads_equal(grads_f, grads_g, 2e-5, "interleaved-llama")
 
+    @pytest.mark.slow
     def test_interleaved_with_seq_axis_matches_gpipe(self):
         """v=2 x ring-in-pipe: chunk selection inside the UNCONDITIONAL
         stage body (collectives every tick) — the full round-5 kernel
@@ -962,6 +1013,7 @@ class TestInterleaved1F1B:
         np.testing.assert_allclose(float(loss_f), float(loss_g), rtol=1e-5)
         _assert_grads_equal(grads_f, grads_g, 3e-5, "v2-x-seq")
 
+    @pytest.mark.slow
     def test_trainer_virtual_stages_full_step(self):
         cfg = TrainConfig(
             model="llama-tiny", rules="pipe", microbatches=4,
@@ -987,6 +1039,7 @@ class TestShardedHeadContract:
     def _mesh(self):
         return build_mesh([("data", 2), ("pipe", 4)])
 
+    @pytest.mark.slow
     def test_vocab_parallel_ce_head_passes(self):
         from jax.sharding import PartitionSpec as P
 
@@ -1010,6 +1063,7 @@ class TestShardedHeadContract:
         verify_sharded_head_contract(
             self._mesh(), head, {"lm_head": P(None, "pipe")}, tiny)
 
+    @pytest.mark.slow
     def test_nested_psums_are_exact(self):
         """NESTED psums do NOT break the correction (the uniform-P
         induction in the kernel docstring): a renormalizer that itself
@@ -1037,6 +1091,7 @@ class TestShardedHeadContract:
         verify_sharded_head_contract(
             self._mesh(), nested_head, {"lm_head": P(None, "pipe")}, tiny)
 
+    @pytest.mark.slow
     def test_forgotten_psum_head_caught(self):
         """The realistic bug class: a head missing a collective computes
         a device-VARYING loss (here the label term sums only the local
@@ -1066,6 +1121,7 @@ class TestShardedHeadContract:
             verify_sharded_head_contract(
                 self._mesh(), bad_head, {"lm_head": P(None, "pipe")}, tiny)
 
+    @pytest.mark.slow
     def test_make_1f1b_loss_runs_the_check(self, monkeypatch):
         """make_1f1b_loss executes the contract check at build time by
         default (OIM_SKIP_HEAD_CHECK opts out)."""

@@ -202,6 +202,7 @@ class TestLabeledFeeds:
         assert b["images"].shape == (4, 16, 16, 3)
         assert b["labels"].tolist() == want[:4]
 
+    @pytest.mark.slow
     def test_supervised_fed_training_beats_chance(self, cluster, tmp_path):
         """THE config-3/4 claim: a labeled volume staged through MapVolume
         trains fed-ResNet below chance loss, and held-out eval accuracy
@@ -303,6 +304,7 @@ class TestWebdatasetEval:
         args.eval_volume_webdataset = ""
         assert eval_feed_args(args) is None
 
+    @pytest.mark.slow
     def test_webdataset_fed_run_evals_end_to_end(self, cluster, tmp_path):
         """Train on one jpg/cls shard, eval on a HELD-OUT shard staged as
         its own '<volume>-eval' MapVolume — accuracy above chance."""
@@ -400,6 +402,29 @@ class TestSeekableFeeds:
         feed.seek(4)
         np.testing.assert_array_equal(next(feed), next(ref))
         np.testing.assert_array_equal(next(feed), next(ref))
+
+    def test_seekable_feed_is_lazy(self):
+        """The factory runs at first next(), not at construction or
+        seek(): resume must not build (publish RPCs, prefetch decode) a
+        position-0 feed just to throw it away (ADVICE r5). A single
+        consumed factory run per position; seeks while un-consumed
+        collapse into the last one."""
+        from oim_tpu.data.feeds import SeekableFeed
+
+        calls = []
+
+        def make(start):
+            calls.append(start)
+            return iter(range(start, start + 100))
+
+        feed = SeekableFeed(make)
+        assert calls == []  # construction is free
+        feed.seek(7)
+        feed.seek(9)
+        assert calls == []  # so is seeking
+        assert next(feed) == 9
+        assert calls == [9]  # one build, at the final position
+        assert next(feed) == 10
 
     def test_trainer_uses_seek_on_resume(self, tmp_path):
         """Resume with a seek-capable feed: the trainer calls seek(n)

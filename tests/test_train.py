@@ -38,6 +38,7 @@ def test_llama_train_step_all_rules(rules, axes):
     _run(cfg, axes)
 
 
+@pytest.mark.slow
 def test_llama_sequence_parallel_training():
     cfg = TrainConfig(
         model="llama-tiny", rules="tp_sp", seq_parallel="ring",
@@ -390,3 +391,26 @@ def test_remat_policy_requires_remat_and_support():
     mcfg = TrainConfig(model="llama-tiny", remat=True,
                        remat_policy="dots").model_config()
     assert mcfg.remat and mcfg.remat_policy == "dots"
+
+
+def test_place_batch_verifies_device_resident_sharding():
+    """A device-resident feed with the expected (BATCH, None, ...) layout
+    passes through untouched (no host round-trip); an equivalent-but-
+    differently-spelled spec also passes; a genuinely mis-sharded feed is
+    resharded (with a warning) instead of silently accepted (ADVICE r5)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cfg = TrainConfig(model="llama-tiny", batch_size=4, seq_len=16,
+                      log_every=1, warmup_steps=1, total_steps=1)
+    trainer = Trainer(cfg, axes=[("data", 2)])
+    toks = np.zeros((4, 17), np.int32)
+    # P('data') vs the canonical P(('data',), None): equivalent at rank 2.
+    good = jax.device_put(toks, NamedSharding(trainer.mesh, P("data")))
+    assert trainer.place_batch({"tokens": good})["tokens"] is good
+    # Replicated feed into a batch-sharded step: must be resharded.
+    bad = jax.device_put(toks, NamedSharding(trainer.mesh, P()))
+    placed = trainer.place_batch({"tokens": bad})["tokens"]
+    assert placed is not bad
+    from oim_tpu.train.trainer import _norm_spec
+
+    assert _norm_spec(placed.sharding.spec, 2) == (("data",), ())
