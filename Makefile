@@ -9,7 +9,7 @@
 PY ?= python
 RUFF := $(shell command -v ruff 2>/dev/null)
 
-.PHONY: test pytest lint drift proto native tsan demo start stop clean
+.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo
 
 # drift and tsan are standalone conveniences; the full pytest target
 # already runs both (SpecDrift + the TSAN stream test build in-fixture).
@@ -44,6 +44,11 @@ tsan:
 demo:
 	bash scripts/demo_cluster.sh demo
 
+# Replicated-registry failover demo: primary + standby + 1 controller on
+# localhost; SIGKILLs the primary and shows the standby auto-promote.
+replication-demo:
+	bash scripts/replication_demo.sh demo
+
 start:
 	bash scripts/demo_cluster.sh start
 
@@ -52,4 +57,4 @@ stop:
 
 clean:
 	$(MAKE) -C native clean
-	rm -rf _demo
+	rm -rf _demo _demo_repl

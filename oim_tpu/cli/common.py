@@ -9,6 +9,26 @@ from oim_tpu.common import logging as oim_logging
 from oim_tpu.common.tlsutil import TLSConfig, load_tls
 
 
+def add_registry_flag(
+    parser: argparse.ArgumentParser,
+    default: str = "",
+    required: bool = False,
+    help_suffix: str = "",
+) -> None:
+    """The shared ``--registry`` flag: one endpoint, or a comma-separated
+    list (``primary:9421,standby:9421``) with a replicated registry —
+    clients fail over to the next endpoint on UNAVAILABLE /
+    FAILED_PRECONDITION (common/endpoints.py)."""
+    parser.add_argument(
+        "--registry",
+        default=default,
+        required=required,
+        help="registry endpoint, or comma-separated list primary,standby "
+             "(clients fail over on UNAVAILABLE/FAILED_PRECONDITION)"
+             + (f"; {help_suffix}" if help_suffix else ""),
+    )
+
+
 def add_common_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument(
         "--log-level",

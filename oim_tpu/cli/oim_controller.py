@@ -4,7 +4,12 @@ from __future__ import annotations
 
 import argparse
 
-from oim_tpu.cli.common import add_common_flags, load_tls_flags, setup_logging
+from oim_tpu.cli.common import (
+    add_common_flags,
+    add_registry_flag,
+    load_tls_flags,
+    setup_logging,
+)
 from oim_tpu.common.meshcoord import MeshCoord
 from oim_tpu.controller import Controller, MallocBackend, TPUBackend, controller_server
 
@@ -29,7 +34,7 @@ def main(argv: list[str] | None = None) -> int:
         default="",
         help="address registered into the registry (reference -controller-address)",
     )
-    parser.add_argument("--registry", default="", help="registry address to register at")
+    add_registry_flag(parser, help_suffix="address(es) to register at")
     parser.add_argument(
         "--registry-delay",
         type=float,

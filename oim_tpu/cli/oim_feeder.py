@@ -13,7 +13,12 @@ from __future__ import annotations
 
 import argparse
 
-from oim_tpu.cli.common import add_common_flags, load_tls_flags, setup_logging
+from oim_tpu.cli.common import (
+    add_common_flags,
+    add_registry_flag,
+    load_tls_flags,
+    setup_logging,
+)
 from oim_tpu.common.logging import from_context
 from oim_tpu.feeder import Feeder, FeederDaemon, feeder_server
 
@@ -29,8 +34,7 @@ def main(argv: list[str] | None = None) -> int:
         choices=("", "malloc", "tpu"),
         help="local mode: serve an in-process controller with this backend",
     )
-    parser.add_argument("--registry", default="",
-                        help="remote mode: registry address")
+    add_registry_flag(parser, help_suffix="remote mode")
     parser.add_argument("--controller-id", default="",
                         help="remote mode: target controller")
     parser.add_argument("--device-mesh", default="",

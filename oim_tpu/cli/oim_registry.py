@@ -1,4 +1,11 @@
-"""oim-registry daemon (reference cmd/oim-registry/main.go)."""
+"""oim-registry daemon (reference cmd/oim-registry/main.go).
+
+Runs standalone (the reference's shape) or as half of a replicated
+primary/standby pair (``--peer`` + ``--role``; registry/replication.py):
+the primary streams its journal to the standby, the standby serves reads
+and auto-promotes when the primary's self-lease expires. ``--healthz-port``
+serves ``GET /healthz`` for k8s liveness/readiness probes.
+"""
 
 from __future__ import annotations
 
@@ -8,6 +15,7 @@ from oim_tpu.cli.common import add_common_flags, load_tls_flags, setup_logging
 from oim_tpu.registry import MemRegistryDB, RegistryService
 from oim_tpu.registry.db import FileRegistryDB
 from oim_tpu.registry.registry import registry_server
+from oim_tpu.registry.replication import HealthzServer, ReplicationManager
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -23,23 +31,87 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--boot-grace-seconds", type=float, default=150.0,
         help="lease granted to controller keys replayed from --db-file at "
-             "startup: live controllers renew within one heartbeat, dead "
-             "ones expire after the grace instead of living forever "
+             "startup (and to lease-less controller keys at standby "
+             "promotion): live controllers renew within one heartbeat, "
+             "dead ones expire after the grace instead of living forever "
              "(lease state itself cannot survive a restart); 0 disables",
+    )
+    parser.add_argument(
+        "--peer", default="",
+        help="peer registry endpoint(s) for replication (comma-separated); "
+             "unset runs standalone",
+    )
+    parser.add_argument(
+        "--role", choices=("primary", "standby"), default="primary",
+        help="initial replication role (requires --peer); the boot-time "
+             "peer probe overrides it when the peer holds a higher "
+             "promotion epoch (a rejoining old primary demotes itself)",
+    )
+    parser.add_argument(
+        "--primary-lease-seconds", type=float, default=10.0,
+        help="the primary's self-lease over the replication stream: the "
+             "standby auto-promotes when no record arrives for this long; "
+             "0 disables auto-promotion (oimctl --promote only)",
+    )
+    parser.add_argument(
+        "--healthz-port", type=int, default=0,
+        help="serve k8s probes on this port: GET /healthz (readiness: 200 "
+             "when serving and, on a standby, replication lag is under "
+             "--healthz-max-lag-seconds; 503 otherwise) and GET /livez "
+             "(liveness: 200 whenever serving, lag-blind); 0 disables",
+    )
+    parser.add_argument(
+        "--healthz-max-lag-seconds", type=float, default=30.0,
+        help="replication lag above which a standby's /healthz turns 503",
     )
     add_common_flags(parser)
     args = parser.parse_args(argv)
     setup_logging(args)
+    if args.role == "standby" and not args.peer:
+        raise SystemExit("--role standby requires --peer")
     db = FileRegistryDB(args.db_file) if args.db_file else MemRegistryDB()
     service = RegistryService(
         db=db, tls=load_tls_flags(args),
         boot_grace_seconds=args.boot_grace_seconds if args.db_file else 0.0,
     )
+    manager = None
+    if args.peer:
+        manager = ReplicationManager(
+            service,
+            peer=args.peer,
+            role=args.role.upper(),
+            primary_lease_seconds=args.primary_lease_seconds,
+            boot_grace_seconds=args.boot_grace_seconds,
+            state_file=f"{args.db_file}.repl" if args.db_file else "",
+        )
     server = registry_server(args.endpoint, service)
+    healthz = None
     try:
+        if manager is not None:
+            # After the gRPC server is up so the peer's boot probe can
+            # reach us while our own probe runs.
+            manager.start()
+        if args.healthz_port:
+            healthz = HealthzServer(
+                manager, port=args.healthz_port,
+                max_lag_seconds=args.healthz_max_lag_seconds,
+            ).start()
         server.wait()
     except KeyboardInterrupt:
+        pass
+    finally:
+        # A startup failure (e.g. healthz port already bound) must not
+        # leave the non-daemon gRPC threads serving a half-built process:
+        # stop the server on EVERY exit path so the traceback actually
+        # terminates the daemon.
         server.stop()
+        if healthz is not None:
+            healthz.stop()
+        if manager is not None:
+            manager.stop()
+        close = getattr(db, "close", None)
+        if close is not None:
+            close()
     return 0
 
 

@@ -16,7 +16,12 @@ from __future__ import annotations
 
 import argparse
 
-from oim_tpu.cli.common import add_common_flags, load_tls_flags, setup_logging
+from oim_tpu.cli.common import (
+    add_common_flags,
+    add_registry_flag,
+    load_tls_flags,
+    setup_logging,
+)
 from oim_tpu.common.logging import from_context
 # The feed layer lives in oim_tpu/data/feeds.py (the CLI is flag
 # parsing only); the two public entry points stay importable from here.
@@ -113,7 +118,7 @@ def main(argv: list[str] | None = None) -> int:
                         help="tiny model, 5 steps, CPU-friendly")
     # Data source (feeder mode).
     parser.add_argument("--synthetic", action="store_true", default=False)
-    parser.add_argument("--registry", default="")
+    add_registry_flag(parser, help_suffix="feeder data source")
     parser.add_argument("--controller-id", default="")
     parser.add_argument("--volume", default="train-data")
     parser.add_argument("--volume-file", default="",

@@ -1,5 +1,7 @@
 """oimctl admin CLI: get/set registry keys + cluster health view over mTLS
-(reference cmd/oimctl/main.go)."""
+(reference cmd/oimctl/main.go). ``--registry`` accepts a comma-separated
+endpoint list (replicated pair): commands fail over to the next endpoint
+on UNAVAILABLE / FAILED_PRECONDITION; ``--promote`` promotes the standby."""
 
 from __future__ import annotations
 
@@ -7,7 +9,13 @@ import argparse
 
 import grpc
 
-from oim_tpu.cli.common import add_common_flags, load_tls_flags, setup_logging
+from oim_tpu.cli.common import (
+    add_common_flags,
+    add_registry_flag,
+    load_tls_flags,
+    setup_logging,
+)
+from oim_tpu.common.endpoints import FAILOVER_CODES, RegistryEndpoints
 from oim_tpu.common.pathutil import REGISTRY_ADDRESS, REGISTRY_MESH
 from oim_tpu.common.tlsutil import secure_channel
 from oim_tpu.spec import RegistryStub, pb
@@ -40,9 +48,30 @@ def health_rows(stub: RegistryStub) -> list[tuple[str, str, str, str]]:
     return rows
 
 
+def registry_health_row(stub: RegistryStub) -> tuple[str, str, str, str] | None:
+    """The registry's own row for the --health table, from the virtual
+    ``registry/...`` status keys: role, replication lag (records/seconds),
+    journal size. None for an unreplicated registry."""
+    entries = {
+        v.path: v.value
+        for v in stub.GetValues(
+            pb.GetValuesRequest(path="registry"), timeout=10).values
+    }
+    role = entries.get("registry/role")
+    if role is None:
+        return None
+    detail = (
+        f"epoch={entries.get('registry/epoch', '?')} "
+        f"lag={entries.get('registry/replication/lag_records', '?')}rec/"
+        f"{entries.get('registry/replication/lag_seconds', '?')}s "
+        f"journal={entries.get('registry/replication/journal_bytes', '?')}B"
+    )
+    return ("_registry", role, detail, entries.get("registry/peer", ""))
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser("oimctl")
-    parser.add_argument("--registry", required=True, help="registry address")
+    add_registry_flag(parser, required=True)
     parser.add_argument("--get", default=None, metavar="PATH", help="prefix to read")
     parser.add_argument(
         "--stale",
@@ -58,39 +87,116 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument(
         "--health",
         action="store_true",
-        help="controller liveness table from the registry's lease plane",
+        help="controller liveness table from the registry's lease plane "
+             "(plus the registry's own role/lag row when replicated)",
+    )
+    parser.add_argument(
+        "--promote",
+        action="store_true",
+        help="promote the standby registry to primary (admin CN): probes "
+             "the endpoint list for the STANDBY and sends the promote "
+             "command there",
     )
     add_common_flags(parser)
     args = parser.parse_args(argv)
     setup_logging(args)
     tls = load_tls_flags(args, peer_name="component.registry")
-    if tls is not None:
-        channel = secure_channel(args.registry, tls)
-    else:
-        channel = grpc.insecure_channel(args.registry)
-    stub = RegistryStub(channel)
-    try:
-        if args.set is not None:
-            if "=" not in args.set:
-                raise SystemExit("--set needs PATH=VALUE")
-            path, value = args.set.split("=", 1)
-            stub.SetValue(
-                pb.SetValueRequest(value=pb.Value(path=path, value=value)), timeout=10
-            )
-        if args.get is not None:
-            reply = stub.GetValues(
-                pb.GetValuesRequest(path=args.get, include_stale=args.stale),
+    endpoints = RegistryEndpoints(args.registry)
+
+    def connect(endpoint: str) -> grpc.Channel:
+        if tls is not None:
+            return secure_channel(endpoint, tls)
+        return grpc.insecure_channel(endpoint)
+
+    def with_failover(op):
+        """Run ``op(stub)`` against the current endpoint, rotating through
+        the list on the failover statuses (dead endpoint / unpromoted
+        standby refusing a write)."""
+        last_err = None
+        for _ in range(len(endpoints)):
+            channel = connect(endpoints.current())
+            try:
+                return op(RegistryStub(channel))
+            except grpc.RpcError as err:
+                if err.code() not in FAILOVER_CODES or not endpoints.multiple:
+                    raise
+                last_err = err
+                endpoints.advance()
+            finally:
+                channel.close()
+        raise last_err
+
+    def promote() -> None:
+        # Find the standby: promoting a primary is a no-op, and silently
+        # sending the command there would print success while no failover
+        # happened. No STANDBY in the list -> fail loudly instead.
+        roles = {}
+        target = None
+        for endpoint in endpoints.all():
+            channel = connect(endpoint)
+            try:
+                reply = RegistryStub(channel).GetValues(
+                    pb.GetValuesRequest(path="registry/role"), timeout=10)
+                roles[endpoint] = {v.path: v.value for v in reply.values}.get(
+                    "registry/role", "unreplicated")
+                if roles[endpoint] == "STANDBY":
+                    target = endpoint
+                    break
+            except grpc.RpcError as err:
+                roles[endpoint] = f"unreachable ({err.code().name})"
+            finally:
+                channel.close()
+        if target is None:
+            raise SystemExit(
+                "--promote: no STANDBY among the endpoints — nothing to "
+                f"promote (saw: {roles})")
+        channel = connect(target)
+        try:
+            RegistryStub(channel).SetValue(
+                pb.SetValueRequest(
+                    value=pb.Value(path="registry/promote", value="1")),
                 timeout=10,
             )
-            for value in reply.values:
-                print(f"{value.path}={value.value}")
-        if args.health:
-            for cid, status, address, mesh in health_rows(stub):
-                print(f"{cid}\t{status}\t{address}\t{mesh}")
-        if args.set is None and args.get is None and not args.health:
-            raise SystemExit("nothing to do: pass --get, --set and/or --health")
-    finally:
-        channel.close()
+            print(f"promoted {target}")
+        finally:
+            channel.close()
+        # Follow-up ops in this invocation (--set/--get/--health) must hit
+        # the NEW primary: the superseded one would still accept a write
+        # for the seconds until its next peer probe demotes it — and then
+        # discard it in the resync.
+        while endpoints.current() != target:
+            endpoints.advance()
+
+    if args.promote:
+        promote()
+    if args.set is not None:
+        if "=" not in args.set:
+            raise SystemExit("--set needs PATH=VALUE")
+        path, value = args.set.split("=", 1)
+        with_failover(lambda stub: stub.SetValue(
+            pb.SetValueRequest(value=pb.Value(path=path, value=value)),
+            timeout=10,
+        ))
+    if args.get is not None:
+        reply = with_failover(lambda stub: stub.GetValues(
+            pb.GetValuesRequest(path=args.get, include_stale=args.stale),
+            timeout=10,
+        ))
+        for value in reply.values:
+            print(f"{value.path}={value.value}")
+    if args.health:
+        def table(stub):
+            return registry_health_row(stub), health_rows(stub)
+
+        registry_row, rows = with_failover(table)
+        if registry_row is not None:
+            print("\t".join(registry_row))
+        for cid, status, address, mesh in rows:
+            print(f"{cid}\t{status}\t{address}\t{mesh}")
+    if args.set is None and args.get is None and not args.health \
+            and not args.promote:
+        raise SystemExit(
+            "nothing to do: pass --get, --set, --health and/or --promote")
     return 0
 
 

@@ -1,0 +1,73 @@
+"""Registry endpoint lists: client-side failover across a replicated pair.
+
+Every ``--registry`` flag accepts a comma-separated endpoint list
+(``primary:9421,standby:9421``). Clients dial ``current()`` and, on the
+two failover statuses — ``UNAVAILABLE`` (endpoint dead/unreachable) and
+``FAILED_PRECONDITION`` (endpoint is an unpromoted standby refusing
+writes) — ``advance()`` to the next endpoint and retry through whatever
+retry machinery the call site already has (the controller heartbeat
+loop's jittered backoff, the feeder's heal loop, bootstrap's poll loop).
+Rotation is intentionally dumb: with at most a handful of endpoints, a
+wrong rotation costs one extra round trip and self-corrects on the next
+failure.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import grpc
+
+# Statuses that mean "try the other registry endpoint": the endpoint is
+# down, or it is a standby that cannot serve this call until promoted.
+FAILOVER_CODES = (
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.FAILED_PRECONDITION,
+)
+
+
+def parse_endpoint_list(spec: str) -> list[str]:
+    """Split a comma-separated endpoint spec; rejects an empty list."""
+    endpoints = [e.strip() for e in spec.split(",") if e.strip()]
+    if not endpoints:
+        raise ValueError(f"empty registry endpoint list: {spec!r}")
+    return endpoints
+
+
+class RegistryEndpoints:
+    """Thread-safe cursor over an ordered endpoint list.
+
+    The order is preference order (primary first); ``advance`` rotates
+    round-robin so repeated failures cycle the whole list rather than
+    ping-ponging between two entries of a longer one.
+    """
+
+    def __init__(self, spec: str | list[str] | tuple[str, ...]):
+        self._endpoints = (
+            parse_endpoint_list(spec) if isinstance(spec, str) else list(spec)
+        )
+        if not self._endpoints:
+            raise ValueError("empty registry endpoint list")
+        self._index = 0
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._endpoints)
+
+    @property
+    def multiple(self) -> bool:
+        return len(self._endpoints) > 1
+
+    def all(self) -> tuple[str, ...]:
+        return tuple(self._endpoints)
+
+    def current(self) -> str:
+        with self._lock:
+            return self._endpoints[self._index]
+
+    def advance(self) -> str:
+        """Rotate to the next endpoint (no-op for a single-entry list);
+        returns the new current endpoint."""
+        with self._lock:
+            self._index = (self._index + 1) % len(self._endpoints)
+            return self._endpoints[self._index]

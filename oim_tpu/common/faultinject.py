@@ -21,6 +21,9 @@ Named points wired in this repo:
 * ``feeder.rpc``           — before each remote feeder data-plane RPC
   (ctx: controller_id, method). Arming it simulates a controller that
   accepted the publish and then froze.
+* ``replication.apply``    — before a standby registry applies one
+  replication stream record (ctx: kind). Arming it severs the stream
+  mid-apply, deterministically: the follower reconnects and catches up.
 
 All state is process-global (the fixture in tests resets it); a
 ``fire`` on an unarmed point costs one dict lookup.

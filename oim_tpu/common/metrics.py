@@ -122,6 +122,26 @@ FEEDER_FAILOVERS = DEFAULT.counter(
     "oim_feeder_failovers_total",
     "feeder re-targets to a different controller serving the same mesh "
     "coordinate after the pinned controller became unavailable")
+# Registry replication (primary/standby pair, registry/replication.py).
+REPL_LAG_RECORDS = DEFAULT.gauge(
+    "oim_replication_lag_records",
+    "journal records the standby has not yet applied (primary next offset "
+    "minus standby applied offset)")
+REPL_LAG_SECONDS = DEFAULT.gauge(
+    "oim_replication_lag_seconds",
+    "seconds since the standby last received a record (data or primary "
+    "self-heartbeat) over the replication stream")
+REPL_RECORDS_APPLIED = DEFAULT.counter(
+    "oim_replication_records_applied_total",
+    "replication records (KV mutations, lease renewals, snapshot entries) "
+    "applied by this registry as a standby")
+REGISTRY_PROMOTIONS = DEFAULT.counter(
+    "oim_registry_promotions_total",
+    "standby-to-primary promotions performed by this registry process "
+    "(admin --promote or primary self-lease expiry)")
+REGISTRY_ROLE = DEFAULT.gauge(
+    "oim_registry_role",
+    "replication role of this registry: 1 = PRIMARY, 0 = STANDBY")
 
 
 class MetricsServer:

@@ -27,3 +27,10 @@ def join_registry_path(parts: list[str] | tuple[str, ...]) -> str:
     path = "/".join(parts)
     split_registry_path(path)
     return path
+
+
+def path_has_prefix(path: str, prefix_parts: list[str]) -> bool:
+    """Component-wise prefix match: ``a/b`` is under ``a`` but ``ab`` is
+    not. The ONE definition of registry prefix semantics — GetValues,
+    the DB scan, and lease renewal must all agree on it."""
+    return path.split("/")[: len(prefix_parts)] == prefix_parts

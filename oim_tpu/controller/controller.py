@@ -26,6 +26,7 @@ import time
 import grpc
 
 from oim_tpu.common import faultinject, metrics as M
+from oim_tpu.common.endpoints import FAILOVER_CODES, RegistryEndpoints
 from oim_tpu.common.keymutex import KeyMutex
 from oim_tpu.common.logging import from_context
 from oim_tpu.common.meshcoord import MeshCoord
@@ -229,7 +230,15 @@ class Controller:
         self.controller_id = controller_id
         self.service = ControllerService(backend)
         self.controller_address = controller_address
+        # ``registry_address`` may be a comma-separated endpoint list
+        # (primary,standby): the heartbeat loop fails over to the next
+        # endpoint when the current one is down or answers standby.
         self.registry_address = registry_address
+        # With no registry configured, keep the pre-list behavior for
+        # direct register_once()/heartbeat_once() callers: dialing ""
+        # fails as an RpcError at call time (start() never runs the loop).
+        self._endpoints = RegistryEndpoints(
+            registry_address if registry_address else [""])
         self.registry_delay = registry_delay
         # 0 = derive from the heartbeat interval; < 0 = no lease (register
         # permanent entries — the pre-health-plane behavior).
@@ -244,7 +253,7 @@ class Controller:
     # -- heartbeat loop ----------------------------------------------------
 
     def _registry_channel(self) -> grpc.Channel:
-        return dial(self.registry_address, self.tls, "component.registry")
+        return dial(self._endpoints.current(), self.tls, "component.registry")
 
     def register_once(self) -> None:
         """One full registration (address + mesh, with lease) over a fresh
@@ -341,6 +350,17 @@ class Controller:
                     failures += 1
                     detail = (err.details() or str(err.code())
                               if isinstance(err, grpc.RpcError) else str(err))
+                    if (self._endpoints.multiple
+                            and isinstance(err, grpc.RpcError)
+                            and err.code() in FAILOVER_CODES):
+                        # Replicated registry: UNAVAILABLE (endpoint dead)
+                        # or FAILED_PRECONDITION (unpromoted standby) —
+                        # rotate to the peer endpoint and let the backoff
+                        # below pace the retry. The pair converges once
+                        # the standby promotes.
+                        target = self._endpoints.advance()
+                        log.warning("failing over to peer registry",
+                                    target=target)
                     # Jittered exponential backoff: a restarting registry
                     # must not be hit by the whole fleet in lockstep.
                     base = min(1.0, self.registry_delay)

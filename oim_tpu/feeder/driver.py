@@ -27,6 +27,7 @@ from typing import Any, Mapping
 import grpc
 
 from oim_tpu.common import faultinject, metrics as M
+from oim_tpu.common.endpoints import RegistryEndpoints
 from oim_tpu.common.keymutex import KeyMutex
 from oim_tpu.common.logging import from_context
 from oim_tpu.common.meshcoord import MeshCoord
@@ -86,7 +87,14 @@ class Feeder:
         if remote and not (registry_address and controller_id):
             raise ValueError("remote mode needs registry_address and controller_id")
         self.controller = controller
+        # Comma-separated endpoint list (primary,standby): operations
+        # rotate to the next endpoint when the current registry is down
+        # or answers standby (registry-level failover, distinct from the
+        # controller-level _fail_over below).
         self.registry_address = registry_address
+        self._endpoints = (
+            RegistryEndpoints(registry_address) if registry_address else None
+        )
         self.controller_id = controller_id
         self.tls = tls
         self._published: dict[str, PublishedVolume] = {}
@@ -97,8 +105,8 @@ class Feeder:
 
     def _registry_channel(self) -> grpc.Channel:
         """Fresh dial per operation (reference DialRegistry,
-        oim-driver.go:219-232)."""
-        return dial(self.registry_address, self.tls, "component.registry")
+        oim-driver.go:219-232); targets the endpoint list's current pick."""
+        return dial(self._endpoints.current(), self.tls, "component.registry")
 
     def _fire_rpc_fault(self, method: str) -> None:
         """Fault point for the remote data plane: an armed ``feeder.rpc``
@@ -226,19 +234,8 @@ class Feeder:
             if self.controller is not None:
                 published = self._publish_local(request, deadline)
             else:
-                try:
-                    published = self._publish_remote(request, deadline)
-                except PublishError as err:
-                    # Retry-with-re-resolve: the pinned controller is
-                    # unreachable/expired — if a live replica serves the
-                    # same mesh coordinate, publish there instead
-                    # (MapVolume is idempotent, so a replica that already
-                    # holds the volume just returns its placement). No
-                    # replica -> the original fast failure stands.
-                    if err.code != "UNAVAILABLE" or not self._fail_over(
-                            request.volume_id, reason=str(err)):
-                        raise
-                    published = self._publish_remote(request, deadline)
+                published = self._publish_remote_with_failover(
+                    request, deadline)
             published.params_key = params_key
             published.request = request
             with self._lock:
@@ -265,6 +262,38 @@ class Feeder:
             map_volume_params(emulate, volume_id, attributes, secrets), timeout
         )
 
+    def _publish_remote_with_failover(self, request, deadline):
+        """Remote publish with the two recovery layers in preference
+        order: (1) registry-level failover — rotate to the standby
+        endpoint and retry, which restages nothing because the controller
+        is untouched; (2) controller-level retry-with-re-resolve — if a
+        live replica serves the same mesh coordinate, publish there
+        (MapVolume is idempotent, so a replica that already holds the
+        volume just returns its placement). Neither applies -> the
+        original fast failure stands."""
+        try:
+            return self._publish_remote(request, deadline)
+        except PublishError as err:
+            # Rotation on UNAVAILABLE only: every feeder registry RPC is a
+            # read or a proxied controller call, both of which a standby
+            # serves — so a FAILED_PRECONDITION here is controller-origin
+            # and rotating on it would just repeat the work elsewhere.
+            # (Write-path clients — controller heartbeats, oimctl,
+            # bootstrap — rotate on the full FAILOVER_CODES set.)
+            if err.code == "UNAVAILABLE" and self._endpoints.multiple:
+                target = self._endpoints.advance()
+                from_context().warning(
+                    "publish failing over to peer registry",
+                    volume=request.volume_id, target=target, reason=str(err))
+                try:
+                    return self._publish_remote(request, deadline)
+                except PublishError as err2:
+                    err = err2
+            if err.code != "UNAVAILABLE" or not self._fail_over(
+                    request.volume_id, reason=str(err)):
+                raise err
+            return self._publish_remote(request, deadline)
+
     def _publish_local(self, request, deadline) -> PublishedVolume:
         reply = self.controller.MapVolume(request, self._LocalContext())
         volume = self.controller.get_volume(request.volume_id)
@@ -290,13 +319,16 @@ class Feeder:
         channel = self._registry_channel()
         try:
             registry = RegistryStub(channel)
-            default_coord = self._default_mesh(registry)
             # The proxy routes Controller methods by metadata
             # (nodeserver.go:230-251).
             stub = ControllerStub(channel)
             metadata = [(CONTROLLER_ID_META, self.controller_id)]
             self._fire_rpc_fault("MapVolume")
             try:
+                # Inside the RpcError-to-PublishError wrapper: a dead
+                # registry must surface as code=UNAVAILABLE so the
+                # endpoint-list failover in the caller can rotate.
+                default_coord = self._default_mesh(registry)
                 reply = stub.MapVolume(
                     request,
                     metadata=metadata,
@@ -397,7 +429,10 @@ class Feeder:
 
     # gRPC status codes (PublishError.code — never message text) that heal
     # treats as control-plane transients worth retrying or restaging.
-    RECOVERABLE = ("UNAVAILABLE", "NOT_FOUND")
+    # FAILED_PRECONDITION covers two transients: a standby registry that
+    # has not promoted yet (rotate endpoints), and a volume still STAGING
+    # after a heal re-publish (plain backoff-retry).
+    RECOVERABLE = ("UNAVAILABLE", "NOT_FOUND", "FAILED_PRECONDITION")
 
     def fetch_window(self, volume_id: str, offset: int = 0, length: int = 0,
                      timeout: float = 120.0, heal: bool = False):
@@ -411,7 +446,9 @@ class Feeder:
 
         ``heal=True`` makes the window survive control-plane failures
         within ``timeout``: transient UNAVAILABLE (registry/controller
-        restarting) retries with backoff, and a NOT_FOUND after a
+        restarting) retries with backoff — rotating to the peer registry
+        endpoint first when a list was configured, because a registry-only
+        outage needs no restaging at all — and a NOT_FOUND after a
         controller restart — soft state lost — re-publishes the recorded
         MapVolumeRequest (idempotent; restages from the source) and
         retries. This is the trainer-feed path's recovery primitive: the
@@ -423,6 +460,7 @@ class Feeder:
         deadline = time.monotonic() + timeout
         delay = 0.2
         just_failed_over = False
+        just_rotated_registry = False
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -461,8 +499,26 @@ class Feeder:
                         # backing off toward the deadline.
                         with self._lock:
                             self._published.setdefault(volume_id, pub)
-                elif not just_failed_over and self._fail_over(
-                        volume_id, reason=str(err)):
+                elif (err.code == "UNAVAILABLE"
+                        and not just_rotated_registry
+                        and self._endpoints is not None
+                        and self._endpoints.multiple):
+                    # Registry-level failover first: if only the registry
+                    # host died, the standby proxies the SAME controller —
+                    # the window completes without restaging anything.
+                    # UNAVAILABLE only: the window is a READ, which a
+                    # standby serves too, so a FAILED_PRECONDITION here is
+                    # controller-origin (volume still STAGING after a heal
+                    # re-publish) and must take the backoff path below,
+                    # not ping-pong the endpoint cursor.
+                    target = self._endpoints.advance()
+                    from_context().warning(
+                        "window failing over to peer registry",
+                        volume=volume_id, target=target, reason=str(err))
+                    just_rotated_registry = True
+                    continue
+                elif (err.code == "UNAVAILABLE" and not just_failed_over
+                        and self._fail_over(volume_id, reason=str(err))):
                     # UNAVAILABLE with a live replica at the same mesh
                     # coordinate: re-target and retry immediately. The
                     # replica answers NOT_FOUND if it never staged this
@@ -476,6 +532,7 @@ class Feeder:
                 time.sleep(min(delay, max(deadline - time.monotonic(), 0)))
                 delay = min(delay * 2, 5.0)
                 just_failed_over = False
+                just_rotated_registry = False
 
     def _fetch_window_once(self, volume_id: str, offset: int, length: int,
                            timeout: float):

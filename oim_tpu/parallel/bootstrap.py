@@ -72,7 +72,7 @@ def _coord_key(c: MeshCoord):
 
 def wait_for_hosts(
     registry_stub, expected_hosts: int, timeout: float = 300.0,
-    poll: float = 1.0,
+    poll: float = 1.0, redial=None,
 ) -> dict[str, str]:
     """Poll GetValues("") until ``expected_hosts`` controllers registered.
 
@@ -81,9 +81,13 @@ def wait_for_hosts(
     registered and then died before the slice assembled can no longer
     wedge ``jax.distributed.initialize`` with a stale address. Transient
     registry unavailability (restart mid-bootstrap) is retried until the
-    deadline rather than aborting the whole slice."""
+    deadline rather than aborting the whole slice. With a replicated
+    registry, ``redial()`` (rotate-endpoint-and-return-a-fresh-stub) is
+    invoked on UNAVAILABLE / FAILED_PRECONDITION so assembly fails over
+    to the standby instead of waiting out the primary's outage."""
     import grpc
 
+    from oim_tpu.common.endpoints import FAILOVER_CODES
     from oim_tpu.spec import pb
 
     deadline = time.monotonic() + timeout
@@ -93,9 +97,11 @@ def wait_for_hosts(
             reply = registry_stub.GetValues(
                 pb.GetValuesRequest(path=""), timeout=10.0)
         except grpc.RpcError as err:
-            if err.code() != grpc.StatusCode.UNAVAILABLE:
+            if err.code() not in FAILOVER_CODES:
                 raise
             last_err = err  # registry restarting; soft state heals itself
+            if redial is not None:
+                registry_stub = redial()
         else:
             last_err = None
             entries = {v.path: v.value for v in reply.values}
@@ -125,16 +131,33 @@ def initialize_from_registry(
     """Wait for the slice to assemble, then jax.distributed.initialize.
 
     Returns (process_id, num_processes). Single-host (expected_hosts == 1)
-    skips initialize entirely.
+    skips initialize entirely. ``registry_address`` may be a comma-
+    separated endpoint list (primary,standby): assembly fails over to the
+    standby when the current endpoint is down.
     """
+    from oim_tpu.common.endpoints import RegistryEndpoints
     from oim_tpu.common.tlsutil import dial
     from oim_tpu.spec import RegistryStub
 
-    channel = dial(registry_address, tls, "component.registry")
+    endpoints = RegistryEndpoints(registry_address)
+    state: dict = {"channel": None}
+
+    def connect() -> RegistryStub:
+        if state["channel"] is not None:
+            state["channel"].close()
+        state["channel"] = dial(endpoints.current(), tls, "component.registry")
+        return RegistryStub(state["channel"])
+
+    def redial() -> RegistryStub:
+        endpoints.advance()
+        return connect()
+
     try:
-        entries = wait_for_hosts(RegistryStub(channel), expected_hosts, timeout)
+        entries = wait_for_hosts(
+            connect(), expected_hosts, timeout, redial=redial)
     finally:
-        channel.close()
+        if state["channel"] is not None:
+            state["channel"].close()
     coordinator, n, pid = derive_process_layout(
         entries, controller_id, coordinator_port
     )

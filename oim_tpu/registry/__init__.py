@@ -7,6 +7,10 @@ controller-bound RPCs so compute nodes never need direct connectivity to TPU
 hosts.
 """
 
-from oim_tpu.registry.db import MemRegistryDB, RegistryDB  # noqa: F401
+from oim_tpu.registry.db import FileRegistryDB, MemRegistryDB, RegistryDB  # noqa: F401
 from oim_tpu.registry.leases import LeaseTable  # noqa: F401
 from oim_tpu.registry.registry import RegistryService, registry_server  # noqa: F401
+from oim_tpu.registry.replication import (  # noqa: F401
+    HealthzServer,
+    ReplicationManager,
+)

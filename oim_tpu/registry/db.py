@@ -90,16 +90,11 @@ class FileRegistryDB(MemRegistryDB):
                     else:
                         self._data[key] = value
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        # Compact: rewrite the current state, then append from there.
-        tmp = f"{path}.tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            for key, value in self._data.items():
-                f.write(json.dumps({"k": key, "v": value}) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
         self._json = json
-        self._journal = open(path, "a", encoding="utf-8")  # noqa
+        self._os = os
+        # Compact: rewrite the current state, then append from there.
+        self._journal = None
+        self._rewrite()
 
     def set(self, path: str, value: str) -> None:
         import os
@@ -119,20 +114,62 @@ class FileRegistryDB(MemRegistryDB):
             self._journal.flush()
             os.fsync(self._journal.fileno())
 
-    def close(self) -> None:
-        with self._lock:
+    def _rewrite(self) -> None:
+        """Rewrite the journal as one record per live key and reopen it for
+        appends. Caller holds no lock (construction) or ``self._lock``
+        (compact). fsyncs the file AND its directory: ``os.replace`` alone
+        is not durable — a crash right after the rename can lose the new
+        directory entry and resurrect the uncompacted journal."""
+        os, json = self._os, self._json
+        if self._journal is not None:
             self._journal.close()
+        tmp = f"{self.path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for key, value in self._data.items():
+                f.write(json.dumps({"k": key, "v": value}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        dirfd = os.open(os.path.dirname(os.path.abspath(self.path)), os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
+        self._journal = open(self.path, "a", encoding="utf-8")  # noqa
+
+    def compact(self) -> None:
+        """Collapse the journal to current state. Safe while writers are
+        live (``set`` serializes on the same lock); a replication standby
+        calls this after applying a snapshot so the delete-and-rewrite
+        churn does not accumulate."""
+        with self._lock:
+            self._rewrite()
+
+    def journal_bytes(self) -> int:
+        """Current on-disk journal size (health/status reporting)."""
+        try:
+            return self._os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def close(self) -> None:
+        """Idempotent: the registry shutdown path and atexit may both get
+        here; a second close must not raise on the closed handle."""
+        with self._lock:
+            if self._journal is not None and not self._journal.closed:
+                self._journal.close()
 
 
 def get_registry_entries(db: RegistryDB, prefix: str) -> dict[str, str]:
     """All entries at or under ``prefix`` (reference GetRegistryEntries,
     registry.go:44-51); empty prefix returns everything."""
+    from oim_tpu.common.pathutil import path_has_prefix
+
     parts = prefix.split("/") if prefix else []
     out: dict[str, str] = {}
 
     def visit(path: str, value: str) -> bool:
-        elems = path.split("/")
-        if elems[: len(parts)] == parts:
+        if path_has_prefix(path, parts):
             out[path] = value
         return True
 

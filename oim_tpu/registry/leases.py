@@ -70,12 +70,14 @@ class LeaseTable:
         an expired-but-unswept lease renews too (the controller came back
         within the stale-visibility window; its entry simply goes live
         again, same as a re-register)."""
+        from oim_tpu.common.pathutil import path_has_prefix
+
         parts = prefix.split("/")
         now = self._clock()
         renewed = 0
         with self._lock:
             for path, lease in self._leases.items():
-                if path.split("/")[: len(parts)] != parts:
+                if not path_has_prefix(path, parts):
                     continue
                 ttl = ttl_seconds if ttl_seconds > 0 else lease.ttl
                 lease.deadline = now + ttl

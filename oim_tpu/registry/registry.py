@@ -19,6 +19,7 @@ control connections are short-lived by design (README.md:39-40).
 
 from __future__ import annotations
 
+import threading
 from typing import Callable
 
 import grpc
@@ -28,6 +29,7 @@ from oim_tpu.common.logging import from_context
 from oim_tpu.common.pathutil import (
     REGISTRY_ADDRESS,
     REGISTRY_MESH,
+    path_has_prefix,
     split_registry_path,
 )
 from oim_tpu.common.server import NonBlockingGRPCServer
@@ -58,6 +60,15 @@ class RegistryService(RegistryServicer):
         # The liveness overlay (registry/leases.py): entries written with
         # lease_seconds stay visible only while heartbeats renew them.
         self.leases = leases if leases is not None else LeaseTable()
+        # Set by ReplicationManager when this registry is half of a
+        # primary/standby pair (registry/replication.py): standbys refuse
+        # writes, mutations feed the replication journal, and the virtual
+        # "registry/..." status keys appear in GetValues.
+        self.replication = None
+        # Serializes a write's state mutation WITH its journal append:
+        # without it, two racing writes to one key could journal in the
+        # opposite order they were applied and diverge the standby.
+        self._write_lock = threading.Lock()
         if boot_grace_seconds > 0:
             # A pre-populated DB (FileRegistryDB journal replay) carries no
             # lease state — monotonic deadlines cannot survive a restart.
@@ -99,27 +110,75 @@ class RegistryService(RegistryServicer):
 
     # -- service methods --------------------------------------------------
 
+    def _reject_if_standby(self, context) -> None:
+        repl = self.replication
+        if repl is not None and not repl.is_primary:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"standby (epoch {repl.epoch}): writes go to the primary",
+            )
+
     def SetValue(self, request, context):
+        from oim_tpu.registry import replication as R
+
         peer = self._peer(context)
         try:
             parts = split_registry_path(request.value.path)
         except ValueError as err:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(err))
+        if parts[0] == R.RESERVED_REGISTRY_ID:
+            # The replication control/status namespace — reserved even on
+            # an unreplicated registry, so a controller id "registry" can
+            # never register standalone and then break (plus collide with
+            # the virtual status keys) once --peer is enabled. The one
+            # write it accepts is the admin promote command — notably
+            # accepted BY A STANDBY (that is its whole point:
+            # oimctl --promote).
+            if peer != "user.admin":
+                context.abort(
+                    grpc.StatusCode.PERMISSION_DENIED,
+                    f"{peer!r} may not write the reserved "
+                    f"{R.RESERVED_REGISTRY_ID}/ namespace",
+                )
+            if request.value.path == R.PROMOTE_KEY:
+                if self.replication is None:
+                    context.abort(
+                        grpc.StatusCode.FAILED_PRECONDITION,
+                        "replication not configured on this registry "
+                        "(--peer)",
+                    )
+                # Empty value is SetValue's delete idiom — an admin
+                # cleaning up keys must not trigger a failover.
+                if request.value.value:
+                    self.replication.promote(reason=f"SetValue by {peer}")
+                return pb.SetValueReply()
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"{R.RESERVED_REGISTRY_ID}/ status keys are read-only",
+            )
+        self._reject_if_standby(context)
         if not self._may_set(peer, parts):
             context.abort(
                 grpc.StatusCode.PERMISSION_DENIED,
                 f"{peer!r} may not set {request.value.path!r}",
             )
-        self.db.set(request.value.path, request.value.value)
-        if request.value.value == "":
-            # Deleted entries carry no lease; a later permanent re-write
-            # must not inherit a stale deadline.
-            self.leases.drop(request.value.path)
-        else:
-            # lease_seconds > 0 grants/refreshes; 0 (proto default) writes
-            # a permanent entry — the pre-lease behavior and the admin
-            # override path (oimctl --set pins a key past lease filtering).
-            self.leases.grant(request.value.path, request.value.lease_seconds)
+        with self._write_lock:
+            self.db.set(request.value.path, request.value.value)
+            if request.value.value == "":
+                # Deleted entries carry no lease; a later permanent
+                # re-write must not inherit a stale deadline.
+                self.leases.drop(request.value.path)
+            else:
+                # lease_seconds > 0 grants/refreshes; 0 (proto default)
+                # writes a permanent entry — the pre-lease behavior and
+                # the admin override path (oimctl --set pins a key past
+                # lease filtering).
+                self.leases.grant(
+                    request.value.path, request.value.lease_seconds)
+            if self.replication is not None:
+                self.replication.record_kv(
+                    request.value.path, request.value.value,
+                    request.value.lease_seconds)
         return pb.SetValueReply()
 
     def GetValues(self, request, context):
@@ -133,13 +192,29 @@ class RegistryService(RegistryServicer):
             except ValueError as err:
                 context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(err))
         entries = get_registry_entries(self.db, request.path)
-        return pb.GetValuesReply(
-            values=[
-                pb.Value(path=k, value=v)
-                for k, v in sorted(entries.items())
-                if request.include_stale or self.leases.alive(k)
-            ]
-        )
+        values = [
+            pb.Value(path=k, value=v)
+            for k, v in sorted(entries.items())
+            if request.include_stale or self.leases.alive(k)
+        ]
+        if self.replication is not None:
+            # Virtual replication status keys (role/epoch/lag): never
+            # stored or leased, served by primary and standby alike so
+            # oimctl --health works against either endpoint. Skipped
+            # entirely unless the prefix can reach them — status_entries()
+            # costs locks and a journal-size stat, and the hot read paths
+            # (bootstrap polling, feeder re-resolution) never ask for it.
+            parts = request.path.split("/") if request.path else []
+            from oim_tpu.registry import replication as R
+
+            if not parts or parts[0] == R.RESERVED_REGISTRY_ID:
+                values.extend(
+                    pb.Value(path=k, value=v)
+                    for k, v in sorted(
+                        self.replication.status_entries().items())
+                    if path_has_prefix(k, parts)
+                )
+        return pb.GetValuesReply(values=values)
 
     def Heartbeat(self, request, context):
         """Renew the leases on every ``<controller_id>/...`` key (the
@@ -163,7 +238,15 @@ class RegistryService(RegistryServicer):
                 grpc.StatusCode.PERMISSION_DENIED,
                 f"{peer!r} may not heartbeat {request.controller_id!r}",
             )
-        renewed = self.leases.renew(request.controller_id, request.lease_seconds)
+        self._reject_if_standby(context)
+        with self._write_lock:
+            renewed = self.leases.renew(
+                request.controller_id, request.lease_seconds)
+            if renewed > 0 and self.replication is not None:
+                # Renewals ship as logical records: the standby re-bases
+                # the deadline on its own monotonic clock.
+                self.replication.record_renew(
+                    request.controller_id, request.lease_seconds)
         # known == False tells the controller to re-register in full. Two
         # causes: the registry has no address for it (restart, lost soft
         # state), or the address exists WITHOUT a lease to renew (journal
@@ -172,6 +255,25 @@ class RegistryService(RegistryServicer):
         known = renewed > 0 and bool(
             self.db.get(f"{request.controller_id}/{REGISTRY_ADDRESS}"))
         return pb.HeartbeatReply(known=known)
+
+    def Replicate(self, request, context):
+        """Stream the journal to a standby registry (or answer a probe).
+        Authorization: the peer registry dials with its own
+        ``component.registry`` identity; ``user.admin`` may also probe
+        (debugging). The record semantics live in
+        registry/replication.py."""
+        peer = self._peer(context)
+        if peer not in ("component.registry", "user.admin"):
+            context.abort(
+                grpc.StatusCode.PERMISSION_DENIED,
+                f"{peer!r} may not replicate the registry",
+            )
+        if self.replication is None:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "replication not configured on this registry (--peer)",
+            )
+        return self.replication.serve(request, context)
 
 
 _IDENTITY = lambda b: b  # noqa: E731 - bytes pass-through serdes for proxying

@@ -24,6 +24,10 @@ REGISTRY_METHODS = {
     "Heartbeat": (pb.HeartbeatRequest, pb.HeartbeatReply),
 }
 
+REGISTRY_STREAM_METHODS = {
+    "Replicate": (pb.ReplicateRequest, pb.ReplicateRecord),
+}
+
 CONTROLLER_METHODS = {
     "MapVolume": (pb.MapVolumeRequest, pb.MapVolumeReply),
     "UnmapVolume": (pb.UnmapVolumeRequest, pb.UnmapVolumeReply),
@@ -86,6 +90,7 @@ class _Stub:
 class RegistryStub(_Stub):
     _service = REGISTRY_SERVICE
     _methods = REGISTRY_METHODS
+    _stream_methods = REGISTRY_STREAM_METHODS
 
 
 class ControllerStub(_Stub):
@@ -116,6 +121,9 @@ class RegistryServicer:
 
     def Heartbeat(self, request, context):
         context.abort(grpc.StatusCode.UNIMPLEMENTED, "Heartbeat not implemented")
+
+    def Replicate(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "Replicate not implemented")
 
 
 class ControllerServicer:
@@ -184,7 +192,10 @@ class FeederServicer:
 
 
 def add_registry_to_server(servicer: RegistryServicer, server: grpc.Server) -> None:
-    _add_service(server, servicer, REGISTRY_SERVICE, REGISTRY_METHODS)
+    _add_service(
+        server, servicer, REGISTRY_SERVICE, REGISTRY_METHODS,
+        REGISTRY_STREAM_METHODS,
+    )
 
 
 def add_controller_to_server(servicer: ControllerServicer, server: grpc.Server) -> None:

@@ -1,0 +1,134 @@
+#!/usr/bin/env bash
+# Replicated-registry demo (make replication-demo): primary + standby
+# registries (journal-streaming replication, real mTLS) + one controller
+# heartbeating through the endpoint list — then SIGKILL the primary and
+# watch the standby auto-promote and the controller fail over.
+#
+# Artifacts (logs, journals, PID files) land in _demo_repl/.
+set -euo pipefail
+
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+DEMO="$REPO/_demo_repl"
+CA="$DEMO/ca"
+PY="${PY:-python}"
+PRIMARY_PORT="${OIM_DEMO_PRIMARY_PORT:-9431}"
+STANDBY_PORT="${OIM_DEMO_STANDBY_PORT:-9432}"
+HEALTHZ_PORT="${OIM_DEMO_HEALTHZ_PORT:-9433}"
+CONTROLLER_PORT="${OIM_DEMO_CONTROLLER_PORT:-9434}"
+REGISTRY_LIST="127.0.0.1:$PRIMARY_PORT,127.0.0.1:$STANDBY_PORT"
+export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+export JAX_PLATFORMS="${OIM_DEMO_PLATFORM:-cpu}"
+
+# mTLS when the cryptography package is available; insecure otherwise
+# (minimal images): TLS_ARGS expand per-identity via tls_args <cn>.
+certs() {
+    [ -f "$CA/ca.crt" ] && return
+    mkdir -p "$CA"
+    if ! "$PY" -c "
+from oim_tpu.common.ca import CertAuthority
+ca = CertAuthority('oim-repl-demo-ca')
+for cn in ['component.registry', 'controller.host-0', 'user.admin']:
+    ca.write_files('$CA', cn)
+print('certs written to $CA')" 2>/dev/null; then
+        echo "cryptography package unavailable: running the demo INSECURE"
+        INSECURE=1
+    fi
+}
+
+tls_args() { # cn
+    if [ "${INSECURE:-0}" = 1 ]; then
+        return
+    fi
+    echo --ca "$CA/ca.crt" --key "$CA/$1"
+}
+
+spawn() { # name, args...
+    local name="$1"; shift
+    nohup "$@" >"$DEMO/$name.log" 2>&1 &
+    echo $! >"$DEMO/$name.pid"
+    echo "started $name (pid $(cat "$DEMO/$name.pid"), log _demo_repl/$name.log)"
+}
+
+oimctl() {
+    # shellcheck disable=SC2046
+    "$PY" -m oim_tpu.cli.oimctl --registry "$REGISTRY_LIST" \
+        $(tls_args user.admin) "$@"
+}
+
+stop() {
+    local name pid
+    for name in controller standby primary; do
+        if [ -f "$DEMO/$name.pid" ]; then
+            pid="$(cat "$DEMO/$name.pid")"
+            kill "$pid" 2>/dev/null && echo "stopped $name (pid $pid)" || true
+            rm -f "$DEMO/$name.pid"
+        fi
+    done
+}
+
+demo() {
+    mkdir -p "$DEMO"
+    certs
+    # shellcheck disable=SC2046
+    spawn primary "$PY" -m oim_tpu.cli.oim_registry \
+        --endpoint "tcp://127.0.0.1:$PRIMARY_PORT" \
+        --db-file "$DEMO/primary.journal" \
+        --peer "127.0.0.1:$STANDBY_PORT" --role primary \
+        --primary-lease-seconds 3 \
+        $(tls_args component.registry)
+    spawn standby "$PY" -m oim_tpu.cli.oim_registry \
+        --endpoint "tcp://127.0.0.1:$STANDBY_PORT" \
+        --db-file "$DEMO/standby.journal" \
+        --peer "127.0.0.1:$PRIMARY_PORT" --role standby \
+        --primary-lease-seconds 3 --healthz-port "$HEALTHZ_PORT" \
+        $(tls_args component.registry)
+    spawn controller "$PY" -m oim_tpu.cli.oim_controller \
+        --endpoint "tcp://127.0.0.1:$CONTROLLER_PORT" \
+        --controller-id host-0 \
+        --controller-address "127.0.0.1:$CONTROLLER_PORT" \
+        --registry "$REGISTRY_LIST" --registry-delay 2 \
+        --backend malloc --mesh-coord 0,0,0 \
+        $(tls_args controller.host-0)
+
+    echo "== waiting for the controller to register and replicate =="
+    for _ in $(seq 1 60); do
+        if oimctl --health 2>/dev/null | grep -q "host-0.ALIVE"; then
+            break
+        fi
+        sleep 0.5
+    done
+    oimctl --health
+
+    echo "== SIGKILL the primary (pid $(cat "$DEMO/primary.pid")) =="
+    kill -9 "$(cat "$DEMO/primary.pid")"
+    rm -f "$DEMO/primary.pid"
+
+    echo "== waiting for the standby to auto-promote (self-lease 3s) =="
+    for _ in $(seq 1 60); do
+        if curl -fsS "http://127.0.0.1:$HEALTHZ_PORT/healthz" 2>/dev/null \
+                | grep -q '"role": *"PRIMARY"'; then
+            break
+        fi
+        sleep 0.5
+    done
+    curl -fsS "http://127.0.0.1:$HEALTHZ_PORT/healthz" && echo
+
+    echo "== controller heartbeats failed over; health via the standby =="
+    for _ in $(seq 1 60); do
+        if oimctl --health 2>/dev/null | grep -q "host-0.ALIVE"; then
+            break
+        fi
+        sleep 0.5
+    done
+    oimctl --health
+    echo "== replication demo OK =="
+}
+
+case "${1:-demo}" in
+    demo)
+        trap stop EXIT
+        demo
+        ;;
+    stop) stop ;;
+    *) echo "usage: $0 {demo|stop}" >&2; exit 2 ;;
+esac

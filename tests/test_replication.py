@@ -1,0 +1,1110 @@
+"""Replicated-registry tests: journal streaming, promotion, split-brain
+avoidance, and client failover across the endpoint list.
+
+In-process primary/standby pairs with short real TTLs carry most of the
+suite (the replication clock is wall time by design — the primary's
+self-lease IS elapsed time between records); the multi-process
+SIGKILL-the-primary acceptance test is marked ``slow`` so the tier-1
+smoke gate stays in budget.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import grpc
+import numpy as np
+import pytest
+
+from oim_tpu.common import faultinject, metrics as M
+from oim_tpu.common.endpoints import RegistryEndpoints, parse_endpoint_list
+from oim_tpu.controller import Controller, ControllerService, MallocBackend
+from oim_tpu.controller.controller import controller_server
+from oim_tpu.feeder import Feeder
+from oim_tpu.registry import (
+    FileRegistryDB,
+    HealthzServer,
+    MemRegistryDB,
+    RegistryService,
+    ReplicationManager,
+)
+from oim_tpu.registry.registry import registry_server
+from oim_tpu.registry.replication import (
+    PRIMARY,
+    STANDBY,
+    ReplicationLog,
+)
+from oim_tpu.spec import RegistryStub, pb
+
+
+def wait_for(predicate, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+class _Node:
+    """One in-process registry (service + server + manager)."""
+
+    def __init__(self, service, server, manager):
+        self.service = service
+        self.server = server
+        self.manager = manager
+
+    @property
+    def addr(self):
+        return self.server.addr
+
+    def stub_channel(self):
+        return grpc.insecure_channel(self.addr)
+
+    def kill(self):
+        """The host dying: manager threads stop, server vanishes."""
+        if self.manager is not None:
+            self.manager.stop()
+        self.server.force_stop()
+
+
+@pytest.fixture
+def pair_factory():
+    """Builds primary/standby pairs; tears everything down at test end."""
+    nodes = []
+
+    def build(primary_lease=0.4, p_db=None, s_db=None, boot_grace=5.0,
+              start=True, p_state="", s_state=""):
+        p_svc = RegistryService(db=p_db if p_db is not None else MemRegistryDB())
+        p_srv = registry_server("tcp://localhost:0", p_svc)
+        s_svc = RegistryService(db=s_db if s_db is not None else MemRegistryDB())
+        s_srv = registry_server("tcp://localhost:0", s_svc)
+        p_mgr = ReplicationManager(
+            p_svc, peer=s_srv.addr, role=PRIMARY,
+            primary_lease_seconds=primary_lease,
+            boot_grace_seconds=boot_grace, state_file=p_state)
+        s_mgr = ReplicationManager(
+            s_svc, peer=p_srv.addr, role=STANDBY,
+            primary_lease_seconds=primary_lease,
+            boot_grace_seconds=boot_grace, state_file=s_state)
+        primary = _Node(p_svc, p_srv, p_mgr)
+        standby = _Node(s_svc, s_srv, s_mgr)
+        nodes.extend([primary, standby])
+        if start:
+            p_mgr.start(initial_probe=False)
+            s_mgr.start(initial_probe=False)
+        return primary, standby
+
+    yield build
+    for node in nodes:
+        try:
+            node.kill()
+        except Exception:
+            pass
+
+
+def set_value(addr, path, value, lease=0.0):
+    with grpc.insecure_channel(addr) as ch:
+        RegistryStub(ch).SetValue(
+            pb.SetValueRequest(value=pb.Value(
+                path=path, value=value, lease_seconds=lease)),
+            timeout=10,
+        )
+
+
+def heartbeat(addr, controller_id, lease=0.0):
+    with grpc.insecure_channel(addr) as ch:
+        return RegistryStub(ch).Heartbeat(
+            pb.HeartbeatRequest(
+                controller_id=controller_id, lease_seconds=lease),
+            timeout=10,
+        )
+
+
+class TestEndpointList:
+    def test_parse_and_rotate(self):
+        assert parse_endpoint_list("a:1, b:2 ,c:3") == ["a:1", "b:2", "c:3"]
+        with pytest.raises(ValueError):
+            parse_endpoint_list(" , ")
+        eps = RegistryEndpoints("a:1,b:2")
+        assert eps.current() == "a:1" and eps.multiple
+        assert eps.advance() == "b:2"
+        assert eps.advance() == "a:1"  # round-robin wraps
+
+    def test_single_endpoint_advance_noop(self):
+        eps = RegistryEndpoints("a:1")
+        assert not eps.multiple
+        assert eps.advance() == "a:1"
+
+
+class TestReplicationLog:
+    def test_offsets_and_collect(self):
+        log = ReplicationLog()
+        log.append_kv("a/b", "1", 5.0)
+        log.append_renew("a", 5.0)
+        records, snap = log.collect(0, timeout=0)
+        assert not snap
+        assert [r.offset for r in records] == [0, 1]
+        assert records[0].value.path == "a/b"
+        assert records[1].renew_prefix == "a"
+        # Caught-up follower: no records, no snapshot.
+        records, snap = log.collect(2, timeout=0)
+        assert records == [] and not snap
+
+    def test_trimmed_window_demands_snapshot(self):
+        log = ReplicationLog(retain=4)
+        for i in range(10):
+            log.append_kv(f"k{i}/address", "v", 0.0)
+        assert log.start_offset == 6
+        _, snap = log.collect(2, timeout=0)
+        assert snap  # fell out of the retained window
+        records, snap = log.collect(7, timeout=0)
+        assert not snap and [r.offset for r in records] == [7, 8, 9]
+
+    def test_future_offset_demands_snapshot(self):
+        # A follower ahead of the log = it followed a previous (restarted)
+        # primary incarnation; offsets are not comparable.
+        log = ReplicationLog()
+        _, snap = log.collect(100, timeout=0)
+        assert snap
+
+
+class TestFileRegistryDBDurability:
+    def test_close_is_idempotent(self, tmp_path):
+        db = FileRegistryDB(str(tmp_path / "j"))
+        db.set("a/b", "1")
+        db.close()
+        db.close()  # registry shutdown path + atexit: must not raise
+
+    def test_compact_preserves_state_and_shrinks(self, tmp_path):
+        path = str(tmp_path / "j")
+        db = FileRegistryDB(path)
+        for i in range(50):
+            db.set("hot/key", f"v{i}")  # 50 journal records, 1 live key
+        before = db.journal_bytes()
+        db.compact()
+        assert db.journal_bytes() < before
+        assert db.get("hot/key") == "v49"
+        db.set("hot/key", "after")  # journal still appendable post-compact
+        db.close()
+        db2 = FileRegistryDB(path)
+        assert db2.get("hot/key") == "after"
+        db2.close()
+
+
+class TestJournalStream:
+    def test_set_and_delete_replicate(self, pair_factory):
+        primary, standby = pair_factory()
+        set_value(primary.addr, "host-0/address", "a:1", lease=30)
+        set_value(primary.addr, "admin/pin", "x")  # permanent
+        assert wait_for(lambda: standby.service.db.get("host-0/address") == "a:1")
+        assert wait_for(lambda: standby.service.db.get("admin/pin") == "x")
+        # Replicated lease is live on the standby; permanent key has none.
+        assert standby.service.leases.remaining("host-0/address") is not None
+        assert standby.service.leases.remaining("admin/pin") is None
+        # Delete-record replication drops key AND lease on the standby.
+        set_value(primary.addr, "host-0/address", "")
+        assert wait_for(lambda: standby.service.db.get("host-0/address") == "")
+        assert standby.service.leases.remaining("host-0/address") is None
+
+    def test_lease_expires_independently_on_standby(self, pair_factory):
+        primary, standby = pair_factory(primary_lease=0)  # no auto-promote
+        set_value(primary.addr, "host-0/address", "a:1", lease=0.3)
+        assert wait_for(
+            lambda: standby.service.db.get("host-0/address") == "a:1")
+        assert standby.service.leases.alive("host-0/address")
+        # No renewals: the standby expires the entry on its OWN clock.
+        assert wait_for(
+            lambda: not standby.service.leases.alive("host-0/address"),
+            timeout=5)
+
+    def test_renew_records_keep_standby_lease_alive(self, pair_factory):
+        primary, standby = pair_factory(primary_lease=0)
+        set_value(primary.addr, "host-0/address", "a:1", lease=0.4)
+        assert wait_for(
+            lambda: standby.service.db.get("host-0/address") == "a:1")
+        deadline = time.monotonic() + 1.5
+        while time.monotonic() < deadline:
+            assert heartbeat(primary.addr, "host-0").known
+            time.sleep(0.1)
+        # Well past the original 0.4s TTL: replicated renewals carried it.
+        assert standby.service.leases.alive("host-0/address")
+
+    def test_late_standby_snapshot_resync(self, pair_factory):
+        # State written BEFORE the standby connects arrives by snapshot;
+        # keys the standby holds that the primary deleted while it was
+        # disconnected are removed at SNAPSHOT_END.
+        primary, standby = pair_factory(start=False)
+        set_value(primary.addr, "host-0/address", "a:1", lease=30)
+        set_value(primary.addr, "admin/pin", "x")
+        standby.service.db.set("ghost/address", "dead:1")  # stale leftover
+        primary.manager.start(initial_probe=False)
+        standby.manager.start(initial_probe=False)
+        assert wait_for(
+            lambda: standby.service.db.get("host-0/address") == "a:1")
+        assert wait_for(lambda: standby.service.db.get("ghost/address") == "")
+        assert standby.service.leases.remaining("host-0/address") is not None
+
+    def test_standby_rejects_writes_serves_reads(self, pair_factory):
+        primary, standby = pair_factory()
+        set_value(primary.addr, "host-0/address", "a:1", lease=30)
+        assert wait_for(
+            lambda: standby.service.db.get("host-0/address") == "a:1")
+        for op in (
+            lambda: set_value(standby.addr, "host-1/address", "b:1"),
+            lambda: heartbeat(standby.addr, "host-0"),
+        ):
+            with pytest.raises(grpc.RpcError) as err:
+                op()
+            assert err.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+            assert "standby" in err.value.details()
+        with standby.stub_channel() as ch:
+            reply = RegistryStub(ch).GetValues(
+                pb.GetValuesRequest(path="host-0"), timeout=10)
+            assert [(v.path, v.value) for v in reply.values] == [
+                ("host-0/address", "a:1")]
+
+    def test_status_keys_on_both_roles(self, pair_factory):
+        primary, standby = pair_factory()
+        for node, role in ((primary, "PRIMARY"), (standby, "STANDBY")):
+            with node.stub_channel() as ch:
+                entries = {
+                    v.path: v.value
+                    for v in RegistryStub(ch).GetValues(
+                        pb.GetValuesRequest(path="registry"),
+                        timeout=10).values
+                }
+            assert entries["registry/role"] == role
+            assert "registry/replication/lag_records" in entries
+            assert "registry/replication/journal_bytes" in entries
+
+    def test_reserved_namespace_writes(self, pair_factory):
+        primary, standby = pair_factory()
+        with pytest.raises(grpc.RpcError) as err:
+            set_value(primary.addr, "registry/role", "PRIMARY")
+        assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        # The delete idiom (value == "") must NOT trigger a promotion —
+        # an admin cleaning up keys is not requesting a failover.
+        set_value(standby.addr, "registry/promote", "")
+        assert standby.manager.role == STANDBY
+
+    def test_registry_namespace_reserved_even_unreplicated(self):
+        """A controller must never be able to claim the id "registry"
+        standalone and then break (and collide with the virtual status
+        keys) when --peer is enabled later."""
+        svc = RegistryService(db=MemRegistryDB())
+        srv = registry_server("tcp://localhost:0", svc)
+        try:
+            with pytest.raises(grpc.RpcError) as err:
+                set_value(srv.addr, "registry/address", "x:1")
+            assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+            with pytest.raises(grpc.RpcError) as err:
+                set_value(srv.addr, "registry/promote", "1")
+            assert err.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        finally:
+            srv.force_stop()
+
+    def test_stream_lost_mid_snapshot_restarts_the_snapshot(
+            self, pair_factory):
+        """A stream that dies between SNAPSHOT_BEGIN and SNAPSHOT_END must
+        not commit the new (log_id, offset) position: the reconnect
+        re-triggers a FULL snapshot instead of tailing past the missing
+        half (keys never sent, deletions never applied)."""
+        primary, standby = pair_factory(start=False, primary_lease=0)
+        for i in range(5):
+            set_value(primary.addr, f"k{i}/address", f"v{i}", lease=30)
+        standby.service.db.set("ghost/address", "dead:1")  # must be deleted
+        # Sever the stream at the FIRST snapshot KV apply.
+        faultinject.arm("replication.apply", times=1, kind=3)
+        primary.manager.start(initial_probe=False)
+        standby.manager.start(initial_probe=False)
+        assert wait_for(lambda: all(
+            standby.service.db.get(f"k{i}/address") == f"v{i}"
+            for i in range(5)))
+        assert wait_for(lambda: standby.service.db.get("ghost/address") == "")
+
+    def test_severed_stream_reconnects_and_catches_up(self, pair_factory):
+        primary, standby = pair_factory(primary_lease=0)
+        set_value(primary.addr, "a/address", "1", lease=30)
+        assert wait_for(lambda: standby.service.db.get("a/address") == "1")
+        faultinject.arm("replication.apply", times=1)
+        set_value(primary.addr, "b/address", "2", lease=30)
+        set_value(primary.addr, "c/address", "3", lease=30)
+        # The armed fault severed the stream mid-apply; the follower
+        # reconnects from its offset and catches up.
+        assert wait_for(lambda: standby.service.db.get("c/address") == "3")
+        assert standby.service.db.get("b/address") == "2"
+
+
+class TestPromotion:
+    def test_manual_promote_and_old_primary_demotes(self, pair_factory):
+        primary, standby = pair_factory(primary_lease=0)  # manual only
+        set_value(primary.addr, "host-0/address", "a:1", lease=30)
+        assert wait_for(
+            lambda: standby.service.db.get("host-0/address") == "a:1")
+        # The oimctl --promote wire path: admin SetValue of the reserved key.
+        set_value(standby.addr, "registry/promote", "1")
+        assert standby.manager.role == PRIMARY
+        assert standby.manager.epoch == 1
+        # The standby now accepts writes.
+        set_value(standby.addr, "host-1/address", "b:1", lease=30)
+        # The old primary's periodic peer probe sees the higher epoch and
+        # demotes — split-brain heals without a restart.
+        assert wait_for(lambda: primary.manager.role == STANDBY, timeout=10)
+        assert primary.manager.epoch == 1
+        with pytest.raises(grpc.RpcError) as err:
+            set_value(primary.addr, "host-2/address", "c:1")
+        assert err.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        # ...and resyncs the new primary's writes.
+        assert wait_for(
+            lambda: primary.service.db.get("host-1/address") == "b:1",
+            timeout=10)
+
+    def test_promote_on_primary_is_noop(self, pair_factory):
+        primary, _ = pair_factory(primary_lease=0)
+        set_value(primary.addr, "registry/promote", "1")  # idempotent OK
+        assert primary.manager.role == PRIMARY
+        assert primary.manager.epoch == 0
+
+    def test_promote_requires_admin(self, pair_factory):
+        _, standby = pair_factory(primary_lease=0)
+        standby.service._peer = lambda context: "controller.host-0"
+        with pytest.raises(grpc.RpcError) as err:
+            set_value(standby.addr, "registry/promote", "1")
+        assert err.value.code() == grpc.StatusCode.PERMISSION_DENIED
+        assert standby.manager.role == STANDBY
+
+    def test_auto_promotion_when_primary_dies(self, pair_factory):
+        primary, standby = pair_factory(primary_lease=0.4)
+        set_value(primary.addr, "host-0/address", "a:1", lease=30)
+        assert wait_for(
+            lambda: standby.service.db.get("host-0/address") == "a:1")
+        before = M.REGISTRY_PROMOTIONS.value
+        primary.kill()
+        t0 = time.monotonic()
+        assert wait_for(lambda: standby.manager.role == PRIMARY, timeout=10)
+        # Within one primary lease TTL (+ watchdog tick + slack).
+        assert time.monotonic() - t0 < 0.4 * 4 + 1.0
+        assert M.REGISTRY_PROMOTIONS.value == before + 1
+        set_value(standby.addr, "host-1/address", "b:1")  # now writable
+
+    def test_promotion_does_not_resurrect_dead_controller(self, pair_factory):
+        """The acceptance criterion's hard half: a controller whose lease
+        expired BEFORE the failover stays STALE on the promoted standby;
+        one with a live replicated lease stays ALIVE (boot grace applies
+        only to lease-less keys)."""
+        primary, standby = pair_factory(primary_lease=0.4, boot_grace=30.0)
+        set_value(primary.addr, "dead/address", "d:1", lease=0.3)
+        set_value(primary.addr, "live/address", "l:1", lease=30)
+        set_value(primary.addr, "pinned/other", "x")  # non-controller layout
+        assert wait_for(lambda: standby.service.db.get("live/address") == "l:1")
+        assert wait_for(  # dead's replicated lease expires on the standby
+            lambda: not standby.service.leases.alive("dead/address"), timeout=5)
+        primary.kill()
+        assert wait_for(lambda: standby.manager.role == PRIMARY, timeout=10)
+        with standby.stub_channel() as ch:
+            stub = RegistryStub(ch)
+            live = {v.path for v in stub.GetValues(
+                pb.GetValuesRequest(path=""), timeout=10).values}
+            stale = {v.path for v in stub.GetValues(
+                pb.GetValuesRequest(path="", include_stale=True),
+                timeout=10).values}
+        assert "live/address" in live
+        assert "dead/address" not in live  # NOT resurrected by boot grace
+        assert "dead/address" in stale  # still inspectable
+        # Non-controller layouts stay permanent.
+        assert standby.service.leases.remaining("pinned/other") is None
+
+    def test_promotion_preserves_admin_pinned_controller_keys(
+            self, pair_factory):
+        """'Operator pins survive any heartbeat failure' must survive a
+        failover too: a SYNCED standby knows the pin is permanent, so
+        promotion must NOT wrap it in a boot-grace lease that expires
+        150s later with nothing heartbeating it."""
+        primary, standby = pair_factory(primary_lease=0.4, boot_grace=0.5)
+        set_value(primary.addr, "pin9/address", "pinned:1")  # admin, no lease
+        assert wait_for(
+            lambda: standby.service.db.get("pin9/address") == "pinned:1")
+        primary.kill()
+        assert wait_for(lambda: standby.manager.role == PRIMARY, timeout=10)
+        assert standby.service.leases.remaining("pin9/address") is None
+        time.sleep(0.7)  # past the (wrongly-granted) grace, were there one
+        with standby.stub_channel() as ch:
+            reply = RegistryStub(ch).GetValues(
+                pb.GetValuesRequest(path="pin9"), timeout=10)
+            assert [v.value for v in reply.values] == ["pinned:1"]
+
+    def test_standby_lease_zero_disables_auto_promotion(self, pair_factory):
+        """--primary-lease-seconds 0 on the STANDBY means manual-promote
+        only, even though the primary advertises its own nonzero lease
+        over the stream (the operator's split-brain stance wins)."""
+        primary, standby = pair_factory(start=False)
+        primary.manager.primary_lease_seconds = 0.4
+        standby.manager.primary_lease_seconds = 0.0
+        primary.manager.start(initial_probe=False)
+        standby.manager.start(initial_probe=False)
+        set_value(primary.addr, "host-0/address", "a:1", lease=30)
+        assert wait_for(
+            lambda: standby.service.db.get("host-0/address") == "a:1")
+        primary.kill()
+        time.sleep(2.0)  # several advertised leases past
+        assert standby.manager.role == STANDBY
+        assert standby.manager.promote(reason="manual")  # still possible
+
+    def test_fresh_empty_standby_never_auto_promotes(self):
+        """A standby with NO replicated state (fresh pod, primary briefly
+        unreachable) must not auto-promote: its empty snapshot would wipe
+        the healthy primary after the epoch-forced demotion. Manual
+        promotion stays possible."""
+        svc = RegistryService(db=MemRegistryDB())
+        srv = registry_server("tcp://localhost:0", svc)
+        mgr = ReplicationManager(
+            svc, peer="localhost:1", role=STANDBY,  # dead peer
+            primary_lease_seconds=0.2)
+        try:
+            mgr.start(initial_probe=False)
+            time.sleep(1.0)  # several leases past
+            assert mgr.role == STANDBY
+            assert mgr.promote(reason="operator override")  # manual works
+        finally:
+            mgr.stop()
+            srv.force_stop()
+
+    def test_partial_snapshot_does_not_arm_auto_promotion(self,
+                                                          pair_factory):
+        """A fresh standby whose only DB contents are a PARTIALLY applied
+        snapshot (primary died mid-snapshot) holds a fragment, not a
+        replica: promoting on it would wipe the missing keys cluster-wide
+        at the old primary's resync."""
+        primary, standby = pair_factory(start=False, primary_lease=0.3)
+        for i in range(5):
+            set_value(primary.addr, f"k{i}/address", f"v{i}", lease=30)
+        # Sever every stream at SNAPSHOT_END: KV records apply (DB fills)
+        # but no snapshot ever completes.
+        faultinject.arm("replication.apply", kind=4)
+        primary.manager.start(initial_probe=False)
+        standby.manager.start(initial_probe=False)
+        assert wait_for(
+            lambda: bool(standby.service.db.get("k0/address")))
+        primary.kill()
+        time.sleep(1.5)  # several leases past
+        assert standby.manager.role == STANDBY  # fragment must not promote
+
+    def test_standby_with_journal_state_auto_promotes_without_peer(self,
+                                                                   tmp_path):
+        """The inverse guard: a restarted standby whose journal replay
+        holds real state IS a replica and may take over a dead pair."""
+        db = FileRegistryDB(str(tmp_path / "s.journal"))
+        db.set("host-0/address", "a:1")
+        svc = RegistryService(db=db)
+        srv = registry_server("tcp://localhost:0", svc)
+        mgr = ReplicationManager(
+            svc, peer="localhost:1", role=STANDBY,
+            primary_lease_seconds=0.2)
+        try:
+            mgr.start(initial_probe=False)
+            assert wait_for(lambda: mgr.role == PRIMARY, timeout=10)
+        finally:
+            mgr.stop()
+            srv.force_stop()
+
+    def test_both_standby_pair_converges_to_one_primary(self, pair_factory):
+        """Operator error / rejoin race: both nodes standby, both alive.
+        Peer HELLOs must not count as primary liveness (that would
+        deadlock the pair rejecting all writes forever); the watchdogs
+        fire, and the epoch/log_id machinery settles on EXACTLY one
+        primary."""
+        primary, standby = pair_factory(primary_lease=0.4)
+        set_value(primary.addr, "host-0/address", "a:1", lease=30)
+        assert wait_for(
+            lambda: standby.service.db.get("host-0/address") == "a:1")
+        primary.manager.demote(primary.manager.epoch, reason="test: force")
+        assert primary.manager.role == STANDBY
+
+        def roles():
+            return sorted((primary.manager.role, standby.manager.role))
+
+        assert wait_for(lambda: roles() == [PRIMARY, STANDBY], timeout=15)
+        # Stable: still exactly one primary a couple of lease periods on.
+        time.sleep(1.0)
+        assert roles() == [PRIMARY, STANDBY]
+
+    def test_rejoining_old_primary_demotes_at_boot_probe(self, pair_factory,
+                                                         tmp_path):
+        p_state = str(tmp_path / "p.repl")
+        primary, standby = pair_factory(
+            primary_lease=0.3, p_state=p_state)
+        # The standby must have synced before it is allowed to take over
+        # (the empty-takeover guard).
+        set_value(primary.addr, "host-0/address", "a:1", lease=30)
+        assert wait_for(
+            lambda: standby.service.db.get("host-0/address") == "a:1")
+        primary.kill()
+        assert wait_for(lambda: standby.manager.role == PRIMARY, timeout=10)
+        # "Restart" the old primary: a fresh service+manager on the old
+        # sidecar (epoch 0) with role=primary, pointed at the promoted
+        # standby. The boot probe must demote it before it serves writes.
+        svc2 = RegistryService(db=MemRegistryDB())
+        srv2 = registry_server("tcp://localhost:0", svc2)
+        mgr2 = ReplicationManager(
+            svc2, peer=standby.addr, role=PRIMARY,
+            primary_lease_seconds=0.3, state_file=p_state)
+        try:
+            mgr2.start(initial_probe=True)
+            assert mgr2.role == STANDBY
+            assert mgr2.epoch == standby.manager.epoch
+        finally:
+            mgr2.stop()
+            srv2.force_stop()
+
+
+class TestJournalEdgeCases:
+    def test_torn_tail_standby_journal_then_catch_up(self, pair_factory,
+                                                     tmp_path):
+        """A standby restarting after a crash mid-append: the torn tail is
+        skipped at replay, and the replication stream (catch-up from
+        offset 0 — a fresh follower state) restores full state."""
+        s_path = str(tmp_path / "standby.journal")
+        db = FileRegistryDB(s_path)
+        db.set("stale/address", "old:1")
+        db.close()
+        with open(s_path, "a", encoding="utf-8") as f:
+            f.write('{"k": "torn/address"')  # crash mid-append: no newline
+        s_db = FileRegistryDB(s_path)
+        assert s_db.get("torn/address") == ""  # torn record not replayed
+        primary, standby = pair_factory(primary_lease=0, s_db=s_db)
+        set_value(primary.addr, "host-0/address", "a:1", lease=30)
+        assert wait_for(
+            lambda: standby.service.db.get("host-0/address") == "a:1")
+        # The snapshot removed the stale key the primary never had.
+        assert wait_for(
+            lambda: standby.service.db.get("stale/address") == "")
+
+    def test_standby_compaction_during_live_stream(self, pair_factory,
+                                                   tmp_path):
+        """The snapshot apply compacts the standby's journal while the
+        stream stays live; subsequent records append and survive a
+        reopen."""
+        s_db = FileRegistryDB(str(tmp_path / "s.journal"))
+        # Pre-existing divergent state makes the snapshot delete + rewrite.
+        for i in range(20):
+            s_db.set(f"old-{i}/address", "x:1")
+        primary, standby = pair_factory(primary_lease=0, s_db=s_db)
+        set_value(primary.addr, "host-0/address", "a:1", lease=30)
+        assert wait_for(
+            lambda: standby.service.db.get("host-0/address") == "a:1")
+        assert wait_for(
+            lambda: standby.service.db.get("old-0/address") == "")
+
+        def journal_lines():
+            with open(s_db.path, encoding="utf-8") as f:
+                return sum(1 for _ in f)
+
+        # SNAPSHOT_END compacts the snapshot-apply churn (20 pre-existing
+        # sets + 20 deletes) down to exactly the one live key.
+        assert wait_for(lambda: journal_lines() == 1)
+        compacted = s_db.journal_bytes()
+        # Stream still live after compaction: new records apply + persist.
+        set_value(primary.addr, "host-1/address", "b:1", lease=30)
+        assert wait_for(
+            lambda: standby.service.db.get("host-1/address") == "b:1")
+        assert s_db.journal_bytes() > compacted
+        standby.kill()
+        db2 = FileRegistryDB(str(tmp_path / "s.journal"))
+        assert db2.get("host-0/address") == "a:1"
+        assert db2.get("host-1/address") == "b:1"
+        assert db2.get("old-0/address") == ""
+        db2.close()
+
+    def test_standby_restart_catches_up_from_offset_zero(self, pair_factory):
+        primary, standby = pair_factory(primary_lease=0, start=False)
+        primary.manager.start(initial_probe=False)
+        standby.manager.start(initial_probe=False)
+        set_value(primary.addr, "host-0/address", "a:1", lease=30)
+        assert wait_for(
+            lambda: standby.service.db.get("host-0/address") == "a:1")
+        # Kill the standby, mutate the primary, then bring up a FRESH
+        # standby (offset 0, empty log id) on the same primary.
+        standby.kill()
+        set_value(primary.addr, "host-0/address", "moved:1", lease=30)
+        set_value(primary.addr, "host-1/address", "b:1", lease=30)
+        svc2 = RegistryService(db=MemRegistryDB())
+        srv2 = registry_server("tcp://localhost:0", svc2)
+        mgr2 = ReplicationManager(
+            svc2, peer=primary.addr, role=STANDBY, primary_lease_seconds=0)
+        try:
+            mgr2.start(initial_probe=False)
+            assert wait_for(lambda: svc2.db.get("host-0/address") == "moved:1")
+            assert wait_for(lambda: svc2.db.get("host-1/address") == "b:1")
+        finally:
+            mgr2.stop()
+            srv2.force_stop()
+
+
+class TestHealthz:
+    def _get(self, port):
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=5) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as err:
+            return err.code, json.loads(err.read())
+
+    def test_unreplicated_registry_is_healthy(self):
+        hz = HealthzServer(None, port=0, host="127.0.0.1").start()
+        try:
+            code, body = self._get(hz.port)
+            assert code == 200 and body["role"] == "PRIMARY"
+        finally:
+            hz.stop()
+
+    def test_primary_200_standby_tracks_lag(self, pair_factory):
+        primary, standby = pair_factory(primary_lease=0.4)
+        hz_p = HealthzServer(primary.manager, port=0, host="127.0.0.1",
+                             max_lag_seconds=5.0).start()
+        hz_s = HealthzServer(standby.manager, port=0, host="127.0.0.1",
+                             max_lag_seconds=5.0).start()
+        try:
+            code, body = self._get(hz_p.port)
+            assert code == 200 and body["role"] == "PRIMARY"
+            code, body = self._get(hz_s.port)
+            assert code == 200 and body["role"] == "STANDBY"
+        finally:
+            hz_p.stop()
+            hz_s.stop()
+
+    def test_laggy_standby_503_but_livez_stays_200(self, pair_factory):
+        primary, standby = pair_factory(primary_lease=0)  # no auto-promote
+        hz = HealthzServer(standby.manager, port=0, host="127.0.0.1",
+                           max_lag_seconds=0.2).start()
+        try:
+            primary.kill()  # stream dies; lag_seconds grows
+            assert wait_for(lambda: self._get(hz.port)[0] == 503, timeout=10)
+            # Liveness is lag-blind: restarting a lagging standby during a
+            # primary outage would destroy the replica when it's needed.
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{hz.port}/livez", timeout=5) as resp:
+                assert resp.status == 200
+        finally:
+            hz.stop()
+
+
+class TestOimctl:
+    def test_health_gains_registry_row(self, pair_factory, capsys):
+        from oim_tpu.cli import oimctl
+
+        primary, standby = pair_factory(primary_lease=0)
+        set_value(primary.addr, "host-0/address", "a:1", lease=30)
+        assert wait_for(
+            lambda: standby.service.db.get("host-0/address") == "a:1")
+        oimctl.main(["--registry", primary.addr, "--health"])
+        out = capsys.readouterr().out.splitlines()
+        assert out[0].startswith("_registry\tPRIMARY\tepoch=0")
+        assert out[1].startswith("host-0\tALIVE\ta:1")
+        # --stale (and --health) work against the STANDBY endpoint too.
+        oimctl.main(["--registry", standby.addr, "--health"])
+        out = capsys.readouterr().out.splitlines()
+        assert out[0].startswith("_registry\tSTANDBY")
+        assert out[1].startswith("host-0\tALIVE")
+        oimctl.main(["--registry", standby.addr, "--get", "", "--stale"])
+        assert "host-0/address=a:1" in capsys.readouterr().out
+
+    def test_get_fails_over_to_standby(self, pair_factory, capsys):
+        from oim_tpu.cli import oimctl
+
+        primary, standby = pair_factory(primary_lease=0)
+        set_value(primary.addr, "host-0/address", "a:1", lease=30)
+        assert wait_for(
+            lambda: standby.service.db.get("host-0/address") == "a:1")
+        primary.kill()
+        oimctl.main([
+            "--registry", f"{primary.addr},{standby.addr}", "--get", ""])
+        assert "host-0/address=a:1" in capsys.readouterr().out
+
+    def test_promote_targets_the_standby(self, pair_factory, capsys):
+        from oim_tpu.cli import oimctl
+
+        primary, standby = pair_factory(primary_lease=0)
+        oimctl.main([
+            "--registry", f"{primary.addr},{standby.addr}", "--promote"])
+        assert standby.manager.role == PRIMARY
+        assert "promoted" in capsys.readouterr().out
+
+    def test_promote_without_standby_fails_loudly(self, pair_factory):
+        """No STANDBY among the endpoints (only the primary is up, or the
+        registry is unreplicated): --promote must error, not print
+        success after a no-op."""
+        from oim_tpu.cli import oimctl
+
+        primary, standby = pair_factory(primary_lease=0)
+        standby.kill()
+        with pytest.raises(SystemExit, match="no STANDBY"):
+            oimctl.main(["--registry", primary.addr, "--promote"])
+        assert primary.manager.role == PRIMARY
+        # Unreplicated registry: same loud failure, and no junk
+        # "registry/promote" key gets written.
+        svc = RegistryService(db=MemRegistryDB())
+        srv = registry_server("tcp://localhost:0", svc)
+        try:
+            with pytest.raises(SystemExit, match="no STANDBY"):
+                oimctl.main(["--registry", srv.addr, "--promote"])
+            assert svc.db.get("registry/promote") == ""
+        finally:
+            srv.force_stop()
+
+
+class TestClientFailover:
+    def test_controller_heartbeats_fail_over(self, pair_factory):
+        primary, standby = pair_factory(primary_lease=0.4)
+        controller = Controller(
+            controller_id="host-0", backend=MallocBackend(),
+            controller_address="c:1",
+            registry_address=f"{primary.addr},{standby.addr}",
+            registry_delay=0.1,
+        )
+        controller.start()
+        try:
+            assert wait_for(
+                lambda: standby.service.db.get("host-0/address") == "c:1")
+            primary.kill()
+            assert wait_for(lambda: standby.manager.role == PRIMARY,
+                            timeout=10)
+            # Heartbeats land on the promoted standby and keep the lease
+            # alive well past its TTL.
+            time.sleep(controller.lease_seconds * 3)
+            assert wait_for(
+                lambda: standby.service.leases.alive("host-0/address"),
+                timeout=5)
+        finally:
+            controller.stop()
+
+    def test_publish_fails_over_to_standby_registry(self, pair_factory,
+                                                    tmp_path):
+        primary, standby = pair_factory(primary_lease=0.3)
+        svc = ControllerService(MallocBackend())
+        ctl_srv = controller_server("tcp://localhost:0", svc)
+        try:
+            set_value(primary.addr, "host-0/address", ctl_srv.addr, lease=60)
+            set_value(primary.addr, "host-0/mesh", "0,0,0", lease=60)
+            assert wait_for(
+                lambda: standby.service.db.get("host-0/address") == ctl_srv.addr)
+            primary.kill()
+            assert wait_for(lambda: standby.manager.role == PRIMARY,
+                            timeout=10)
+            data = np.arange(512, dtype=np.int32)
+            path = tmp_path / "v.npy"
+            np.save(path, data)
+            feeder = Feeder(
+                registry_address=f"{primary.addr},{standby.addr}",
+                controller_id="host-0")
+            pub = feeder.publish(pb.MapVolumeRequest(
+                volume_id="v",
+                file=pb.FileParams(path=str(path), format="npy"),
+            ), timeout=30)
+            assert pub.bytes == data.nbytes
+            assert feeder.controller_id == "host-0"  # registry-level only
+        finally:
+            ctl_srv.force_stop()
+
+    def test_fetch_window_survives_registry_death_without_restaging(
+            self, pair_factory, tmp_path):
+        """Only the registry dies; the controller keeps its volume. The
+        healed window must route through the standby's proxy WITHOUT
+        restaging or controller failover."""
+        primary, standby = pair_factory(primary_lease=0.3)
+        svc = ControllerService(MallocBackend())
+        ctl_srv = controller_server("tcp://localhost:0", svc)
+        try:
+            set_value(primary.addr, "host-0/address", ctl_srv.addr, lease=60)
+            set_value(primary.addr, "host-0/mesh", "0,0,0", lease=60)
+            assert wait_for(
+                lambda: standby.service.db.get("host-0/address") == ctl_srv.addr)
+            data = np.random.RandomState(5).bytes(40_000)
+            path = tmp_path / "vol.bin"
+            path.write_bytes(data)
+            feeder = Feeder(
+                registry_address=f"{primary.addr},{standby.addr}",
+                controller_id="host-0")
+            feeder.publish(pb.MapVolumeRequest(
+                volume_id="vol",
+                file=pb.FileParams(path=str(path), format="raw"),
+            ))
+            volume_before = svc.get_volume("vol")
+            w, total, _ = feeder.fetch_window("vol", 0, 10_000, heal=True)
+            assert w.tobytes() == data[:10_000]
+
+            primary.kill()
+            failovers_before = M.FEEDER_FAILOVERS.value
+            w2, total2, _ = feeder.fetch_window(
+                "vol", 10_000, 10_000, timeout=30, heal=True)
+            assert w2.tobytes() == data[10_000:20_000]
+            assert total2 == len(data)
+            # Same staged volume object: nothing was restaged, and no
+            # controller-level failover fired.
+            assert svc.get_volume("vol") is volume_before
+            assert M.FEEDER_FAILOVERS.value == failovers_before
+            assert feeder.controller_id == "host-0"
+        finally:
+            ctl_srv.force_stop()
+
+    def test_wait_for_hosts_redials_to_standby(self, pair_factory):
+        from oim_tpu.parallel.bootstrap import wait_for_hosts
+
+        primary, standby = pair_factory(primary_lease=0)
+        set_value(primary.addr, "host-0/address", "a:1", lease=60)
+        assert wait_for(
+            lambda: standby.service.db.get("host-0/address") == "a:1")
+        primary.kill()
+        endpoints = RegistryEndpoints(f"{primary.addr},{standby.addr}")
+        state = {"ch": grpc.insecure_channel(endpoints.current())}
+
+        def redial():
+            state["ch"].close()
+            state["ch"] = grpc.insecure_channel(endpoints.advance())
+            return RegistryStub(state["ch"])
+
+        try:
+            entries = wait_for_hosts(
+                RegistryStub(state["ch"]), 1, timeout=15, poll=0.05,
+                redial=redial)
+            assert entries["host-0/address"] == "a:1"
+        finally:
+            state["ch"].close()
+
+
+class TestAcceptance:
+    def test_kill_primary_mid_stream_full_scenario(self, pair_factory,
+                                                   tmp_path):
+        """The ISSUE acceptance scenario, in-process: primary + standby +
+        one live controller + one controller killed beforehand + a feeder
+        streaming windows. Kill the primary mid-stream: heartbeats fail
+        over, the standby auto-promotes within one primary lease TTL, the
+        window completes without restaging, and the promoted registry
+        shows the live controller ALIVE / the pre-killed one STALE."""
+        primary, standby = pair_factory(primary_lease=0.4, boot_grace=30.0)
+        registry_list = f"{primary.addr},{standby.addr}"
+        live = Controller(
+            controller_id="host-0", backend=MallocBackend(),
+            controller_address="pending", registry_address=registry_list,
+            registry_delay=0.2,  # lease TTL 0.5s
+        )
+        live_srv = controller_server("tcp://localhost:0", live.service)
+        live.controller_address = live_srv.addr
+        dead = Controller(
+            controller_id="host-dead", backend=MallocBackend(),
+            controller_address="dead:1", registry_address=registry_list,
+            registry_delay=0.2,
+        )
+        try:
+            live.start()
+            dead.start()
+            assert wait_for(
+                lambda: standby.service.db.get("host-0/address") == live_srv.addr
+                and standby.service.db.get("host-dead/address") == "dead:1")
+            # Kill host-dead BEFORE the failover; let its lease expire on
+            # both registries.
+            dead.stop()
+            assert wait_for(
+                lambda: not standby.service.leases.alive("host-dead/address"),
+                timeout=5)
+
+            data = np.random.RandomState(11).bytes(60_000)
+            vol = tmp_path / "vol.bin"
+            vol.write_bytes(data)
+            feeder = Feeder(registry_address=registry_list,
+                            controller_id="host-0")
+            feeder.publish(pb.MapVolumeRequest(
+                volume_id="acc",
+                file=pb.FileParams(path=str(vol), format="raw"),
+            ))
+            volume_before = live.service.get_volume("acc")
+            w, _, _ = feeder.fetch_window("acc", 0, 20_000, heal=True)
+            assert w.tobytes() == data[:20_000]
+
+            primary.kill()  # mid-stream
+            t_kill = time.monotonic()
+            w2, total, _ = feeder.fetch_window(
+                "acc", 20_000, 20_000, timeout=30, heal=True)
+            assert w2.tobytes() == data[20_000:40_000]
+            assert total == len(data)
+            assert live.service.get_volume("acc") is volume_before  # no restage
+
+            assert wait_for(lambda: standby.manager.role == PRIMARY,
+                            timeout=10)
+            promote_latency = time.monotonic() - t_kill
+            assert promote_latency < 0.4 * 4 + 1.0
+
+            # Controller heartbeats fail over; its lease stays warm on the
+            # promoted registry (ALIVE, lease intact) while the pre-killed
+            # controller stays STALE — no boot-grace resurrection.
+            from oim_tpu.cli.oimctl import health_rows
+
+            def rows():
+                with standby.stub_channel() as ch:
+                    return {r[0]: r[1] for r in health_rows(RegistryStub(ch))}
+
+            assert wait_for(lambda: rows().get("host-0") == "ALIVE",
+                            timeout=10)
+            assert rows().get("host-dead") == "STALE"
+            time.sleep(live.lease_seconds * 2)  # several heartbeat cycles
+            assert wait_for(lambda: rows().get("host-0") == "ALIVE",
+                            timeout=5)
+        finally:
+            live.stop()
+            dead.stop()
+            live_srv.force_stop()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+class TestAcceptanceMultiProcess:
+    """The same scenario with REAL registry processes and SIGKILL — the
+    multi-process failover acceptance test (excluded from the tier-1
+    smoke gate by the ``slow`` marker)."""
+
+    def _spawn_registry(self, tmp_path, name, port, peer_port, role,
+                        healthz_port):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+        log = open(tmp_path / f"{name}.log", "w")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "oim_tpu.cli.oim_registry",
+             "--endpoint", f"tcp://127.0.0.1:{port}",
+             "--db-file", str(tmp_path / f"{name}.journal"),
+             "--peer", f"127.0.0.1:{peer_port}",
+             "--role", role,
+             "--primary-lease-seconds", "1.0",
+             "--boot-grace-seconds", "30",
+             "--healthz-port", str(healthz_port)],
+            env=env, stdout=log, stderr=subprocess.STDOUT,
+        )
+        return proc
+
+    def test_sigkill_primary_fails_over(self, tmp_path):
+        p_port, s_port = _free_port(), _free_port()
+        p_hz, s_hz = _free_port(), _free_port()
+        p_proc = self._spawn_registry(
+            tmp_path, "primary", p_port, s_port, "primary", p_hz)
+        s_proc = self._spawn_registry(
+            tmp_path, "standby", s_port, p_port, "standby", s_hz)
+        registry_list = f"127.0.0.1:{p_port},127.0.0.1:{s_port}"
+        controller = None
+        ctl_srv = None
+        try:
+            def serving(port):
+                try:
+                    with grpc.insecure_channel(f"127.0.0.1:{port}") as ch:
+                        RegistryStub(ch).GetValues(
+                            pb.GetValuesRequest(path=""), timeout=2)
+                    return True
+                except grpc.RpcError:
+                    return False
+
+            assert wait_for(lambda: serving(p_port), timeout=30)
+            assert wait_for(lambda: serving(s_port), timeout=30)
+
+            controller = Controller(
+                controller_id="host-0", backend=MallocBackend(),
+                controller_address="pending",
+                registry_address=registry_list, registry_delay=0.3,
+            )
+            ctl_srv = controller_server(
+                "tcp://localhost:0", controller.service)
+            controller.controller_address = ctl_srv.addr
+            controller.start()
+
+            def standby_has_key():
+                try:
+                    with grpc.insecure_channel(f"127.0.0.1:{s_port}") as ch:
+                        reply = RegistryStub(ch).GetValues(
+                            pb.GetValuesRequest(path="host-0"), timeout=2)
+                    return any(v.path == "host-0/address" for v in reply.values)
+                except grpc.RpcError:
+                    return False
+
+            assert wait_for(standby_has_key, timeout=30)
+
+            data = np.random.RandomState(3).bytes(50_000)
+            vol = tmp_path / "v.bin"
+            vol.write_bytes(data)
+            feeder = Feeder(registry_address=registry_list,
+                            controller_id="host-0")
+            feeder.publish(pb.MapVolumeRequest(
+                volume_id="mp",
+                file=pb.FileParams(path=str(vol), format="raw"),
+            ), timeout=30)
+            w, _, _ = feeder.fetch_window("mp", 0, 10_000, heal=True)
+            assert w.tobytes() == data[:10_000]
+
+            os.kill(p_proc.pid, signal.SIGKILL)
+            p_proc.wait(timeout=10)
+
+            # The window completes through the standby without restaging.
+            volume_before = controller.service.get_volume("mp")
+            w2, total, _ = feeder.fetch_window(
+                "mp", 10_000, 10_000, timeout=60, heal=True)
+            assert w2.tobytes() == data[10_000:20_000]
+            assert total == len(data)
+            assert controller.service.get_volume("mp") is volume_before
+
+            # The standby promotes within ~one primary lease and reports
+            # PRIMARY on /healthz and in the status keys.
+            def promoted():
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{s_hz}/healthz",
+                            timeout=2) as resp:
+                        return json.loads(resp.read())["role"] == "PRIMARY"
+                except Exception:
+                    return False
+
+            assert wait_for(promoted, timeout=15)
+
+            # Controller heartbeats fail over: the lease stays ALIVE on
+            # the promoted registry.
+            from oim_tpu.cli.oimctl import health_rows
+
+            def rows():
+                try:
+                    with grpc.insecure_channel(f"127.0.0.1:{s_port}") as ch:
+                        return {r[0]: r[1]
+                                for r in health_rows(RegistryStub(ch))}
+                except grpc.RpcError:
+                    return {}
+
+            assert wait_for(lambda: rows().get("host-0") == "ALIVE",
+                            timeout=15)
+        finally:
+            if controller is not None:
+                controller.stop()
+            if ctl_srv is not None:
+                ctl_srv.force_stop()
+            for proc in (p_proc, s_proc):
+                if proc.poll() is None:
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
