@@ -9,7 +9,7 @@
 PY ?= python
 RUFF := $(shell command -v ruff 2>/dev/null)
 
-.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo
+.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo
 
 # drift and tsan are standalone conveniences; the full pytest target
 # already runs both (SpecDrift + the TSAN stream test build in-fixture).
@@ -49,6 +49,12 @@ demo:
 replication-demo:
 	bash scripts/replication_demo.sh demo
 
+# Distributed-tracing demo: registry + controller + feeder one-window run
+# with --trace-dir; merges the per-process Chrome traces and fails unless
+# one trace_id spans >= 3 processes. Artifacts in _demo_trace/.
+trace-demo:
+	$(PY) scripts/trace_demo.py
+
 start:
 	bash scripts/demo_cluster.sh start
 
@@ -57,4 +63,4 @@ stop:
 
 clean:
 	$(MAKE) -C native clean
-	rm -rf _demo _demo_repl
+	rm -rf _demo _demo_repl _demo_trace

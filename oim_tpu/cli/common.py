@@ -35,6 +35,14 @@ def add_common_flags(parser: argparse.ArgumentParser) -> None:
         default="info",
         help="debug|info|warning|error (reference -log.level flag)",
     )
+    parser.add_argument(
+        "--log-format",
+        default="text",
+        choices=oim_logging.FORMATS,
+        help="text = '<time> <level> <msg> | k: v'; json = one JSON object "
+             "per line with fields flattened (log aggregators); trace_id "
+             "appears as a field in both when telemetry binds it",
+    )
     parser.add_argument("--ca", default="", help="CA certificate file (mTLS)")
     parser.add_argument(
         "--key",
@@ -43,9 +51,67 @@ def add_common_flags(parser: argparse.ArgumentParser) -> None:
     )
 
 
+def add_observability_flags(parser: argparse.ArgumentParser) -> None:
+    """--metrics-port / --metrics-host / --trace-dir, shared by all four
+    daemons (registry, controller, feeder, trainer)."""
+    parser.add_argument(
+        "--metrics-port", type=int, default=-1,
+        help=">=0 serves GET /metrics (Prometheus text) and GET "
+             "/debug/spans (span ring buffer, Chrome trace JSON); "
+             "0 = ephemeral port",
+    )
+    parser.add_argument(
+        "--metrics-host", default="127.0.0.1",
+        help="bind address for the metrics server; 0.0.0.0 lets Prometheus "
+             "scrape from another pod (default loopback)",
+    )
+    parser.add_argument(
+        "--trace-dir", default="",
+        help="stream finished spans into <dir>/<service>-<pid>.trace.json "
+             "(Chrome trace-event JSON: open in Perfetto / chrome://tracing; "
+             "merge processes with scripts/trace_demo.py)",
+    )
+
+
+class Observability:
+    """Started telemetry for one daemon: span recorder + metrics server."""
+
+    def __init__(self, server, recorder):
+        self.server = server  # MetricsServer | None
+        self.recorder = recorder
+
+    def stop(self) -> None:
+        self.recorder.flush()
+        self.recorder.close()
+        if self.server is not None:
+            self.server.stop()
+
+
+def start_observability(args: argparse.Namespace, service: str) -> Observability:
+    """Configure the process-global span recorder (service names the
+    Perfetto process) and start the metrics server when requested."""
+    from oim_tpu.common import tracing
+    from oim_tpu.common.logging import from_context
+
+    recorder = tracing.configure(
+        service, trace_dir=getattr(args, "trace_dir", ""))
+    server = None
+    if getattr(args, "metrics_port", -1) >= 0:
+        from oim_tpu.common.metrics import MetricsServer
+
+        server = MetricsServer(
+            port=args.metrics_port, host=args.metrics_host).start()
+        from_context().info(
+            "metrics", host=server.host, port=server.port)
+    return Observability(server, recorder)
+
+
 def setup_logging(args: argparse.Namespace) -> None:
     oim_logging.set_global(
-        oim_logging.Logger(level=oim_logging.parse_level(args.log_level))
+        oim_logging.Logger(
+            level=oim_logging.parse_level(args.log_level),
+            fmt=getattr(args, "log_format", "text"),
+        )
     )
 
 

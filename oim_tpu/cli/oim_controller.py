@@ -6,9 +6,11 @@ import argparse
 
 from oim_tpu.cli.common import (
     add_common_flags,
+    add_observability_flags,
     add_registry_flag,
     load_tls_flags,
     setup_logging,
+    start_observability,
 )
 from oim_tpu.common.meshcoord import MeshCoord
 from oim_tpu.controller import Controller, MallocBackend, TPUBackend, controller_server
@@ -66,8 +68,10 @@ def main(argv: list[str] | None = None) -> int:
              "chip)",
     )
     add_common_flags(parser)
+    add_observability_flags(parser)
     args = parser.parse_args(argv)
     setup_logging(args)
+    obs = start_observability(args, "oim-controller")
     tls = load_tls_flags(args)
     backend = (
         TPUBackend(mesh=_device_mesh(args.device_mesh))
@@ -91,6 +95,8 @@ def main(argv: list[str] | None = None) -> int:
     except KeyboardInterrupt:
         controller.stop()
         server.stop()
+    finally:
+        obs.stop()
     return 0
 
 

@@ -15,9 +15,11 @@ import argparse
 
 from oim_tpu.cli.common import (
     add_common_flags,
+    add_observability_flags,
     add_registry_flag,
     load_tls_flags,
     setup_logging,
+    start_observability,
 )
 from oim_tpu.common.logging import from_context
 from oim_tpu.feeder import Feeder, FeederDaemon, feeder_server
@@ -42,8 +44,10 @@ def main(argv: list[str] | None = None) -> int:
                              "placements, e.g. data=4,model=2")
     parser.add_argument("--publish-timeout", type=float, default=60.0)
     add_common_flags(parser)
+    add_observability_flags(parser)
     args = parser.parse_args(argv)
     setup_logging(args)
+    obs = start_observability(args, "oim-feeder")
     log = from_context()
 
     local = bool(args.backend)
@@ -84,6 +88,8 @@ def main(argv: list[str] | None = None) -> int:
         server.wait()
     except KeyboardInterrupt:
         server.stop()
+    finally:
+        obs.stop()
     return 0
 
 

@@ -11,7 +11,13 @@ from __future__ import annotations
 
 import argparse
 
-from oim_tpu.cli.common import add_common_flags, load_tls_flags, setup_logging
+from oim_tpu.cli.common import (
+    add_common_flags,
+    add_observability_flags,
+    load_tls_flags,
+    setup_logging,
+    start_observability,
+)
 from oim_tpu.registry import MemRegistryDB, RegistryService
 from oim_tpu.registry.db import FileRegistryDB
 from oim_tpu.registry.registry import registry_server
@@ -65,8 +71,10 @@ def main(argv: list[str] | None = None) -> int:
         help="replication lag above which a standby's /healthz turns 503",
     )
     add_common_flags(parser)
+    add_observability_flags(parser)
     args = parser.parse_args(argv)
     setup_logging(args)
+    obs = start_observability(args, "oim-registry")
     if args.role == "standby" and not args.peer:
         raise SystemExit("--role standby requires --peer")
     db = FileRegistryDB(args.db_file) if args.db_file else MemRegistryDB()
@@ -112,6 +120,7 @@ def main(argv: list[str] | None = None) -> int:
         close = getattr(db, "close", None)
         if close is not None:
             close()
+        obs.stop()
     return 0
 
 

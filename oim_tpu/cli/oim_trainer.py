@@ -18,9 +18,11 @@ import argparse
 
 from oim_tpu.cli.common import (
     add_common_flags,
+    add_observability_flags,
     add_registry_flag,
     load_tls_flags,
     setup_logging,
+    start_observability,
 )
 from oim_tpu.common.logging import from_context
 # The feed layer lives in oim_tpu/data/feeds.py (the CLI is flag
@@ -112,8 +114,6 @@ def main(argv: list[str] | None = None) -> int:
                              "--eval-every: token shards for llama models "
                              "(--wds-ext), jpg/cls shards for vision "
                              "(the config-5 eval path)")
-    parser.add_argument("--metrics-port", type=int, default=-1,
-                        help=">=0 serves GET /metrics (0 = ephemeral port)")
     parser.add_argument("--smoke", action="store_true",
                         help="tiny model, 5 steps, CPU-friendly")
     # Data source (feeder mode).
@@ -168,8 +168,10 @@ def main(argv: list[str] | None = None) -> int:
              "mesh via --xla_force_host_platform_device_count)",
     )
     add_common_flags(parser)
+    add_observability_flags(parser)
     args = parser.parse_args(argv)
     setup_logging(args)
+    obs = start_observability(args, "oim-trainer")
     log = from_context()
 
     if args.platform:
@@ -233,13 +235,6 @@ def main(argv: list[str] | None = None) -> int:
         eval_steps=args.eval_steps,
         model_overrides=overrides,
     )
-
-    server = None
-    if args.metrics_port >= 0:
-        from oim_tpu.common.metrics import MetricsServer
-
-        server = MetricsServer(port=args.metrics_port).start()
-        log.info("metrics", port=server.port)
 
     data = None
     eval_data = None
@@ -332,11 +327,12 @@ def main(argv: list[str] | None = None) -> int:
     from oim_tpu.common.profiling import profile_trace
 
     trainer = Trainer(cfg, axes=parse_mesh(args.mesh))
-    with profile_trace(args.profile):
-        loss = trainer.run(steps=args.steps, data=data, eval_data=eval_data)
-    log.info("done", final_loss=round(loss, 4))
-    if server is not None:
-        server.stop()
+    try:
+        with profile_trace(args.profile):
+            loss = trainer.run(steps=args.steps, data=data, eval_data=eval_data)
+        log.info("done", final_loss=round(loss, 4))
+    finally:
+        obs.stop()
     return 0
 
 

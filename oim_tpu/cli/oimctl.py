@@ -17,7 +17,7 @@ from oim_tpu.cli.common import (
 )
 from oim_tpu.common.endpoints import FAILOVER_CODES, RegistryEndpoints
 from oim_tpu.common.pathutil import REGISTRY_ADDRESS, REGISTRY_MESH
-from oim_tpu.common.tlsutil import secure_channel
+from oim_tpu.common.tlsutil import dial
 from oim_tpu.spec import RegistryStub, pb
 
 
@@ -69,9 +69,130 @@ def registry_health_row(stub: RegistryStub) -> tuple[str, str, str, str] | None:
     return ("_registry", role, detail, entries.get("registry/peer", ""))
 
 
+def parse_prometheus_text(text: str):
+    """Prometheus text format -> (types, helps, samples) where samples is
+    [(name, {label: value}, float)]. Tolerant of anything a daemon's
+    /metrics serves; label values may contain escaped quotes/newlines."""
+    import re
+
+    types: dict[str, str] = {}
+    helps: dict[str, str] = {}
+    samples: list[tuple[str, dict[str, str], float]] = []
+    sample_re = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})?\s+(\S+)$')
+    label_re = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            types[name] = kind
+            continue
+        if line.startswith("# HELP "):
+            _, _, name, help_ = (line.split(None, 3) + [""])[:4]
+            helps[name] = help_
+            continue
+        if line.startswith("#"):
+            continue
+        m = sample_re.match(line)
+        if not m:
+            raise ValueError(f"unparseable metrics line: {line!r}")
+        # One left-to-right pass: chained str.replace would mis-decode a
+        # literal backslash followed by 'n' (\\n -> backslash+n, not \n).
+        unescape = {"n": "\n", '"': '"', "\\": "\\"}
+        labels = {
+            k: re.sub(r"\\(.)",
+                      lambda esc: unescape.get(esc.group(1), esc.group(0)), v)
+            for k, v in label_re.findall(m.group(3) or "")
+        }
+        samples.append((m.group(1), labels, float(m.group(4))))
+    return types, helps, samples
+
+
+def _histogram_quantile(buckets: list[tuple[float, float]], q: float) -> float:
+    """Linear interpolation over cumulative le-buckets (the PromQL
+    histogram_quantile estimate)."""
+    if not buckets:
+        return float("nan")
+    total = buckets[-1][1]
+    if total <= 0:
+        return float("nan")
+    rank = q * total
+    prev_bound, prev_count = 0.0, 0.0
+    for bound, count in buckets:
+        if count >= rank:
+            if bound == float("inf"):
+                return prev_bound
+            span = count - prev_count
+            frac = (rank - prev_count) / span if span else 1.0
+            return prev_bound + (bound - prev_bound) * frac
+        prev_bound, prev_count = bound, count
+    return prev_bound
+
+
+def print_metrics(target: str) -> None:
+    """GET /metrics on ``host:port`` and pretty-print: families grouped
+    with their type + help, histograms summarized as count/mean/quantile
+    estimates (the quick-scrape view; raw text is one curl away)."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+                f"http://{target}/metrics", timeout=10) as r:
+            text = r.read().decode()
+    except (urllib.error.URLError, OSError) as err:
+        raise SystemExit(f"--metrics: cannot scrape http://{target}/metrics: "
+                         f"{getattr(err, 'reason', err)}") from err
+    types, helps, samples = parse_prometheus_text(text)
+    by_family: dict[str, list[tuple[dict[str, str], float]]] = {}
+    for name, labels, value in samples:
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in types:
+                base = name[:-len(suffix)]
+                break
+        by_family.setdefault(base, []).append((name, labels, value))
+    for family in sorted(by_family):
+        kind = types.get(family, "untyped")
+        help_ = helps.get(family, "")
+        print(f"{family} [{kind}]" + (f" — {help_}" if help_ else ""))
+        rows = by_family[family]
+        if kind == "histogram":
+            # Group by the non-le label set.
+            series: dict[tuple, dict] = {}
+            for name, labels, value in rows:
+                key = tuple(sorted(
+                    (k, v) for k, v in labels.items() if k != "le"))
+                s = series.setdefault(
+                    key, {"buckets": [], "sum": 0.0, "count": 0.0})
+                if name.endswith("_bucket"):
+                    s["buckets"].append((float(labels["le"]), value))
+                elif name.endswith("_sum"):
+                    s["sum"] = value
+                elif name.endswith("_count"):
+                    s["count"] = value
+            for key, s in sorted(series.items()):
+                label_str = ",".join(f'{k}="{v}"' for k, v in key)
+                buckets = sorted(s["buckets"])
+                mean = s["sum"] / s["count"] if s["count"] else float("nan")
+                p50 = _histogram_quantile(buckets, 0.5)
+                p99 = _histogram_quantile(buckets, 0.99)
+                print(f"  {{{label_str}}} count={s['count']:g} "
+                      f"mean={mean:.6g}s p50~{p50:.6g}s p99~{p99:.6g}s")
+        else:
+            for name, labels, value in sorted(
+                    rows, key=lambda r: sorted(r[1].items())):
+                label_str = ",".join(
+                    f'{k}="{v}"' for k, v in sorted(labels.items()))
+                prefix = f"  {{{label_str}}}" if label_str else " "
+                print(f"{prefix} {value:g}")
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser("oimctl")
-    add_registry_flag(parser, required=True)
+    add_registry_flag(parser)
     parser.add_argument("--get", default=None, metavar="PATH", help="prefix to read")
     parser.add_argument(
         "--stale",
@@ -97,16 +218,31 @@ def main(argv: list[str] | None = None) -> int:
              "the endpoint list for the STANDBY and sends the promote "
              "command there",
     )
+    parser.add_argument(
+        "--metrics",
+        default=None,
+        metavar="HOST:PORT",
+        help="pretty-print a daemon's GET /metrics scrape (families "
+             "grouped, histograms summarized as count/mean/p50/p99); "
+             "plain HTTP, no --registry needed",
+    )
     add_common_flags(parser)
     args = parser.parse_args(argv)
     setup_logging(args)
+    if args.metrics is not None:
+        print_metrics(args.metrics)
+        if args.set is None and args.get is None and not args.health \
+                and not args.promote:
+            return 0
+    if not args.registry:
+        raise SystemExit("--registry is required (except with --metrics alone)")
     tls = load_tls_flags(args, peer_name="component.registry")
     endpoints = RegistryEndpoints(args.registry)
 
     def connect(endpoint: str) -> grpc.Channel:
-        if tls is not None:
-            return secure_channel(endpoint, tls)
-        return grpc.insecure_channel(endpoint)
+        # tlsutil.dial: mTLS when configured, and the telemetry client
+        # interceptor either way (oimctl's calls show up in traces too).
+        return dial(endpoint, tls)
 
     def with_failover(op):
         """Run ``op(stub)`` against the current endpoint, rotating through
@@ -194,9 +330,10 @@ def main(argv: list[str] | None = None) -> int:
         for cid, status, address, mesh in rows:
             print(f"{cid}\t{status}\t{address}\t{mesh}")
     if args.set is None and args.get is None and not args.health \
-            and not args.promote:
+            and not args.promote and args.metrics is None:
         raise SystemExit(
-            "nothing to do: pass --get, --set, --health and/or --promote")
+            "nothing to do: pass --get, --set, --health, --promote "
+            "and/or --metrics")
     return 0
 
 

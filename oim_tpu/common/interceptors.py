@@ -56,17 +56,40 @@ def strip_secrets(msg: Any) -> str:
     return str(clone).replace("\n", " ").strip() or "<empty>"
 
 
+_REDACTED = "***stripped***"
+_SECRET_FIELDS = ("secret", "secrets")
+
+
 def _redact(msg: Message) -> None:
     for field, value in msg.ListFields():
-        if field.name == "secret" and field.type == field.TYPE_STRING:
-            setattr(msg, field.name, "***stripped***")
-        elif field.type == field.TYPE_MESSAGE:
-            if field.is_repeated:
+        secret = field.name in _SECRET_FIELDS
+        if field.type == field.TYPE_MESSAGE:
+            entry = field.message_type
+            if entry.GetOptions().map_entry:
+                # Proto maps present as repeated (key, value) entry
+                # messages: iterating the composite yields KEYS, so the
+                # old repeated-message recursion never saw the values —
+                # map<string,string> secrets passed through unredacted.
+                value_field = entry.fields_by_name["value"]
+                if secret and value_field.type == value_field.TYPE_STRING:
+                    for key in value:
+                        value[key] = _REDACTED
+                elif value_field.type == value_field.TYPE_MESSAGE:
+                    for key in value:
+                        _redact(value[key])
+            elif field.is_repeated:
                 for item in value:
-                    if isinstance(item, Message):
-                        _redact(item)
+                    _redact(item)
             else:
                 _redact(value)
+        elif secret and field.type == field.TYPE_STRING:
+            if field.is_repeated:
+                # Repeated string secrets: replace every element in place
+                # (setattr on a repeated field raises).
+                for i in range(len(value)):
+                    value[i] = _REDACTED
+            else:
+                setattr(msg, field.name, _REDACTED)
 
 
 class LogServerInterceptor(grpc.ServerInterceptor):

@@ -11,12 +11,23 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import json
 import sys
 import threading
 import time
 from typing import Any, Iterator, TextIO
 
 DEBUG, INFO, WARNING, ERROR = 10, 20, 30, 40
+
+FORMATS = ("text", "json")
+
+
+def _timestamp() -> str:
+    """Wall clock with millisecond precision — sub-second ordering matters
+    when correlating log lines against span timelines."""
+    now = time.time()
+    return (time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(now))
+            + ".%03d" % (int(now * 1000) % 1000))
 
 _LEVEL_NAMES = {DEBUG: "DEBUG", INFO: "INFO", WARNING: "WARNING", ERROR: "ERROR"}
 _NAME_LEVELS = {v.lower(): k for k, v in _LEVEL_NAMES.items()}
@@ -36,7 +47,10 @@ class Logger:
     ``with_fields`` returns a child logger carrying extra key/value pairs
     (reference Logger.With, pkg/log/log.go:37-110). Output formatting follows
     the reference's simple logger: ``<time> <level> <msg> | k: v``
-    (pkg/log/formatter.go:18-30).
+    (pkg/log/formatter.go:18-30) — or, with ``fmt="json"``, one JSON object
+    per line with bound fields flattened to top level (log aggregators;
+    the ``--log-format json`` CLI flag). ``trace_id`` appears as an
+    ordinary field in both formats when the telemetry interceptors bind it.
     """
 
     def __init__(
@@ -45,6 +59,7 @@ class Logger:
         level: int = INFO,
         fields: tuple[tuple[str, Any], ...] = (),
         _lock: threading.Lock | None = None,
+        fmt: str = "text",
     ):
         # None = resolve sys.stderr at write time: a captured-at-construction
         # stream may be replaced/closed later (pytest capsys, daemon redirects).
@@ -52,6 +67,9 @@ class Logger:
         self.level = level
         self._fields = fields
         self._lock = _lock or threading.Lock()
+        if fmt not in FORMATS:
+            raise ValueError(f"unknown log format: {fmt!r}")
+        self.fmt = fmt
 
     def with_fields(self, **fields: Any) -> "Logger":
         return Logger(
@@ -59,20 +77,29 @@ class Logger:
             self.level,
             self._fields + tuple(fields.items()),
             self._lock,
+            self.fmt,
         )
 
     def log(self, level: int, msg: str, **fields: Any) -> None:
         if level < self.level:
             return
-        parts = [
-            time.strftime("%Y-%m-%d %H:%M:%S"),
-            _LEVEL_NAMES.get(level, str(level)),
-            msg,
-        ]
         all_fields = self._fields + tuple(fields.items())
-        if all_fields:
-            parts.append("| " + " ".join(f"{k}: {v!r}" for k, v in all_fields))
-        line = " ".join(parts) + "\n"
+        if self.fmt == "json":
+            record: dict[str, Any] = {
+                "ts": _timestamp(),
+                "level": _LEVEL_NAMES.get(level, str(level)),
+                "msg": msg,
+            }
+            # Flattened, last-wins on collisions; non-JSON values (lazy
+            # payload formatters, protos) stringify via default=repr.
+            record.update(all_fields)
+            line = json.dumps(record, default=repr) + "\n"
+        else:
+            parts = [_timestamp(), _LEVEL_NAMES.get(level, str(level)), msg]
+            if all_fields:
+                parts.append(
+                    "| " + " ".join(f"{k}: {v!r}" for k, v in all_fields))
+            line = " ".join(parts) + "\n"
         with self._lock:
             out = self._output if self._output is not None else sys.stderr
             try:

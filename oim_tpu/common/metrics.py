@@ -1,10 +1,17 @@
-"""Metrics: thread-safe counters/gauges + a Prometheus-text HTTP endpoint.
+"""Metrics: thread-safe counters/gauges/histograms + a Prometheus-text HTTP
+endpoint.
 
 The reference vendors go-grpc-prometheus but never wires it (SURVEY.md
 section 5.5); the BASELINE metrics (stage GB/s, images/sec/chip) must be
 first-class here, so this is a real registry: controllers count staged
-bytes, the trainer publishes step time / throughput / MFU, and anything can
-scrape ``GET /metrics``.
+bytes, the trainer publishes step time / throughput / MFU, the gRPC
+telemetry interceptors (common/tracing.py) record per-method latency
+histograms labeled by status code, and anything can scrape ``GET /metrics``.
+
+Label support follows the Prometheus client model: a metric declared with
+``labelnames`` is a family; ``.labels(method=..., code=...)`` returns (and
+memoizes) the child the samples land on. Metrics without labelnames keep
+the original single-sample API (``inc``/``set``/``observe``/``value``).
 """
 
 from __future__ import annotations
@@ -12,13 +19,44 @@ from __future__ import annotations
 import http.server
 import threading
 import time
-from typing import Iterable
+from typing import Iterable, Sequence
+
+# go-grpc-prometheus / prometheus-client default latency buckets (seconds).
+DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 
-class Counter:
-    def __init__(self, name: str, help_: str = ""):
-        self.name = name
-        self.help = help_
+def escape_help(text: str) -> str:
+    """Prometheus text-format HELP escaping: backslash and newline."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def escape_label_value(text: str) -> str:
+    """Label-value escaping: backslash, double-quote, newline."""
+    return (text.replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _fmt_bound(value: float) -> str:
+    """le-label formatting: integral bounds without the '.0' (the
+    prometheus-client convention for bucket bounds)."""
+    if value == int(value) and abs(value) < 1e15:
+        return str(int(value))
+    return repr(value)
+
+
+def _label_str(names: Sequence[str], values: Sequence[str],
+               extra: str = "") -> str:
+    pairs = [f'{n}="{escape_label_value(v)}"' for n, v in zip(names, values)]
+    if extra:
+        pairs.append(extra)
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+class _CounterValue:
+    """One sample (a labels() child, or the whole unlabeled metric)."""
+
+    def __init__(self) -> None:
         self._value = 0.0
         self._lock = threading.Lock()
 
@@ -31,21 +69,168 @@ class Counter:
         with self._lock:
             return self._value
 
-    def render(self) -> Iterable[str]:
-        yield f"# HELP {self.name} {self.help}"
-        yield f"# TYPE {self.name} counter"
-        yield f"{self.name} {self.value}"
+    def sample_lines(self, name: str, labels: str) -> Iterable[str]:
+        # Plain float formatting ("42.0"): the pre-label wire format,
+        # which scrapers and tests already depend on.
+        yield f"{name}{labels} {self.value}"
 
 
-class Gauge(Counter):
+class _GaugeValue(_CounterValue):
     def set(self, value: float) -> None:
         with self._lock:
             self._value = value
 
+
+class _HistogramValue:
+    def __init__(self, buckets: Sequence[float]) -> None:
+        self._buckets = tuple(buckets)
+        self._counts = [0] * len(self._buckets)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        import bisect
+
+        # _counts[i] is the count landing in (buckets[i-1], buckets[i]];
+        # values above the last bound count only in +Inf (== _count).
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            i = bisect.bisect_left(self._buckets, value)
+            if i < len(self._counts):
+                self._counts[i] += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def sample_lines(self, name: str, labels: str) -> Iterable[str]:
+        with self._lock:
+            counts = list(self._counts)
+            total, total_sum = self._count, self._sum
+        # labels arrives rendered ("{a=\"x\"}" or ""); the le label merges
+        # inside the braces per the text-format grammar.
+        inner = labels[1:-1] if labels else ""
+        cumulative = 0
+        for bound, n in zip(self._buckets, counts):
+            cumulative += n
+            le = f'le="{_fmt_bound(bound)}"'
+            merged = "{" + (inner + "," if inner else "") + le + "}"
+            yield f"{name}_bucket{merged} {cumulative}"
+        merged = "{" + (inner + "," if inner else "") + 'le="+Inf"' + "}"
+        yield f"{name}_bucket{merged} {total}"
+        yield f"{name}_sum{labels} {total_sum}"
+        yield f"{name}_count{labels} {total}"
+
+
+class Counter:
+    """A counter family; without labelnames it is its own single sample."""
+
+    TYPE = "counter"
+
+    def __init__(self, name: str, help_: str = "",
+                 labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.labelnames = tuple(labelnames)
+        self._children: dict[tuple[str, ...], object] = {}
+        self._family_lock = threading.Lock()
+        if not self.labelnames:
+            self._children[()] = self._new_value()
+
+    def _new_value(self):
+        return _CounterValue()
+
+    def labels(self, *values: object, **kwvalues: object):
+        if kwvalues:
+            if values:
+                raise ValueError("pass label values positionally OR by name")
+            try:
+                values = tuple(kwvalues.pop(n) for n in self.labelnames)
+            except KeyError as err:
+                raise ValueError(
+                    f"{self.name}: missing label {err.args[0]!r}") from None
+            if kwvalues:
+                raise ValueError(
+                    f"{self.name}: unknown labels {sorted(kwvalues)}")
+        key = tuple(str(v) for v in values)
+        if len(key) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name} takes labels {self.labelnames}, got {key}")
+        with self._family_lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._new_value()
+        return child
+
+    def _solo(self):
+        if self.labelnames:
+            raise ValueError(
+                f"{self.name} is labeled {self.labelnames}; use .labels()")
+        return self._children[()]
+
+    # Unlabeled passthroughs (the original API).
+    def inc(self, amount: float = 1.0) -> None:
+        self._solo().inc(amount)
+
+    @property
+    def value(self) -> float:
+        return self._solo().value
+
     def render(self) -> Iterable[str]:
-        yield f"# HELP {self.name} {self.help}"
-        yield f"# TYPE {self.name} gauge"
-        yield f"{self.name} {self.value}"
+        yield f"# HELP {self.name} {escape_help(self.help)}"
+        yield f"# TYPE {self.name} {self.TYPE}"
+        with self._family_lock:
+            children = sorted(self._children.items())
+        for key, child in children:
+            yield from child.sample_lines(
+                self.name, _label_str(self.labelnames, key))
+
+
+class Gauge(Counter):
+    TYPE = "gauge"
+
+    def _new_value(self):
+        return _GaugeValue()
+
+    def set(self, value: float) -> None:
+        self._solo().set(value)
+
+
+class Histogram(Counter):
+    TYPE = "histogram"
+
+    def __init__(self, name: str, help_: str = "",
+                 labelnames: Sequence[str] = (),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.buckets = tuple(sorted(buckets))
+        super().__init__(name, help_, labelnames)
+
+    def _new_value(self):
+        return _HistogramValue(self.buckets)
+
+    def observe(self, value: float) -> None:
+        self._solo().observe(value)
+
+    @property
+    def count(self) -> int:
+        return self._solo().count
+
+    @property
+    def sum(self) -> float:
+        return self._solo().sum
+
+    # A histogram family's aggregate value is its observation count.
+    @property
+    def value(self) -> float:
+        return float(self._solo().count)
 
 
 class Registry:
@@ -53,20 +238,42 @@ class Registry:
         self._metrics: dict[str, Counter] = {}
         self._lock = threading.Lock()
 
-    def counter(self, name: str, help_: str = "") -> Counter:
-        return self._get(name, help_, Counter)
+    def counter(self, name: str, help_: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get(name, help_, Counter, labelnames)
 
-    def gauge(self, name: str, help_: str = "") -> Gauge:
-        return self._get(name, help_, Gauge)
+    def gauge(self, name: str, help_: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get(name, help_, Gauge, labelnames)
 
-    def _get(self, name, help_, cls):
+    def histogram(self, name: str, help_: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get(name, help_, Histogram, labelnames, buckets)
+
+    def _get(self, name, help_, cls, labelnames=(), buckets=None):
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
-                m = cls(name, help_)
+                if cls is Histogram:
+                    m = cls(name, help_, labelnames, buckets)
+                else:
+                    m = cls(name, help_, labelnames)
                 self._metrics[name] = m
             elif not isinstance(m, cls):
                 raise ValueError(f"metric {name!r} already registered as {type(m).__name__}")
+            elif m.labelnames != tuple(labelnames):
+                raise ValueError(
+                    f"metric {name!r} already registered with labels "
+                    f"{m.labelnames}")
+            elif (cls is Histogram
+                  and m.buckets != tuple(sorted(buckets))):
+                # A second registration with different buckets would get
+                # the first family's bounds — its quantile estimates would
+                # be silently wrong. Fail like a label mismatch does.
+                raise ValueError(
+                    f"metric {name!r} already registered with buckets "
+                    f"{m.buckets}")
             return m
 
     def render(self) -> str:
@@ -142,31 +349,63 @@ REGISTRY_PROMOTIONS = DEFAULT.counter(
 REGISTRY_ROLE = DEFAULT.gauge(
     "oim_registry_role",
     "replication role of this registry: 1 = PRIMARY, 0 = STANDBY")
+# Labeled RPC telemetry (common/tracing.py interceptors — the
+# go-grpc-prometheus analog; recorded by client and server vantage alike).
+RPC_LATENCY = DEFAULT.histogram(
+    "oim_rpc_latency_seconds",
+    "gRPC call latency by method and final status code (streaming calls "
+    "time the whole stream)",
+    labelnames=("method", "code"))
+RPC_TOTAL = DEFAULT.counter(
+    "oim_rpc_total",
+    "gRPC calls completed, by method and final status code",
+    labelnames=("method", "code"))
 
 
 class MetricsServer:
-    """Serves ``registry.render()`` on ``GET /metrics`` in a daemon thread."""
+    """Serves ``registry.render()`` on ``GET /metrics`` and the tracing
+    ring buffer on ``GET /debug/spans`` in a daemon thread.
 
-    def __init__(self, registry: Registry | None = None, port: int = 0):
+    ``host`` defaults to loopback (the safe standalone default); daemons
+    that Prometheus scrapes from another pod bind ``--metrics-host
+    0.0.0.0`` (deploy/kubernetes annotations point the scraper here)."""
+
+    def __init__(self, registry: Registry | None = None, port: int = 0,
+                 host: str = "127.0.0.1"):
         self.registry = registry or DEFAULT
         registry_ref = self.registry
 
         class Handler(http.server.BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 - http.server API
-                if self.path != "/metrics":
-                    self.send_error(404)
-                    return
-                body = registry_ref.render().encode()
+            def _reply(self, body: bytes, content_type: str) -> None:
                 self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
+            def do_GET(self):  # noqa: N802 - http.server API
+                if self.path == "/metrics":
+                    self._reply(registry_ref.render().encode(),
+                                "text/plain; version=0.0.4")
+                    return
+                if self.path == "/debug/spans":
+                    # Complete Chrome-trace JSON of the span ring: save the
+                    # body to a file and open it in Perfetto directly.
+                    import json
+
+                    from oim_tpu.common import tracing
+
+                    body = json.dumps(
+                        {"traceEvents": tracing.recorder().to_events()})
+                    self._reply(body.encode(), "application/json")
+                    return
+                self.send_error(404)
+
             def log_message(self, *args):  # silence per-request stderr lines
                 pass
 
-        self._server = http.server.ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.host = host
+        self._server = http.server.ThreadingHTTPServer((host, port), Handler)
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
 

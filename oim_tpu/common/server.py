@@ -49,9 +49,15 @@ class NonBlockingGRPCServer:
         interceptors: Sequence[grpc.ServerInterceptor] = (),
         max_workers: int = 16,
     ):
+        # Telemetry wraps outermost on EVERY server (spans + labeled RPC
+        # metrics + trace_id-bound logger, common/tracing.py) so the
+        # registry, controller, feeder daemon, and test servers all emit
+        # oim_rpc_latency_seconds/oim_rpc_total without per-call wiring.
+        from oim_tpu.common.tracing import TelemetryServerInterceptor
+
         self._endpoint = endpoint
         self._tls = tls
-        self._interceptors = tuple(interceptors)
+        self._interceptors = (TelemetryServerInterceptor(), *interceptors)
         self._max_workers = max_workers
         self._server: grpc.Server | None = None
         self._addr: str | None = None

@@ -81,10 +81,16 @@ def secure_channel(address: str, cfg: TLSConfig, peer_name: str | None = None) -
 
 def dial(address: str, tls: TLSConfig | None, peer_name: str = "") -> grpc.Channel:
     """The one way every component dials another: mTLS with peer-name pinning
-    when TLS material is configured, plain channel otherwise (tests only)."""
+    when TLS material is configured, plain channel otherwise (tests only).
+    Every channel carries the telemetry client interceptor (spans with
+    ``oim-trace`` propagation + labeled RPC metrics, common/tracing.py)."""
+    from oim_tpu.common.tracing import TelemetryClientInterceptor
+
     if tls is not None:
-        return secure_channel(address, tls, peer_name or tls.peer_name)
-    return grpc.insecure_channel(address)
+        channel = secure_channel(address, tls, peer_name or tls.peer_name)
+    else:
+        channel = grpc.insecure_channel(address)
+    return grpc.intercept_channel(channel, TelemetryClientInterceptor())
 
 
 def peer_common_name(context: grpc.ServicerContext) -> str | None:

@@ -14,6 +14,7 @@ from typing import Any
 
 import numpy as np
 
+from oim_tpu.common import metrics as M, tracing
 from oim_tpu.controller.backend import StagedVolume, reshape_to_spec
 from oim_tpu.controller.source import load_source
 
@@ -54,16 +55,26 @@ class MallocBackend:
     # -- staging ----------------------------------------------------------
 
     def stage(self, volume: StagedVolume, params_kind: str, params: Any) -> None:
+        # Captured on the RPC thread: the staging span joins the MapVolume
+        # call's trace even though the work runs on its own thread.
+        parent = tracing.current_context()
+
         def work() -> None:
-            try:
-                if params_kind == "malloc":
-                    host = self.buffer(volume.volume_id)
-                else:
-                    host = load_source(params_kind, params)
-                array = reshape_to_spec(np.asarray(host), volume.spec)
-                volume.mark_ready(array, array.nbytes)
-            except Exception as exc:  # noqa: BLE001 - reported via StageStatus
-                volume.mark_failed(str(exc))
+            with tracing.start_span("stage", parent=parent,
+                                    volume=volume.volume_id,
+                                    kind=params_kind) as span:
+                try:
+                    if params_kind == "malloc":
+                        host = self.buffer(volume.volume_id)
+                    else:
+                        host = load_source(params_kind, params)
+                    array = reshape_to_spec(np.asarray(host), volume.spec)
+                    volume.mark_ready(array, array.nbytes)
+                except Exception as exc:  # noqa: BLE001 - via StageStatus
+                    volume.mark_failed(str(exc))
+                finally:
+                    span.finish()
+                    M.STAGE_SECONDS.inc(span.duration)
 
         threading.Thread(target=work, daemon=True).start()
 

@@ -19,6 +19,7 @@ from typing import Any
 
 import numpy as np
 
+from oim_tpu.common import metrics as M, tracing
 from oim_tpu.common.meshcoord import MeshCoord
 from oim_tpu.controller.backend import StagedVolume, reshape_to_spec, spec_dtype
 from oim_tpu.controller.malloc_backend import MallocBackend
@@ -152,24 +153,34 @@ class TPUBackend(MallocBackend):
             if not volume.mark_ready(arr, arr.nbytes, device_id=dev_ids[0]):
                 arr.delete()  # unmapped while we were staging
 
-        def work() -> None:
-            try:
-                from oim_tpu.data import plane
+        # Captured on the RPC thread: the staging span joins the MapVolume
+        # call's trace even though the work runs on its own thread.
+        parent = tracing.current_context()
 
-                src = None
-                if params_kind != "malloc":
-                    src = plane.lower_source(params_kind, params)
-                if src is not None:
-                    try:
-                        work_plane(src)
-                        return
-                    except plane.PlacementNotLowerable:
-                        # Pathological run explosion: the whole-read path
-                        # still serves it.
-                        pass
-                work_whole()
-            except Exception as exc:  # noqa: BLE001 - reported via StageStatus
-                volume.mark_failed(str(exc))
+        def work() -> None:
+            with tracing.start_span("stage", parent=parent,
+                                    volume=volume.volume_id,
+                                    kind=params_kind) as span:
+                try:
+                    from oim_tpu.data import plane
+
+                    src = None
+                    if params_kind != "malloc":
+                        src = plane.lower_source(params_kind, params)
+                    if src is not None:
+                        try:
+                            work_plane(src)
+                            return
+                        except plane.PlacementNotLowerable:
+                            # Pathological run explosion: the whole-read
+                            # path still serves it.
+                            pass
+                    work_whole()
+                except Exception as exc:  # noqa: BLE001 - via StageStatus
+                    volume.mark_failed(str(exc))
+                finally:
+                    span.finish()
+                    M.STAGE_SECONDS.inc(span.duration)
 
         threading.Thread(target=work, daemon=True).start()
 

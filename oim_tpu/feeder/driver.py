@@ -26,7 +26,7 @@ from typing import Any, Mapping
 
 import grpc
 
-from oim_tpu.common import faultinject, metrics as M
+from oim_tpu.common import faultinject, metrics as M, tracing
 from oim_tpu.common.endpoints import RegistryEndpoints
 from oim_tpu.common.keymutex import KeyMutex
 from oim_tpu.common.logging import from_context
@@ -216,7 +216,11 @@ class Feeder:
         if not request.volume_id:
             raise PublishError("empty volume_id")
         params_key = request.SerializeToString(deterministic=True)
-        with self._keymutex.locked(request.volume_id):
+        # Root (or caller-nested) span for the whole publish: MapVolume,
+        # the StageStatus poll loop, and any failover retries all become
+        # its children, so "which hop ate the budget" reads off one trace.
+        with tracing.start_span("feeder.publish", volume=request.volume_id), \
+                self._keymutex.locked(request.volume_id):
             existing = self._published.get(request.volume_id)
             if existing is not None:
                 # Idempotency: already published (nodeserver.go:95-109) —
@@ -455,8 +459,16 @@ class Feeder:
         same stance as the reference's re-registration loop, applied to
         the data window (SURVEY.md section 5.3).
         """
-        if not heal:
-            return self._fetch_window_once(volume_id, offset, length, timeout)
+        with tracing.start_span("feeder.window", volume=volume_id,
+                                offset=offset, length=length, heal=heal):
+            if not heal:
+                return self._fetch_window_once(
+                    volume_id, offset, length, timeout)
+            return self._fetch_window_healed(
+                volume_id, offset, length, timeout)
+
+    def _fetch_window_healed(self, volume_id: str, offset: int, length: int,
+                             timeout: float):
         deadline = time.monotonic() + timeout
         delay = 0.2
         just_failed_over = False

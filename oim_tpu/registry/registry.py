@@ -24,7 +24,7 @@ from typing import Callable
 
 import grpc
 
-from oim_tpu.common import faultinject, metrics as M
+from oim_tpu.common import faultinject, metrics as M, tracing
 from oim_tpu.common.logging import from_context
 from oim_tpu.common.pathutil import (
     REGISTRY_ADDRESS,
@@ -363,22 +363,36 @@ class TransparentProxy(grpc.GenericRpcHandler):
             )
         log.debug("proxying", method=method, controller=controller_id, address=address)
         # Per-call dialing with pinned far-end identity (registry.go:191-210).
-        channel = self._dial(address, f"controller.{controller_id}")
-        try:
-            call = channel.stream_stream(
-                method, request_serializer=_IDENTITY, response_deserializer=_IDENTITY
-            )(
-                request_iterator,
-                timeout=context.time_remaining(),
-                metadata=[(k, v) for k, v in metadata if k != CONTROLLER_ID_META],
-            )
+        # The hop is traced explicitly — extract the caller's context from
+        # the raw metadata and re-inject the hop span's own id — because
+        # the generic handler's generator body cannot rely on the server
+        # interceptor's ambient contextvar: one trace_id then follows
+        # feeder -> proxy -> controller (doc/architecture.md Observability).
+        parent = tracing.extract(metadata)
+        with tracing.start_span(
+                f"proxy:{tracing.method_label(method)}", parent=parent,
+                controller=controller_id) as span:
+            forwarded = tracing.inject(
+                [(k, v) for k, v in metadata if k != CONTROLLER_ID_META],
+                span.context)
+            channel = self._dial(address, f"controller.{controller_id}")
             try:
-                for response in call:
-                    yield response
-            except grpc.RpcError as err:
-                context.abort(err.code(), err.details())
-        finally:
-            channel.close()
+                call = channel.stream_stream(
+                    method, request_serializer=_IDENTITY,
+                    response_deserializer=_IDENTITY,
+                )(
+                    request_iterator,
+                    timeout=context.time_remaining(),
+                    metadata=forwarded,
+                )
+                try:
+                    for response in call:
+                        yield response
+                except grpc.RpcError as err:
+                    span.attrs["code"] = err.code().name
+                    context.abort(err.code(), err.details())
+            finally:
+                channel.close()
 
 
 def registry_server(

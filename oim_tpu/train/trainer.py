@@ -25,7 +25,7 @@ import numpy as np
 import optax
 from jax import lax
 
-from oim_tpu.common import metrics as M
+from oim_tpu.common import metrics as M, tracing
 from oim_tpu.common.logging import from_context
 from oim_tpu.models import llama, resnet
 from oim_tpu.ops.losses import softmax_cross_entropy
@@ -682,7 +682,12 @@ class Trainer:
         feed_wait = 0.0
         for i in range(start_step, steps):
             batch = pending
-            with jax.profiler.StepTraceAnnotation("train", step_num=i + 1):
+            # The control-plane span (common/tracing.py) complements the
+            # jax.profiler annotation: the device trace shows XLA time, the
+            # oim trace shows the host-side dispatch + feed wait next to
+            # the publish/window spans that fed this step.
+            with tracing.start_span("train.step", step=i + 1), \
+                    jax.profiler.StepTraceAnnotation("train", step_num=i + 1):
                 self.state, stats = self.step_fn(self.state, batch)
                 if i + 1 < steps:
                     # Host time blocked on the feed: with async dispatch the

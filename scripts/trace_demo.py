@@ -1,0 +1,186 @@
+#!/usr/bin/env python3
+"""`make trace-demo`: prove one trace_id crosses three processes.
+
+Starts a registry and a malloc controller as real daemons (insecure, CPU),
+publishes a file volume and pulls one data window through the registry's
+transparent proxy from this process (the feeder), then merges every
+streamed ``*.trace.json`` into one Chrome trace and FAILS unless at least
+3 distinct processes contributed spans sharing a single trace_id —
+the end-to-end check on the oim-trace propagation chain
+(feeder -> registry proxy -> controller). Also scrapes each daemon's
+``GET /metrics`` and fails unless ``oim_rpc_latency_seconds`` histograms
+labeled by method and code parse as valid Prometheus text.
+
+Artifacts land in _demo_trace/: per-process trace files, merged.trace.json
+(open it in https://ui.perfetto.dev), daemon logs.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEMO = os.path.join(REPO, "_demo_trace")
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def spawn(name: str, args: list[str]) -> subprocess.Popen:
+    log = open(os.path.join(DEMO, f"{name}.log"), "w")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.Popen([sys.executable, "-m"] + args, stdout=log,
+                            stderr=subprocess.STDOUT, env=env, cwd=REPO)
+    print(f"started {name} (pid {proc.pid}, log _demo_trace/{name}.log)")
+    return proc
+
+
+def scrape(port: int, who: str) -> None:
+    """Assert the daemon's /metrics serves labeled RPC histograms that
+    parse as Prometheus text."""
+    from oim_tpu.cli.oimctl import parse_prometheus_text
+
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+    types, _, samples = parse_prometheus_text(text)  # raises on bad lines
+    assert types.get("oim_rpc_latency_seconds") == "histogram", (
+        f"{who}: oim_rpc_latency_seconds missing/untyped")
+    labeled = [
+        (name, labels) for name, labels, _ in samples
+        if name.startswith("oim_rpc_latency_seconds_bucket")
+        and labels.get("method") and labels.get("code") and labels.get("le")
+    ]
+    assert labeled, f"{who}: no labeled oim_rpc_latency_seconds_bucket samples"
+    print(f"{who} /metrics: {len(labeled)} labeled histogram bucket samples")
+
+
+def main() -> int:
+    os.makedirs(DEMO, exist_ok=True)
+    for stale in os.listdir(DEMO):
+        if stale.endswith(".trace.json"):
+            os.unlink(os.path.join(DEMO, stale))
+    registry_port = free_port()
+    controller_port = free_port()
+    registry_metrics = free_port()
+    controller_metrics = free_port()
+
+    procs = []
+    try:
+        procs.append(spawn("registry", [
+            "oim_tpu.cli.oim_registry",
+            "--endpoint", f"tcp://127.0.0.1:{registry_port}",
+            "--trace-dir", DEMO,
+            "--metrics-port", str(registry_metrics),
+        ]))
+        procs.append(spawn("controller", [
+            "oim_tpu.cli.oim_controller",
+            "--endpoint", f"tcp://127.0.0.1:{controller_port}",
+            "--controller-id", "host-0",
+            "--controller-address", f"127.0.0.1:{controller_port}",
+            "--registry", f"127.0.0.1:{registry_port}",
+            "--registry-delay", "2",
+            "--backend", "malloc",
+            "--mesh-coord", "0,0,0",
+            "--trace-dir", DEMO,
+            "--metrics-port", str(controller_metrics),
+        ]))
+
+        import grpc
+
+        from oim_tpu.common import tracing
+        from oim_tpu.spec import RegistryStub, pb
+
+        # Wait until the controller has self-registered.
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                with grpc.insecure_channel(
+                        f"127.0.0.1:{registry_port}") as ch:
+                    reply = RegistryStub(ch).GetValues(
+                        pb.GetValuesRequest(path="host-0"), timeout=2)
+                if any(v.path == "host-0/address" for v in reply.values):
+                    break
+            except grpc.RpcError:
+                pass
+            if time.monotonic() > deadline:
+                raise SystemExit(
+                    "cluster did not become ready; see _demo_trace/*.log")
+            time.sleep(0.3)
+        print("cluster ready")
+
+        # This process IS the feeder: publish one volume and stream one
+        # window through the proxy, all inside a root span.
+        tracing.configure("trace-demo-feeder", trace_dir=DEMO)
+        import numpy as np
+
+        from oim_tpu.feeder import Feeder
+
+        data_path = os.path.join(DEMO, "train.npy")
+        np.save(data_path, np.arange(4096, dtype=np.float32))
+        feeder = Feeder(
+            registry_address=f"127.0.0.1:{registry_port}",
+            controller_id="host-0",
+        )
+        with tracing.start_span("trace-demo.window"):
+            feeder.publish(pb.MapVolumeRequest(
+                volume_id="demo-vol",
+                file=pb.FileParams(path=data_path, format="npy"),
+            ), timeout=30)
+            window, total, _ = feeder.fetch_window("demo-vol", 0, 1024)
+        assert window.size == 1024 and total > 0
+        print("published demo-vol and fetched a 1 KiB window")
+        tracing.recorder().flush()
+        tracing.recorder().close()
+
+        scrape(registry_metrics, "registry")
+        scrape(controller_metrics, "controller")
+    finally:
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    from oim_tpu.common.tracing import merge_trace_dir
+
+    merged_path = os.path.join(DEMO, "merged.trace.json")
+    events = merge_trace_dir(DEMO, merged_path)
+    process_names = {
+        e["pid"]: e["args"]["name"]
+        for e in events if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    by_trace: dict[str, set[int]] = {}
+    for e in events:
+        tid = e.get("args", {}).get("trace_id")
+        if tid:
+            by_trace.setdefault(tid, set()).add(e["pid"])
+    best_trace, best_pids = max(
+        by_trace.items(), key=lambda kv: len(kv[1]), default=("", set()))
+    print(f"{len(events)} events from {len(process_names)} processes, "
+          f"{len(by_trace)} traces")
+    print(f"widest trace {best_trace} spans {len(best_pids)} processes: "
+          f"{sorted(process_names.get(p, str(p)) for p in best_pids)}")
+    if len(best_pids) < 3:
+        print("FAIL: expected one trace_id spanning >= 3 processes "
+              "(feeder, registry proxy, controller)", file=sys.stderr)
+        return 1
+    print(f"OK: merged trace at _demo_trace/merged.trace.json "
+          f"(open in ui.perfetto.dev)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
