@@ -9,7 +9,7 @@
 PY ?= python
 RUFF := $(shell command -v ruff 2>/dev/null)
 
-.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo
+.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke
 
 # drift and tsan are standalone conveniences; the full pytest target
 # already runs both (SpecDrift + the TSAN stream test build in-fixture).
@@ -40,6 +40,13 @@ native:
 tsan:
 	$(MAKE) -C native tsan
 	$(PY) -m pytest tests/test_staging.py -q -k thread_sanitizer
+
+# Tiny CPU-only stage-and-train correctness loop (seconds, not minutes):
+# byte-identical staging through the parallel pipeline, cache-hit
+# republish, converging train steps. Also runs in tier-1 as
+# tests/test_bench_smoke.py, so the pipeline can't silently corrupt data.
+bench-smoke:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --smoke
 
 demo:
 	bash scripts/demo_cluster.sh demo

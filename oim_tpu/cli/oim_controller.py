@@ -67,15 +67,46 @@ def main(argv: list[str] | None = None) -> int:
              "rejected — a scatter must never silently collapse onto one "
              "chip)",
     )
+    parser.add_argument(
+        "--chunk-bytes", type=int, default=64 << 20,
+        help="staging pipeline chunk size (tpu backend); smaller chunks "
+             "cut transient HBM, larger ones amortize per-chunk dispatch",
+    )
+    parser.add_argument(
+        "--stage-workers", type=int, default=0,
+        help="concurrent shard-group staging pool width (0 = default "
+             "$OIM_STAGE_WORKERS or 4; each in-flight group adds up to "
+             "2 chunks of transient memory)",
+    )
+    parser.add_argument(
+        "--cache-bytes", type=int, default=-1,
+        help="content-addressed stage cache capacity (-1 = default "
+             "$OIM_STAGE_CACHE_BYTES or 1 GiB; 0 disables caching)",
+    )
+    parser.add_argument(
+        "--no-keep-cached", action="store_true",
+        help="free cached staged arrays on last unmap instead of keeping "
+             "them resident for O(1) re-mount",
+    )
     add_common_flags(parser)
     add_observability_flags(parser)
     args = parser.parse_args(argv)
     setup_logging(args)
     obs = start_observability(args, "oim-controller")
     tls = load_tls_flags(args)
+    cache_bytes = None if args.cache_bytes < 0 else args.cache_bytes
     backend = (
-        TPUBackend(mesh=_device_mesh(args.device_mesh))
-        if args.backend == "tpu" else MallocBackend()
+        TPUBackend(
+            mesh=_device_mesh(args.device_mesh),
+            chunk_bytes=args.chunk_bytes,
+            stage_workers=args.stage_workers or None,
+            cache_bytes=cache_bytes,
+            keep_cached=not args.no_keep_cached,
+        )
+        if args.backend == "tpu" else MallocBackend(
+            cache_bytes=cache_bytes,
+            keep_cached=not args.no_keep_cached,
+        )
     )
     coord = MeshCoord.parse(args.mesh_coord) if args.mesh_coord else None
     controller = Controller(

@@ -70,12 +70,15 @@ def _redact(msg: Message) -> None:
                 # messages: iterating the composite yields KEYS, so the
                 # old repeated-message recursion never saw the values —
                 # map<string,string> secrets passed through unredacted.
+                # list() before mutating: writing through a live upb map
+                # iterator can invalidate it and silently skip entries
+                # (observed as an unredacted secret on loaded suite runs).
                 value_field = entry.fields_by_name["value"]
                 if secret and value_field.type == value_field.TYPE_STRING:
-                    for key in value:
+                    for key in list(value):
                         value[key] = _REDACTED
                 elif value_field.type == value_field.TYPE_MESSAGE:
-                    for key in value:
+                    for key in list(value):
                         _redact(value[key])
             elif field.is_repeated:
                 for item in value:

@@ -294,6 +294,27 @@ STAGE_SECONDS = DEFAULT.counter(
     "oim_stage_seconds_total", "wall seconds spent staging")
 STAGE_GBPS = DEFAULT.gauge(
     "oim_stage_gbps", "throughput of the most recent staging operation")
+STAGE_WAIT_SECONDS = DEFAULT.histogram(
+    "oim_stage_wait_seconds",
+    "time a feeder publish spent polling StageStatus until the volume "
+    "materialized (publish latency attributable to staging + polling)")
+# Content-addressed stage cache (controller/stagecache.py).
+STAGE_CACHE_HITS = DEFAULT.counter(
+    "oim_stage_cache_hits_total",
+    "publishes served a resident staged array by content address, "
+    "without re-reading the source")
+STAGE_CACHE_MISSES = DEFAULT.counter(
+    "oim_stage_cache_misses_total",
+    "publishes that staged from source (no resident entry for the "
+    "content key)")
+STAGE_CACHE_EVICTIONS = DEFAULT.counter(
+    "oim_stage_cache_evictions_total",
+    "stage-cache entries evicted (LRU capacity pressure, stale source "
+    "fingerprints, or keep_cached=false unmaps)")
+STAGE_CACHE_BYTES = DEFAULT.gauge(
+    "oim_stage_cache_bytes", "bytes resident in the stage cache")
+STAGE_CACHE_ENTRIES = DEFAULT.gauge(
+    "oim_stage_cache_entries", "entries resident in the stage cache")
 TRAIN_STEP_SECONDS = DEFAULT.gauge(
     "oim_train_step_seconds", "duration of the most recent training step")
 TRAIN_EXAMPLES_PER_SEC = DEFAULT.gauge(

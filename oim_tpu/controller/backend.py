@@ -42,12 +42,19 @@ class StagedVolume:
     finished_at: float = 0.0
     device_id: int = -1
     array: Any = None  # np.ndarray (malloc) or jax.Array (tpu)
+    # Set when ``array`` is owned by the backend's content-addressed stage
+    # cache: unstage releases the pin instead of deleting the array, so a
+    # re-publish of identical content re-mounts it in O(1).
+    cache_entry: Any = None
     cond: threading.Condition = dataclasses.field(default_factory=threading.Condition)
 
-    def mark_ready(self, array: Any, nbytes: int, device_id: int = -1) -> bool:
+    def mark_ready(self, array: Any, nbytes: int, device_id: int = -1,
+                   cache_entry: Any = None) -> bool:
         """Returns False if the volume was unmapped while staging ran — the
-        caller (the staging thread) must then free the array itself, so a
-        racing UnmapVolume can never strand device memory."""
+        caller (the staging thread) must then free the array itself (or
+        release its cache pin), so a racing UnmapVolume can never strand
+        device memory. ``cache_entry`` is published under the same lock as
+        ``array`` so unstage sees both or neither."""
         with self.cond:
             if self.cancelled:
                 self.finished_at = time.monotonic()
@@ -56,6 +63,7 @@ class StagedVolume:
                 self.cond.notify_all()
                 return False
             self.array = array
+            self.cache_entry = cache_entry
             self.bytes_staged = nbytes
             self.total_bytes = nbytes
             self.device_id = device_id

@@ -147,6 +147,46 @@ class ControllerService(ControllerServicer):
             )
         return volume.status_proto()
 
+    def PrestageVolume(self, request, context):
+        """Warm the backend's content-addressed stage cache for the
+        request's source WITHOUT creating a volume (the warm-standby
+        path, spec.md PrestageVolume): an async stage runs into the
+        cache, so a later MapVolume of identical content hits in O(1).
+        Idempotent and volume-table-free — prestaging never conflicts
+        with a mapped volume_id."""
+        params_kind = request.WhichOneof("params")
+        if not params_kind:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "no volume params")
+        backend = self.backend
+        prestage = getattr(backend, "prestage", None)
+        content_key = getattr(backend, "_content_key", None)
+        if prestage is None or content_key is None:
+            context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "backend has no stage cache",
+            )
+        params = getattr(request, params_kind)
+        keyinfo = content_key(params_kind, params, request.spec)
+        if keyinfo is None:
+            # Mutable (malloc) / unfingerprintable sources can never be
+            # served from the cache: a warm would pay the full O(volume)
+            # stage and throw it away. Refuse instead of pretending.
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"{params_kind} source is not content-addressable; "
+                "nothing to prestage",
+            )
+        # Resident already? (Pure probe: lookup pins, so release the pin.)
+        entry = backend.cache.lookup(keyinfo[0])
+        if entry is not None:
+            backend.cache.release(entry, keep=True)
+            return pb.PrestageVolumeReply(already_cached=True)
+        prestage(params_kind, params, request.spec)
+        from_context().info(
+            "prestaging volume", volume=request.volume_id, kind=params_kind
+        )
+        return pb.PrestageVolumeReply(already_cached=False)
+
     # Must leave headroom under gRPC's 4 MiB default max message size: the
     # chunk rides in a message with framing + (on the first chunk) spec and
     # total_bytes fields.

@@ -5,6 +5,12 @@ Fully functional without TPU hardware; the backend for ring-0 tests and
 BASELINE config 1. Buffers are named host arrays; ``MapVolume`` with
 ``MallocParams`` stages the buffer named by the volume id, other params load
 their source into host memory.
+
+File-backed sources ride the content-addressed stage cache
+(controller/stagecache.py): an identical re-publish — same bytes on disk,
+same spec — returns the resident host array without re-reading the source,
+and ``prestage`` warms the cache ahead of a MapVolume (the warm-standby
+path). Named malloc buffers are mutable and never cached.
 """
 
 from __future__ import annotations
@@ -15,14 +21,21 @@ from typing import Any
 import numpy as np
 
 from oim_tpu.common import metrics as M, tracing
+from oim_tpu.controller import stagecache
 from oim_tpu.controller.backend import StagedVolume, reshape_to_spec
 from oim_tpu.controller.source import load_source
 
 
 class MallocBackend:
-    def __init__(self) -> None:
+    def __init__(self, cache_bytes: int | None = None,
+                 keep_cached: bool = True) -> None:
         self._buffers: dict[str, np.ndarray] = {}
         self._lock = threading.Lock()
+        # keep_cached: entries outlive their volumes (an unmap leaves the
+        # staged array resident for O(1) re-mount) until LRU/capacity
+        # eviction; False frees on last unmap.
+        self.cache = stagecache.StageCache(cache_bytes)
+        self.keep_cached = keep_cached
 
     # -- named buffers ----------------------------------------------------
 
@@ -52,6 +65,49 @@ class MallocBackend:
             raise KeyError(f"no malloc buffer {name!r}")
         return buf
 
+    # -- stage cache -------------------------------------------------------
+
+    def _placement_sig(self, spec) -> tuple:
+        return ("host",)
+
+    def _content_key(self, params_kind: str, params, spec,
+                     src=None) -> tuple[str, tuple[str, ...]] | None:
+        """(cache key, locators) for a content-addressable source, else
+        None (mutable malloc buffers, unlowerable formats, I/O errors —
+        the stage itself will surface those). ``src`` skips re-lowering
+        when the caller already holds the ExtentSource."""
+        if params_kind == "malloc":
+            return None
+        if src is None:
+            from oim_tpu.data import plane
+
+            try:
+                src = plane.lower_source(params_kind, params)
+            except (OSError, ValueError):
+                return None
+        if src is None:
+            return None
+        fp = stagecache.fingerprint_source(src)
+        if fp is None:
+            return None
+        return stagecache.content_key(
+            params_kind, fp, spec.SerializeToString(deterministic=True),
+            self._placement_sig(spec))
+
+    def _serve_cached(self, volume: StagedVolume, key: str) -> bool:
+        """Complete the volume from a resident cache entry; False on miss
+        (counted — the caller then stages from source)."""
+        entry = self.cache.lookup(key)
+        if entry is None:
+            M.STAGE_CACHE_MISSES.inc()
+            return False
+        M.STAGE_CACHE_HITS.inc()
+        if not volume.mark_ready(entry.array, entry.nbytes,
+                                 device_id=entry.device_id,
+                                 cache_entry=entry):
+            self.cache.release(entry, keep=self.keep_cached)
+        return True
+
     # -- staging ----------------------------------------------------------
 
     def stage(self, volume: StagedVolume, params_kind: str, params: Any) -> None:
@@ -64,12 +120,25 @@ class MallocBackend:
                                     volume=volume.volume_id,
                                     kind=params_kind) as span:
                 try:
+                    keyinfo = self._content_key(params_kind, params,
+                                                volume.spec)
+                    if keyinfo is not None and self._serve_cached(
+                            volume, keyinfo[0]):
+                        return
                     if params_kind == "malloc":
                         host = self.buffer(volume.volume_id)
                     else:
                         host = load_source(params_kind, params)
                     array = reshape_to_spec(np.asarray(host), volume.spec)
-                    volume.mark_ready(array, array.nbytes)
+                    entry = None
+                    if keyinfo is not None:
+                        entry = self.cache.insert(
+                            keyinfo[0], array, array.nbytes, keyinfo[1],
+                            source_sig=keyinfo[2])
+                    if not volume.mark_ready(array, array.nbytes,
+                                             cache_entry=entry):
+                        if entry is not None:
+                            self.cache.release(entry, keep=self.keep_cached)
                 except Exception as exc:  # noqa: BLE001 - via StageStatus
                     volume.mark_failed(str(exc))
                 finally:
@@ -81,4 +150,34 @@ class MallocBackend:
     def unstage(self, volume: StagedVolume) -> None:
         with volume.cond:
             volume.cancelled = True
-            volume.array = None
+            arr, volume.array = volume.array, None
+            entry, volume.cache_entry = volume.cache_entry, None
+        if arr is None:
+            return  # in-flight stager frees its own work (incl. cache pin)
+        if entry is not None:
+            self.cache.release(entry, keep=self.keep_cached)
+
+    # -- warm-standby ------------------------------------------------------
+
+    def prestage(self, params_kind: str, params: Any, spec) -> StagedVolume:
+        """Warm the content cache without creating a volume: stage into a
+        detached StagedVolume (never registered with the service) and
+        release the pin on completion, leaving the entry resident and
+        idle. A later MapVolume of the same content — e.g. the feeder's
+        failover re-publish landing on this replica — hits in O(1).
+        Returns the detached volume so callers can wait on it."""
+        volume = StagedVolume(volume_id="~prestage", params_key=b"", spec=spec)
+        self.stage(volume, params_kind, params)
+
+        def finish() -> None:
+            volume.wait()
+            with volume.cond:
+                arr, volume.array = volume.array, None
+                entry, volume.cache_entry = volume.cache_entry, None
+            if entry is not None:
+                self.cache.release(entry, keep=True)
+            elif arr is not None and hasattr(arr, "delete"):
+                arr.delete()  # uncacheable source: nothing worth keeping
+
+        threading.Thread(target=finish, daemon=True).start()
+        return volume

@@ -37,16 +37,23 @@ def device_mesh_coord(device) -> MeshCoord:
 
 class TPUBackend(MallocBackend):
     """Extends MallocBackend (named host buffers still work) with device
-    placement."""
+    placement, the parallel staging pipeline (concurrent shard groups +
+    overlapped H2D, data/plane.py), and the content-addressed stage cache
+    holding device-resident jax.Arrays."""
 
-    def __init__(self, mesh=None, devices=None, chunk_bytes: int = 64 << 20):
-        super().__init__()
+    def __init__(self, mesh=None, devices=None, chunk_bytes: int = 64 << 20,
+                 stage_workers: int | None = None,
+                 cache_bytes: int | None = None, keep_cached: bool = True):
+        super().__init__(cache_bytes=cache_bytes, keep_cached=keep_cached)
         import jax
 
         self._jax = jax
         self.mesh = mesh
         self.devices = list(devices) if devices is not None else jax.local_devices()
         self.chunk_bytes = chunk_bytes  # overlapped-staging chunk size
+        # Width of the concurrent shard-group pool (None = plane default);
+        # each in-flight group adds up to 2 chunks of transient memory.
+        self.stage_workers = stage_workers
         self._next_device = 0
         self._device_lock = threading.Lock()
 
@@ -75,8 +82,32 @@ class TPUBackend(MallocBackend):
 
         return SingleDeviceSharding(self._pick_device())
 
+    def _placement_sig(self, spec) -> tuple:
+        """Cache-key component naming the placement domain. A sharded
+        placement is pinned to the exact mesh (axis names/sizes + device
+        ids); a single-device placement keys as "device" WITHOUT the
+        round-robin pick — the resident copy on whichever device it landed
+        is the O(1) answer, re-staging it elsewhere would defeat the
+        cache."""
+        axes = [a or None for a in spec.sharding_axes]
+        if any(axes) and self.mesh is not None:
+            return (
+                "mesh",
+                tuple(zip(map(str, self.mesh.axis_names),
+                          map(int, self.mesh.devices.shape))),
+                tuple(int(d.id) for d in self.mesh.devices.flat),
+                tuple(spec.sharding_axes),
+            )
+        return ("device",)
+
+    @staticmethod
+    def _looks_oom(exc: Exception) -> bool:
+        text = str(exc)
+        return "RESOURCE_EXHAUSTED" in text or "Out of memory" in text \
+            or "out of memory" in text
+
     def stage(self, volume: StagedVolume, params_kind: str, params: Any) -> None:
-        def work_plane(src) -> None:
+        def work_plane(src, keyinfo) -> None:
             """The uniform data plane (data/plane.py): chunked read-ahead
             overlapped with per-chunk DMA into preallocated donated device
             buffers, for EVERY extent-lowerable source (raw/npy files,
@@ -127,15 +158,14 @@ class TPUBackend(MallocBackend):
             arr = plane.stage_source(
                 src, dtype=dtype, shape=shape, sharding=sharding,
                 chunk_bytes=self.chunk_bytes, progress=progress,
+                max_workers=self.stage_workers,
             )
             if arr is None:  # unmapped mid-stage; buffers already freed
                 volume.mark_failed("unmapped during staging")
                 return
-            dev_ids = sorted(d.id for d in arr.sharding.device_set)
-            if not volume.mark_ready(arr, arr.nbytes, device_id=dev_ids[0]):
-                arr.delete()
+            self._finish(volume, arr, keyinfo)
 
-        def work_whole() -> None:
+        def work_whole(keyinfo) -> None:
             """Host-materializing fallback: malloc buffers (already in
             host RAM) and sources the extent map can't express (fortran
             .npy, unknown formats)."""
@@ -149,33 +179,49 @@ class TPUBackend(MallocBackend):
             sharding = self._sharding_for(volume.spec)
             arr = self._jax.device_put(host, sharding)
             arr.block_until_ready()
-            dev_ids = sorted(d.id for d in arr.sharding.device_set)
-            if not volume.mark_ready(arr, arr.nbytes, device_id=dev_ids[0]):
-                arr.delete()  # unmapped while we were staging
+            self._finish(volume, arr, keyinfo)
 
         # Captured on the RPC thread: the staging span joins the MapVolume
         # call's trace even though the work runs on its own thread.
         parent = tracing.current_context()
+
+        def attempt() -> None:
+            from oim_tpu.data import plane
+
+            src = None
+            if params_kind != "malloc":
+                src = plane.lower_source(params_kind, params)
+            keyinfo = None
+            if src is not None:
+                keyinfo = self._content_key(
+                    params_kind, params, volume.spec, src=src)
+            if keyinfo is not None and self._serve_cached(volume, keyinfo[0]):
+                return
+            if src is not None:
+                try:
+                    work_plane(src, keyinfo)
+                    return
+                except plane.PlacementNotLowerable:
+                    # Pathological run explosion / bitcast-unsafe dtype:
+                    # the whole-read path still serves it.
+                    pass
+            work_whole(keyinfo)
 
         def work() -> None:
             with tracing.start_span("stage", parent=parent,
                                     volume=volume.volume_id,
                                     kind=params_kind) as span:
                 try:
-                    from oim_tpu.data import plane
-
-                    src = None
-                    if params_kind != "malloc":
-                        src = plane.lower_source(params_kind, params)
-                    if src is not None:
-                        try:
-                            work_plane(src)
-                            return
-                        except plane.PlacementNotLowerable:
-                            # Pathological run explosion: the whole-read
-                            # path still serves it.
-                            pass
-                    work_whole()
+                    try:
+                        attempt()
+                    except Exception as exc:  # noqa: BLE001 - OOM valve
+                        # HBM pressure: idle cache entries are the only
+                        # memory this backend can legally reclaim — drop
+                        # them all and retry the stage once.
+                        if not self._looks_oom(exc) \
+                                or self.cache.evict_idle() == 0:
+                            raise
+                        attempt()
                 except Exception as exc:  # noqa: BLE001 - via StageStatus
                     volume.mark_failed(str(exc))
                 finally:
@@ -184,11 +230,36 @@ class TPUBackend(MallocBackend):
 
         threading.Thread(target=work, daemon=True).start()
 
+    def _finish(self, volume: StagedVolume, arr, keyinfo) -> None:
+        """Insert the staged array into the content cache (when keyed) and
+        mark the volume ready; frees the array / pin if an UnmapVolume won
+        the race."""
+        dev_ids = sorted(d.id for d in arr.sharding.device_set)
+        entry = None
+        if keyinfo is not None:
+            entry = self.cache.insert(
+                keyinfo[0], arr, arr.nbytes, keyinfo[1],
+                device_id=dev_ids[0], source_sig=keyinfo[2])
+        if not volume.mark_ready(arr, arr.nbytes, device_id=dev_ids[0],
+                                 cache_entry=entry):
+            if entry is not None:
+                self.cache.release(entry, keep=self.keep_cached)
+            else:
+                arr.delete()
+
     def unstage(self, volume: StagedVolume) -> None:
         with volume.cond:
             volume.cancelled = True  # in-flight stager frees its own array
             arr, volume.array = volume.array, None
-        if arr is not None and hasattr(arr, "delete"):
+            entry, volume.cache_entry = volume.cache_entry, None
+        if arr is None:
+            return
+        if entry is not None:
+            # Cache-owned: drop the pin; the entry (and its HBM) stays
+            # resident for O(1) re-mount until evicted (keep_cached) or
+            # freed now (not keep_cached).
+            self.cache.release(entry, keep=self.keep_cached)
+        elif hasattr(arr, "delete"):
             arr.delete()  # free HBM eagerly; leaks here are device OOM
 
     def coord_of(self, volume: StagedVolume) -> MeshCoord:

@@ -87,6 +87,18 @@ def _request(url: str, headers: dict[str, str] | None, method: str = "GET",
         delay = min(delay * 2, 2.0)
 
 
+def object_validators(
+    url: str, headers: dict[str, str] | None = None
+) -> tuple[str, str]:
+    """(ETag, Last-Modified) via HEAD — the freshness validators a
+    content-addressed stage cache keys on. A same-size re-upload changes
+    at least one of them on any real object store (RGW/S3 always send
+    ETag); both empty means the store offers NO freshness signal and the
+    caller must not cache."""
+    _, hdrs = _request(url, headers, method="HEAD", read_body=False)
+    return hdrs.get("ETag") or "", hdrs.get("Last-Modified") or ""
+
+
 def content_length(url: str, headers: dict[str, str] | None = None) -> int:
     """Object size via HEAD (falls back to a 1-byte range GET for servers
     that reject HEAD)."""

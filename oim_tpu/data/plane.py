@@ -36,11 +36,13 @@ single local raw file); this module is the generalisation:
 from __future__ import annotations
 
 import bisect
+import concurrent.futures
 import dataclasses
 import functools
 import os
 import queue
 import threading
+import time
 from typing import Callable, Iterator
 
 import numpy as np
@@ -310,11 +312,32 @@ def _q_get(q: queue.Queue, stop: threading.Event):
                 raise _Cancelled()
 
 
+def read_view(
+    src: ExtentSource, runs: list[tuple[int, int]], starts: list[int],
+    view_off: int, dst: np.ndarray,
+) -> None:
+    """Fill ``dst`` with view bytes [view_off, view_off+len(dst)), where
+    the view is the concatenation of ``runs`` and ``starts`` holds each
+    run's prefix sum (its offset inside the view)."""
+    need = dst.size
+    filled = 0
+    i = bisect.bisect_right(starts, view_off) - 1
+    while filled < need:
+        vol_off, length = runs[i]
+        inner = view_off + filled - starts[i]
+        n = min(length - inner, need - filled)
+        read_range(src, vol_off + inner, dst[filled:filled + n])
+        filled += n
+        i += 1
+
+
 def iter_view_chunks(
     src: ExtentSource,
     runs: list[tuple[int, int]],
     chunk_bytes: int = 64 << 20,
     n_buffers: int = 3,
+    pad_tail: bool = False,
+    on_read_seconds: Callable[[float], None] | None = None,
 ) -> Iterator[tuple[int, np.ndarray]]:
     """Stream the concatenation of ``runs`` (the "view": a device slice,
     or the whole volume) as (view_offset, uint8 chunk) pairs.
@@ -325,6 +348,14 @@ def iter_view_chunks(
     SPDK-data-plane property, asserted by the overlap-timing test in
     tests/test_staging.py. Each yielded view is valid until the next
     iteration (its buffer is then recycled to the filler).
+
+    ``pad_tail=True`` emits only full-size chunks: the final chunk is
+    re-aligned to end exactly at the view's end, overlapping the previous
+    chunk (the overlap bytes are re-read and re-land identical values).
+    Every chunk then has the same shape, so the jitted device updater
+    compiles ONE program per view size instead of one more per distinct
+    tail size. ``on_read_seconds`` receives the filler's per-chunk source
+    read time (the disk half of the staging breakdown).
     """
     from oim_tpu.data import staging
 
@@ -332,6 +363,14 @@ def iter_view_chunks(
     if total == 0:
         return
     chunk_bytes = min(chunk_bytes, total)
+    starts = []
+    pos = 0
+    for _, n in runs:
+        starts.append(pos)
+        pos += n
+    offsets = list(range(0, total, chunk_bytes))
+    if pad_tail and offsets and offsets[-1] + chunk_bytes > total:
+        offsets[-1] = total - chunk_bytes
     stop = threading.Event()
     free_q: queue.Queue = queue.Queue()
     for _ in range(n_buffers):
@@ -340,24 +379,13 @@ def iter_view_chunks(
 
     def fill():
         try:
-            view_off = 0
-            buf = None
-            used = 0
-            for vol_off, nbytes in runs:
-                pos = 0
-                while pos < nbytes:
-                    if buf is None:
-                        buf = _q_get(free_q, stop)
-                        used = 0
-                    n = min(chunk_bytes - used, nbytes - pos)
-                    read_range(src, vol_off + pos, buf[used:used + n])
-                    pos += n
-                    used += n
-                    if used == chunk_bytes:
-                        ready_q.put(("chunk", buf, used, view_off))
-                        view_off += used
-                        buf = None
-            if buf is not None and used:
+            for view_off in offsets:
+                buf = _q_get(free_q, stop)
+                used = min(chunk_bytes, total - view_off)
+                t0 = time.monotonic()
+                read_view(src, runs, starts, view_off, buf[:used])
+                if on_read_seconds is not None:
+                    on_read_seconds(time.monotonic() - t0)
                 ready_q.put(("chunk", buf, used, view_off))
             ready_q.put(("done",))
         except _Cancelled:
@@ -390,17 +418,36 @@ def iter_view_chunks(
 # ------------------------------------------------------------- device land --
 
 # Transient device-byte accounting for the most recent stage_source call:
-# the peak this model claims (preallocated buffers + in-flight chunk) is
-# what the memory-bound CPU test asserts, and the ring-2 TPU test checks
-# the same bound against device.memory_stats() for real.
+# the peak this model claims (preallocated buffers + up to two in-flight
+# chunks per concurrently-staging group — the H2D double buffer) is what
+# the memory-bound CPU test asserts, and the ring-2 TPU test checks the
+# same bound against device.memory_stats() for real.
 LAST_STAGE_PEAK = 0
+# Max shard groups observed staging simultaneously during the most recent
+# stage_source call — the concurrency the parallel pipeline achieved.
+LAST_STAGE_CONCURRENCY = 0
+# Wall-second breakdown of the most recent stage_source call:
+# disk_s (source reads, summed over filler threads), h2d_s (host->device
+# copies incl. the per-group completion fences), dispatch_s (donated
+# device-update dispatch, first call per shape includes its compile).
+LAST_STAGE_BREAKDOWN: dict = {}
 # Total stage_source invocations — tests assert the plane (not the
 # whole-read fallback) served a given MapVolume.
 STAGE_CALLS = 0
 # stage_source runs on async controller staging threads: concurrent
-# MapVolume calls must not interleave the read-modify-write of the two
+# MapVolume calls must not interleave the read-modify-write of the
 # accounting globals above.
 _STATS_LOCK = threading.Lock()
+
+# Default width of the per-stage shard-group thread pool: distinct device
+# slices read disk and ride H2D concurrently. Overridable per call
+# (max_workers=) and by environment for deploy tuning; each in-flight
+# group adds up to 2 chunks of transient host+device memory.
+def _default_workers() -> int:
+    try:
+        return max(1, int(os.environ.get("OIM_STAGE_WORKERS", "4")))
+    except ValueError:
+        return 4
 
 
 # Buffers beyond int32 indexing land chunks under a scoped enable_x64 so
@@ -421,29 +468,29 @@ def _updater(x64: bool):
     return upd
 
 
-def _land_chunk(buf, chunk_np, off, device, on_cpu):
-    """One chunk into the donated device buffer at byte offset ``off``."""
+def _enable_x64():
+    """jax.enable_x64 moved between jax versions (removed from the top
+    level in 0.4.x); resolve the scoped context manager wherever it
+    lives."""
     import jax
 
-    if on_cpu:
-        # CPU jax may alias the pinned host buffer zero-copy and dispatch
-        # asynchronously; the buffer is recycled right after this call, so
-        # hand jax a real copy.
-        dchunk = jax.device_put(np.array(chunk_np), device)
-    else:
-        dchunk = jax.device_put(chunk_np, device)
-        dchunk.block_until_ready()
-        # Remote-execution backends can return from block_until_ready
-        # before the copy consumed the host buffer (BASELINE.md caveat);
-        # fetching a byte is the only portable completion fence.
-        np.asarray(dchunk[:1])
+    ctx = getattr(jax, "enable_x64", None)
+    if ctx is None:
+        from jax.experimental import enable_x64 as ctx
+    return ctx(True)
+
+
+def _update(buf, dchunk, off):
+    """Dispatch one donated dynamic_update_slice of ``dchunk`` into
+    ``buf`` at byte offset ``off`` (int64 path past int32 indexing)."""
     if buf.size > _X64_THRESHOLD:
-        with jax.enable_x64(True):
+        with _enable_x64():
             return _updater(True)(buf, dchunk, np.int64(off))
     return _updater(False)(buf, dchunk, np.int32(off))
 
 
-def _device_empty(nbytes: int, device):
+@functools.lru_cache(maxsize=512)
+def _device_empty_prog(nbytes: int, device):
     import jax
     import jax.numpy as jnp
     from jax.sharding import SingleDeviceSharding
@@ -451,32 +498,208 @@ def _device_empty(nbytes: int, device):
     return jax.jit(
         lambda: jnp.zeros((nbytes,), jnp.uint8),
         out_shardings=SingleDeviceSharding(device),
-    )()
+    )
 
 
-def _stage_view(
-    src, runs, devices, chunk_bytes, progress, done_offset, peak
-):
+def _device_empty(nbytes: int, device):
+    return _device_empty_prog(nbytes, device)()
+
+
+def _fence(dchunks) -> None:
+    """Portable completion fence for in-flight device_put results: fetch a
+    byte. Remote-execution backends can return from block_until_ready
+    before the copy consumed the host buffer (BASELINE.md caveat), so this
+    is the only fence that proves the pinned source buffer is reusable."""
+    for dc in dchunks:
+        if dc.size:
+            np.asarray(dc[:1])
+
+
+class _StageControl:
+    """Shared, thread-safe state for one stage_source call: cumulative
+    progress across concurrently-staging groups, cooperative abort, the
+    transient-byte peak model, and the wall-time breakdown."""
+
+    def __init__(self, progress):
+        self._progress = progress
+        self.abort = threading.Event()
+        self.cancelled = False  # progress returned False (vs an error)
+        self._lock = threading.Lock()
+        self._landed: dict[int, int] = {}     # group -> bytes landed
+        self._transient: dict[int, int] = {}  # group -> in-flight chunk bytes
+        self._live = 0                        # preallocated device buffers
+        self.peak = 0
+        self._inflight = 0
+        self.max_inflight = 0
+        self.disk_s = 0.0
+        self.h2d_s = 0.0
+        self.dispatch_s = 0.0
+
+    # -- group lifecycle ---------------------------------------------------
+
+    def group_started(self) -> None:
+        with self._lock:
+            self._inflight += 1
+            self.max_inflight = max(self.max_inflight, self._inflight)
+
+    def group_finished(self, group: int) -> None:
+        with self._lock:
+            self._inflight -= 1
+            self._transient.pop(group, None)
+
+    # -- accounting --------------------------------------------------------
+
+    def add_live(self, nbytes: int) -> None:
+        with self._lock:
+            self._live += nbytes
+            self.peak = max(self.peak,
+                            self._live + sum(self._transient.values()))
+
+    def note_transient(self, group: int, nbytes: int) -> None:
+        with self._lock:
+            self._transient[group] = nbytes
+            self.peak = max(self.peak,
+                            self._live + sum(self._transient.values()))
+
+    def add_disk(self, seconds: float) -> None:
+        with self._lock:
+            self.disk_s += seconds
+
+    def add_h2d(self, seconds: float) -> None:
+        with self._lock:
+            self.h2d_s += seconds
+
+    def add_dispatch(self, seconds: float) -> None:
+        with self._lock:
+            self.dispatch_s += seconds
+
+    def breakdown(self) -> dict:
+        return {
+            "disk_s": self.disk_s,
+            "h2d_s": self.h2d_s,
+            "dispatch_s": self.dispatch_s,
+        }
+
+    # -- progress / abort --------------------------------------------------
+
+    def report(self, group: int, landed: int) -> bool:
+        """Record the group's landed-byte high-water mark and invoke the
+        user progress callback with the cumulative total. Serialized under
+        the control lock so cumulative totals reach the callback
+        monotonically and non-thread-safe callbacks stay correct. Returns
+        False when staging must abort."""
+        if self.abort.is_set():
+            return False
+        if self._progress is None:
+            return True
+        with self._lock:
+            self._landed[group] = landed
+            total = sum(self._landed.values())
+            if self.abort.is_set():
+                return False
+            ok = self._progress(total)
+        if ok is False:
+            self.cancelled = True
+            self.abort.set()
+            return False
+        return True
+
+
+def _stage_view(src, runs, devices, chunk_bytes, ctl, group):
     """Stage one view (run list) onto every device in ``devices`` (they
     hold identical slices — replication reads the host bytes once).
-    Returns ({device: uint8 buffer}, bytes landed) or (None, bytes) on
-    abort."""
+
+    The device half is double-buffered: chunk N+1's ``device_put`` rides
+    while chunk N's donated update dispatches, with NO per-chunk blocking
+    — the pinned source of an in-flight copy is fenced only when its slot
+    comes up for reuse (every other chunk) and once at the end of the
+    group, so a remote-execution dispatch round-trip is paid per slot
+    turnover instead of per chunk.
+
+    Returns {device: uint8 buffer} or None on abort (buffers freed).
+    """
     total = sum(n for _, n in runs)
     bufs = {d: _device_empty(total, d) for d in devices}
-    peak[0] += total * len(devices)
+    ctl.add_live(total * len(devices))
     on_cpu = all(d.platform == "cpu" for d in devices)
-    done = 0
-    for view_off, chunk in iter_view_chunks(src, runs, chunk_bytes):
-        peak[1] = max(peak[1], peak[0] + chunk.size)
-        for d in devices:
-            bufs[d] = _land_chunk(bufs[d], chunk, view_off, d, on_cpu)
-            done += chunk.size
-            if progress is not None and progress(done_offset + done) is False:
-                for b in bufs.values():
-                    if hasattr(b, "delete"):
-                        b.delete()
-                return None, done
-    return bufs, done
+    import jax
+
+    from oim_tpu.data import staging
+
+    def free_all():
+        for b in bufs.values():
+            if hasattr(b, "delete"):
+                b.delete()
+
+    # Two transfer slots (non-CPU): each holds a pinned staging copy of a
+    # chunk plus the device_put results that are still consuming it.
+    transfer = [None, None]
+    pending: list[list] = [[], []]
+    slot = 0
+    chunk_size = min(chunk_bytes, total) if total else 0
+
+    def drain():
+        """Fence in-flight copies before an early exit: returning would
+        release the pinned transfer buffers (weakref finalizer frees the
+        C allocation) while a device_put may still be reading them."""
+        try:
+            _fence(pending[0] + pending[1])
+        except Exception:  # noqa: BLE001 - never mask the original failure
+            pass
+        pending[0], pending[1] = [], []
+
+    try:
+        for view_off, chunk in iter_view_chunks(
+                src, runs, chunk_bytes, pad_tail=True,
+                on_read_seconds=ctl.add_disk):
+            if ctl.abort.is_set():
+                drain()
+                free_all()
+                return None
+            # Up to 2 chunks in flight per slot turnover, one device copy
+            # per replica holder.
+            ctl.note_transient(group, 2 * chunk_size * len(devices))
+            t0 = time.monotonic()
+            if on_cpu:
+                # CPU jax may alias the host buffer zero-copy; hand it a
+                # private copy (never touched again) instead of the
+                # recycled pinned buffer, and skip the fence entirely.
+                host = np.array(chunk)
+                dchunks = [jax.device_put(host, d) for d in devices]
+            else:
+                if pending[slot]:
+                    # Fence the slot's previous copies before overwriting
+                    # the pinned buffer they read from.
+                    _fence(pending[slot])
+                    pending[slot] = []
+                if transfer[slot] is None or transfer[slot].size < chunk.size:
+                    transfer[slot] = staging.alloc_pinned(chunk_size)
+                dst = transfer[slot][:chunk.size]
+                np.copyto(dst, chunk)
+                dchunks = [jax.device_put(dst, d) for d in devices]
+                pending[slot] = dchunks
+                slot ^= 1
+            ctl.add_h2d(time.monotonic() - t0)
+            t0 = time.monotonic()
+            for i, d in enumerate(devices):
+                bufs[d] = _update(bufs[d], dchunks[i], view_off)
+            ctl.add_dispatch(time.monotonic() - t0)
+            landed = min(view_off + chunk.size, total) * len(devices)
+            if not ctl.report(group, landed):
+                drain()
+                free_all()
+                return None
+        # One fence per group: every in-flight device_put must have
+        # consumed its pinned transfer buffer before the buffers are
+        # released back to the allocator.
+        t0 = time.monotonic()
+        _fence(pending[0] + pending[1])
+        ctl.add_h2d(time.monotonic() - t0)
+    except BaseException:
+        drain()
+        free_all()
+        raise
+    return bufs
 
 
 def _as_typed(buf, dtype, shape):
@@ -510,17 +733,28 @@ def stage_source(
     sharding,
     chunk_bytes: int = 64 << 20,
     progress=None,
+    max_workers: int | None = None,
 ):
     """Stage an extent source into a device-resident jax.Array under any
     sharding (SingleDeviceSharding or NamedSharding — sharded, replicated,
     or both, uneven shards included).
 
-    ``progress(bytes_landed)`` returning False aborts (partial buffers
-    freed, returns None) — the StageStatus / unmap-during-staging hook.
-    Raises ValueError when the placement is not run-lowerable (caller
-    falls back to whole-array staging).
+    Distinct device-slice groups stage CONCURRENTLY on a thread pool of
+    ``max_workers`` (default ``$OIM_STAGE_WORKERS`` or 4; 1 restores the
+    serial path): each group runs its own read-ahead filler and H2D
+    double buffer, so on an N-way sharded mesh the shards' disk reads and
+    host->device copies proceed in parallel instead of back to back.
+    Results are byte-identical to the serial path — groups touch disjoint
+    device buffers and the per-group chunk streams are internally
+    ordered.
+
+    ``progress(bytes_landed)`` returning False aborts (every group's
+    partial buffers freed, returns None) — the StageStatus /
+    unmap-during-staging hook. Raises ValueError when the placement is
+    not run-lowerable (caller falls back to whole-array staging).
     """
-    global LAST_STAGE_PEAK, STAGE_CALLS
+    global LAST_STAGE_PEAK, LAST_STAGE_CONCURRENCY, LAST_STAGE_BREAKDOWN
+    global STAGE_CALLS
     import jax
 
     with _STATS_LOCK:
@@ -538,35 +772,63 @@ def stage_source(
             for s in (index or ())
         )
         groups.setdefault(key, ([], index))[0].append(dev)
-    peak = [0, 0]  # [live transient bytes, peak]
-    done_offset = 0
-    shards = []
-    staged_groups = []
-    try:
-        for devs, index in groups.values():
-            lowered = slice_runs(shape, index or (), dtype.itemsize)
-            if lowered is None:
-                raise PlacementNotLowerable(
-                    f"placement of {shape} over {sharding} exceeds "
-                    f"{MAX_RUNS} runs per slice"
-                )
-            runs, slice_shape = lowered
-            bufs, done = _stage_view(
-                src, runs, devs, chunk_bytes, progress, done_offset, peak
+    # Lower every placement BEFORE allocating device memory: a run
+    # explosion in any group must fall back with nothing staged.
+    lowered = []
+    for devs, index in groups.values():
+        lr = slice_runs(shape, index or (), dtype.itemsize)
+        if lr is None:
+            raise PlacementNotLowerable(
+                f"placement of {shape} over {sharding} exceeds "
+                f"{MAX_RUNS} runs per slice"
             )
-            done_offset += done
-            if bufs is None:  # aborted
-                for group in staged_groups:
-                    for b in group.values():
-                        if hasattr(b, "delete"):
-                            b.delete()
-                return None
-            staged_groups.append(bufs)
-            for d, b in bufs.items():
-                shards.append((d, _as_typed(b, dtype, slice_shape)))
+        lowered.append((devs, lr[0], lr[1]))
+    ctl = _StageControl(progress)
+    n_workers = max(1, min(len(lowered),
+                           max_workers if max_workers else _default_workers()))
+    results: list[dict | None] = [None] * len(lowered)
+    errors: list[BaseException] = []
+
+    def run_group(i: int) -> None:
+        devs, runs, _ = lowered[i]
+        ctl.group_started()
+        try:
+            results[i] = _stage_view(src, runs, devs, chunk_bytes, ctl, i)
+        except BaseException as exc:  # noqa: BLE001 - re-raised below
+            with ctl._lock:
+                errors.append(exc)
+            ctl.abort.set()
+        finally:
+            ctl.group_finished(i)
+
+    try:
+        if n_workers == 1:
+            for i in range(len(lowered)):
+                if ctl.abort.is_set():
+                    break
+                run_group(i)
+        else:
+            with concurrent.futures.ThreadPoolExecutor(
+                    n_workers, thread_name_prefix="oim-stage") as pool:
+                concurrent.futures.wait(
+                    [pool.submit(run_group, i) for i in range(len(lowered))])
     finally:
         with _STATS_LOCK:
-            LAST_STAGE_PEAK = peak[1]
+            LAST_STAGE_PEAK = ctl.peak
+            LAST_STAGE_CONCURRENCY = ctl.max_inflight
+            LAST_STAGE_BREAKDOWN = ctl.breakdown()
+    if errors or ctl.abort.is_set():
+        for bufs in results:
+            for b in (bufs or {}).values():
+                if hasattr(b, "delete"):
+                    b.delete()
+        if errors:
+            raise errors[0]
+        return None  # cancelled via progress
+    shards = []
+    for (devs, _, slice_shape), bufs in zip(lowered, results):
+        for d, b in bufs.items():
+            shards.append((d, _as_typed(b, dtype, slice_shape)))
     from jax.sharding import SingleDeviceSharding
 
     if isinstance(sharding, SingleDeviceSharding) and len(shards) == 1:

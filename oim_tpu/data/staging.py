@@ -153,7 +153,17 @@ def read_into(path: str | os.PathLike, dst: np.ndarray,
         with open(path, "rb") as f:
             if offset:
                 f.seek(offset)
-            got = f.readinto(memoryview(dst))
+            # A single readinto may legally return fewer bytes than
+            # requested mid-file (signal interruption, pipe-backed or
+            # network filesystems): loop until dst is full or EOF, and
+            # only then judge the size mismatch below.
+            view = memoryview(dst)
+            got = 0
+            while got < dst.size:
+                n = f.readinto(view[got:])
+                if not n:
+                    break
+                got += n
     else:
         got = lib.oim_read_into(
             path.encode(), dst.ctypes.data, offset, dst.size, n_threads

@@ -34,6 +34,7 @@ CONTROLLER_METHODS = {
     "ProvisionMallocBDev": (pb.ProvisionMallocBDevRequest, pb.ProvisionMallocBDevReply),
     "CheckMallocBDev": (pb.CheckMallocBDevRequest, pb.CheckMallocBDevReply),
     "StageStatus": (pb.StageStatusRequest, pb.StageStatusReply),
+    "PrestageVolume": (pb.MapVolumeRequest, pb.PrestageVolumeReply),
 }
 
 # unary-stream methods (server streams the reply type).
@@ -141,6 +142,9 @@ class ControllerServicer:
 
     def StageStatus(self, request, context):
         context.abort(grpc.StatusCode.UNIMPLEMENTED, "StageStatus not implemented")
+
+    def PrestageVolume(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "PrestageVolume not implemented")
 
     def ReadVolume(self, request, context):
         context.abort(grpc.StatusCode.UNIMPLEMENTED, "ReadVolume not implemented")

@@ -1,0 +1,19 @@
+"""Tier-1 wiring of `make bench-smoke`: the tiny stage-and-train loop
+runs inside the normal (non-slow) test pass, so the parallel staging
+pipeline cannot silently corrupt data between bench runs — byte-identical
+staging, a cache-hit republish that skips the source read, and a jitted
+train loop whose loss falls, all asserted by bench.smoke() itself."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def test_bench_smoke_stage_and_train():
+    import bench
+
+    extras = bench.smoke()  # raises AssertionError on any corruption
+    assert extras["cache_hit"] is True
+    assert extras["final_loss"] < extras["first_loss"]
+    assert extras["staged_bytes"] > 0

@@ -113,6 +113,20 @@ class TestMockOIM:
         feeder.unpublish("vol-0")
         assert controller_service.get_volume("vol-0") is None
 
+    def test_remote_publish_records_stage_wait_histogram(self, cluster):
+        """The StageStatus poll loop (decorrelated-jitter backoff) must
+        attribute its wait to oim_stage_wait_seconds, so publish latency
+        spent polling is visible in /metrics."""
+        from oim_tpu.common import metrics as M
+
+        registry, controller_service = cluster
+        controller_service.backend.provision("vol-w", 256)
+        before = M.STAGE_WAIT_SECONDS.count
+        self.feeder_for(registry).publish(
+            pb.MapVolumeRequest(volume_id="vol-w", malloc=pb.MallocParams())
+        )
+        assert M.STAGE_WAIT_SECONDS.count == before + 1
+
     def test_remote_fetch_streams_data_window(self, cluster, tmp_path):
         """ReadVolume through the proxy: the remote consumer pulls the
         staged bytes + layout (spec.md ReadVolume; vhost-user analog)."""
