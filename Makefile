@@ -43,8 +43,11 @@ tsan:
 
 # Tiny CPU-only stage-and-train correctness loop (seconds, not minutes):
 # byte-identical staging through the parallel pipeline, cache-hit
-# republish, converging train steps. Also runs in tier-1 as
-# tests/test_bench_smoke.py, so the pipeline can't silently corrupt data.
+# republish, converging train steps, and the direct-data-path guards —
+# the remote read-back must serve >=1 window controller-direct and dial
+# each target at most once (per-window channel churn stays dead). Also
+# runs in tier-1 as tests/test_bench_smoke.py, so neither the pipeline
+# nor the window path can silently regress.
 bench-smoke:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --smoke
 

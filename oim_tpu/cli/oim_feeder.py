@@ -5,8 +5,10 @@ Two mutually exclusive modes, like the reference's -spdk-socket XOR
 -oim-registry-address (main.go:30-38): **local** (--backend malloc|tpu —
 the daemon owns an in-process controller and the JAX runtime; volumes
 live here) and **remote** (--registry + --controller-id — the daemon is a
-thin node-side proxy to a controller elsewhere; data crosses the wire
-through the registry's transparent proxy).
+thin node-side proxy to a controller elsewhere; data windows stream
+controller-DIRECT over a pooled channel by default, with the registry's
+transparent proxy as the fallback — see doc/architecture.md "Data path";
+--no-direct-data pins everything to the proxy).
 """
 
 from __future__ import annotations
@@ -39,6 +41,21 @@ def main(argv: list[str] | None = None) -> int:
     add_registry_flag(parser, help_suffix="remote mode")
     parser.add_argument("--controller-id", default="",
                         help="remote mode: target controller")
+    parser.add_argument(
+        "--warm-standby", action="store_true",
+        help="remote mode: after each publish, prestage the live replica "
+             "controller at the same mesh coordinate (PrestageVolume), so "
+             "a later failover re-publish hits its stage cache in O(1)")
+    parser.add_argument(
+        "--no-direct-data", dest="direct_data", action="store_false",
+        help="remote mode: stream every data window through the "
+             "registry's transparent proxy instead of dialing the owning "
+             "controller's registered endpoint directly (the direct path "
+             "is the default; the proxy always remains the fallback)")
+    parser.add_argument(
+        "--window-chunk-bytes", type=int, default=0,
+        help="preferred ReadVolume chunk size requested from the "
+             "controller (0 = feeder default, 16 MiB; the server clamps)")
     parser.add_argument("--device-mesh", default="",
                         help="local tpu mode: device mesh for NamedSharding "
                              "placements, e.g. data=4,model=2")
@@ -46,6 +63,10 @@ def main(argv: list[str] | None = None) -> int:
     add_common_flags(parser)
     add_observability_flags(parser)
     args = parser.parse_args(argv)
+    if args.window_chunk_bytes < 0:
+        parser.error(
+            f"--window-chunk-bytes must be positive (0 = default), "
+            f"got {args.window_chunk_bytes}")
     setup_logging(args)
     obs = start_observability(args, "oim-feeder")
     log = from_context()
@@ -57,6 +78,12 @@ def main(argv: list[str] | None = None) -> int:
             "exactly one of --backend (local) or "
             "--registry + --controller-id (remote) required"
         )
+
+    if local and args.warm_standby:
+        # Prestaging targets a REPLICA controller resolved from the
+        # registry; a local-mode daemon has no registry to resolve from.
+        raise SystemExit("--warm-standby requires remote mode "
+                         "(--registry + --controller-id)")
 
     if local:
         from oim_tpu.controller.controller import ControllerService
@@ -76,6 +103,9 @@ def main(argv: list[str] | None = None) -> int:
             registry_address=args.registry,
             controller_id=args.controller_id,
             tls=load_tls_flags(args),
+            warm_standby=args.warm_standby,
+            direct_data=args.direct_data,
+            window_chunk_bytes=args.window_chunk_bytes,
         )
 
     daemon = FeederDaemon(feeder, default_timeout=args.publish_timeout)

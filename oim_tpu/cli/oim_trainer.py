@@ -149,6 +149,11 @@ def main(argv: list[str] | None = None) -> int:
                         help="host-resident feed window; 0 = materialize "
                              "the whole volume (small volumes only)")
     parser.add_argument("--publish-timeout", type=float, default=60.0)
+    parser.add_argument(
+        "--no-direct-data", dest="direct_data", action="store_false",
+        help="stream feed windows through the registry proxy instead of "
+             "dialing the owning controller directly (direct is the "
+             "default; the proxy always remains the fallback)")
     parser.add_argument("--profile", default="",
                         help="capture a jax.profiler trace of the train "
                              "loop into this directory")
@@ -267,6 +272,7 @@ def main(argv: list[str] | None = None) -> int:
                 registry_address=args.registry,
                 controller_id=args.controller_id,
                 tls=tls,
+                direct_data=getattr(args, "direct_data", True),
             )
 
             def _make_feed(start):

@@ -15,9 +15,9 @@ from oim_tpu.cli.common import (
     load_tls_flags,
     setup_logging,
 )
+from oim_tpu.common import channelpool
 from oim_tpu.common.endpoints import FAILOVER_CODES, RegistryEndpoints
 from oim_tpu.common.pathutil import REGISTRY_ADDRESS, REGISTRY_MESH
-from oim_tpu.common.tlsutil import dial
 from oim_tpu.spec import RegistryStub, pb
 
 
@@ -239,27 +239,30 @@ def main(argv: list[str] | None = None) -> int:
     tls = load_tls_flags(args, peer_name="component.registry")
     endpoints = RegistryEndpoints(args.registry)
 
+    pool = channelpool.shared()
+
     def connect(endpoint: str) -> grpc.Channel:
-        # tlsutil.dial: mTLS when configured, and the telemetry client
-        # interceptor either way (oimctl's calls show up in traces too).
-        return dial(endpoint, tls)
+        # Pooled tlsutil.dial: mTLS when configured, the telemetry client
+        # interceptor either way (oimctl's calls show up in traces too),
+        # and one channel per endpoint across this invocation's commands
+        # (--promote's role probes + the follow-up --health reuse it).
+        return pool.get(endpoint, tls)
 
     def with_failover(op):
         """Run ``op(stub)`` against the current endpoint, rotating through
         the list on the failover statuses (dead endpoint / unpromoted
-        standby refusing a write)."""
+        standby refusing a write). A dead endpoint's pooled channel is
+        evicted so a later retry re-dials instead of reusing the corpse."""
         last_err = None
         for _ in range(len(endpoints)):
-            channel = connect(endpoints.current())
             try:
-                return op(RegistryStub(channel))
+                return op(RegistryStub(connect(endpoints.current())))
             except grpc.RpcError as err:
+                pool.maybe_evict(err, endpoints.current())
                 if err.code() not in FAILOVER_CODES or not endpoints.multiple:
                     raise
                 last_err = err
                 endpoints.advance()
-            finally:
-                channel.close()
         raise last_err
 
     def promote() -> None:
@@ -269,9 +272,8 @@ def main(argv: list[str] | None = None) -> int:
         roles = {}
         target = None
         for endpoint in endpoints.all():
-            channel = connect(endpoint)
             try:
-                reply = RegistryStub(channel).GetValues(
+                reply = RegistryStub(connect(endpoint)).GetValues(
                     pb.GetValuesRequest(path="registry/role"), timeout=10)
                 roles[endpoint] = {v.path: v.value for v in reply.values}.get(
                     "registry/role", "unreplicated")
@@ -279,23 +281,18 @@ def main(argv: list[str] | None = None) -> int:
                     target = endpoint
                     break
             except grpc.RpcError as err:
+                pool.maybe_evict(err, endpoint)
                 roles[endpoint] = f"unreachable ({err.code().name})"
-            finally:
-                channel.close()
         if target is None:
             raise SystemExit(
                 "--promote: no STANDBY among the endpoints — nothing to "
                 f"promote (saw: {roles})")
-        channel = connect(target)
-        try:
-            RegistryStub(channel).SetValue(
-                pb.SetValueRequest(
-                    value=pb.Value(path="registry/promote", value="1")),
-                timeout=10,
-            )
-            print(f"promoted {target}")
-        finally:
-            channel.close()
+        RegistryStub(connect(target)).SetValue(
+            pb.SetValueRequest(
+                value=pb.Value(path="registry/promote", value="1")),
+            timeout=10,
+        )
+        print(f"promoted {target}")
         # Follow-up ops in this invocation (--set/--get/--health) must hit
         # the NEW primary: the superseded one would still accept a write
         # for the seconds until its next peer probe demotes it — and then

@@ -370,6 +370,25 @@ REGISTRY_PROMOTIONS = DEFAULT.counter(
 REGISTRY_ROLE = DEFAULT.gauge(
     "oim_registry_role",
     "replication role of this registry: 1 = PRIMARY, 0 = STANDBY")
+# Direct data path (feeder/driver.py + common/channelpool.py): windows
+# served controller-direct vs through the registry proxy, per-window
+# throughput, and the pooled-channel census.
+WINDOW_PATH_TOTAL = DEFAULT.counter(
+    "oim_window_path_total",
+    "data windows served, by path: direct = feeder dialed the owning "
+    "controller's registered endpoint; proxy = streamed through the "
+    "registry's transparent proxy (first contact, direct-dial failure, "
+    "or direct_data=False)",
+    labelnames=("path",))
+WINDOW_GBPS = DEFAULT.histogram(
+    "oim_window_gbps",
+    "throughput of each remote data-window read (window bytes / wall "
+    "seconds, GB/s), both paths",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 4.0, 8.0,
+             16.0, 32.0))
+CHANNEL_POOL_SIZE = DEFAULT.gauge(
+    "oim_channel_pool_size",
+    "live pooled gRPC channels across every ChannelPool in this process")
 # Labeled RPC telemetry (common/tracing.py interceptors — the
 # go-grpc-prometheus analog; recorded by client and server vantage alike).
 RPC_LATENCY = DEFAULT.histogram(

@@ -17,7 +17,11 @@ from typing import Callable, Sequence
 import grpc
 
 from oim_tpu.common.logging import from_context
-from oim_tpu.common.tlsutil import TLSConfig, server_credentials
+from oim_tpu.common.tlsutil import (
+    GRPC_MAX_MESSAGE_BYTES,
+    TLSConfig,
+    server_credentials,
+)
 
 
 def parse_endpoint(endpoint: str) -> tuple[str, str]:
@@ -76,10 +80,18 @@ class NonBlockingGRPCServer:
         options: Sequence[tuple[str, object]] = (),
     ) -> None:
         scheme, address = parse_endpoint(self._endpoint)
+        # Raised message caps on every oim server, mirroring dial_options:
+        # ReadVolume chunks up to the controller's MAX_READ_CHUNK must
+        # clear both ends (and the transparent proxy in between). Caller
+        # options append after, so they can override.
         server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=self._max_workers),
             interceptors=self._interceptors,
-            options=list(options),
+            options=[
+                ("grpc.max_send_message_length", GRPC_MAX_MESSAGE_BYTES),
+                ("grpc.max_receive_message_length", GRPC_MAX_MESSAGE_BYTES),
+                *options,
+            ],
         )
         register(server)
         if scheme == "unix":

@@ -21,6 +21,18 @@ from pathlib import Path
 
 import grpc
 
+# Ceiling for one gRPC message on every oim channel and server (gRPC's
+# stock default is 4 MiB). Sized so a ReadVolume chunk at the
+# controller's MAX_READ_CHUNK (16 MiB) plus first-chunk framing (spec +
+# total_bytes) clears it with room: big windows stream in a few large
+# messages instead of dozens of 3 MiB ones.
+GRPC_MAX_MESSAGE_BYTES = 32 << 20
+
+_MESSAGE_SIZE_OPTIONS = [
+    ("grpc.max_send_message_length", GRPC_MAX_MESSAGE_BYTES),
+    ("grpc.max_receive_message_length", GRPC_MAX_MESSAGE_BYTES),
+]
+
 
 @dataclasses.dataclass(frozen=True)
 class TLSConfig:
@@ -64,10 +76,14 @@ def channel_credentials(cfg: TLSConfig) -> grpc.ChannelCredentials:
     )
 
 
-def dial_options(peer_name: str) -> list[tuple[str, str]]:
-    """Channel args pinning the far end's certificate identity (reference
-    ChooseDialOpts + ServerName, grpc.go:43-67,96-99)."""
-    return [("grpc.ssl_target_name_override", peer_name)] if peer_name else []
+def dial_options(peer_name: str) -> list[tuple[str, object]]:
+    """Channel args: peer-identity pinning (reference ChooseDialOpts +
+    ServerName, grpc.go:43-67,96-99) plus the raised message-size caps
+    every oim channel carries (big ReadVolume chunks)."""
+    options: list[tuple[str, object]] = list(_MESSAGE_SIZE_OPTIONS)
+    if peer_name:
+        options.append(("grpc.ssl_target_name_override", peer_name))
+    return options
 
 
 def secure_channel(address: str, cfg: TLSConfig, peer_name: str | None = None) -> grpc.Channel:
@@ -89,7 +105,7 @@ def dial(address: str, tls: TLSConfig | None, peer_name: str = "") -> grpc.Chann
     if tls is not None:
         channel = secure_channel(address, tls, peer_name or tls.peer_name)
     else:
-        channel = grpc.insecure_channel(address)
+        channel = grpc.insecure_channel(address, options=dial_options(""))
     return grpc.intercept_channel(channel, TelemetryClientInterceptor())
 
 

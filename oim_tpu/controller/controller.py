@@ -9,7 +9,9 @@
   reference's self-registration loop, controller.go:411-476, upgraded to
   leases): a background thread registers ``<id>/address`` and ``<id>/mesh``
   with a lease TTL, then HEARTBEATS every ``registry_delay`` seconds to renew
-  it (fresh channel per attempt — README.md:138-143). ``known == false`` in a
+  it over ONE pooled channel (common/channelpool.py — the reference's fresh
+  channel per attempt, README.md:138-143, paid a TLS handshake per renewal;
+  the pool evicts on UNAVAILABLE and re-dials on recovery). ``known == false`` in a
   heartbeat reply (registry restarted, lease swept) triggers an immediate
   full re-registration; registry outages back off exponentially with jitter
   so a restarting registry isn't thundering-herded by the fleet; a registry
@@ -25,7 +27,7 @@ import time
 
 import grpc
 
-from oim_tpu.common import faultinject, metrics as M
+from oim_tpu.common import channelpool, faultinject, metrics as M
 from oim_tpu.common.endpoints import FAILOVER_CODES, RegistryEndpoints
 from oim_tpu.common.keymutex import KeyMutex
 from oim_tpu.common.logging import from_context
@@ -33,7 +35,7 @@ from oim_tpu.common.meshcoord import MeshCoord
 from oim_tpu.common.pathutil import REGISTRY_ADDRESS, REGISTRY_MESH
 from oim_tpu.common.server import NonBlockingGRPCServer
 from oim_tpu.common.interceptors import LogServerInterceptor
-from oim_tpu.common.tlsutil import TLSConfig, dial
+from oim_tpu.common.tlsutil import TLSConfig
 from oim_tpu.controller.backend import StagedVolume, StageState, StagingBackend
 from oim_tpu.spec import ControllerServicer, RegistryStub, add_controller_to_server, pb
 
@@ -187,10 +189,15 @@ class ControllerService(ControllerServicer):
         )
         return pb.PrestageVolumeReply(already_cached=False)
 
-    # Must leave headroom under gRPC's 4 MiB default max message size: the
-    # chunk rides in a message with framing + (on the first chunk) spec and
-    # total_bytes fields.
+    # Default chunk when the client doesn't ask: leaves headroom under
+    # gRPC's stock 4 MiB max message size, so even a consumer that dialed
+    # without the raised oim caps (tests, third-party stubs) can stream.
     DEFAULT_READ_CHUNK = 3 << 20
+    # Cap for a client-REQUESTED chunk_bytes: feeders that dialed through
+    # tlsutil (GRPC_MAX_MESSAGE_BYTES = 32 MiB on both ends) pull big
+    # windows in a few large messages instead of dozens of 3 MiB ones.
+    # 16 MiB + first-chunk framing clears the 32 MiB cap with margin.
+    MAX_READ_CHUNK = 16 << 20
 
     def ReadVolume(self, request, context):
         """Stream a staged volume back to a cross-process consumer — the
@@ -226,8 +233,11 @@ class ControllerService(ControllerServicer):
         host = np.ascontiguousarray(np.asarray(arr.reshape(-1)[e0:e1]))
         raw_win = host.view(np.uint8).reshape(-1)[
             start - e0 * itemsize:end - e0 * itemsize]
-        chunk = int(request.chunk_bytes) or self.DEFAULT_READ_CHUNK
-        chunk = max(1, min(chunk, self.DEFAULT_READ_CHUNK))
+        chunk = int(request.chunk_bytes)
+        # Non-positive = "not asked" (a negative value must not clamp to
+        # 1-byte chunks and stream a window as millions of messages).
+        chunk = min(chunk, self.MAX_READ_CHUNK) if chunk > 0 \
+            else self.DEFAULT_READ_CHUNK
         first = True
         for off in range(start, end, chunk) if start < end else [start]:
             stop = min(off + chunk, end)
@@ -264,6 +274,7 @@ class Controller:
         lease_seconds: float = 0.0,
         mesh_coord: MeshCoord | None = None,
         tls: TLSConfig | None = None,
+        pool: channelpool.ChannelPool | None = None,
     ):
         if registry_address and not controller_address:
             raise ValueError("registration requires a controller address")
@@ -287,21 +298,31 @@ class Controller:
         self.lease_seconds = max(lease_seconds, 0.0)
         self.mesh_coord = mesh_coord
         self.tls = tls
+        self._pool = pool if pool is not None else channelpool.shared()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     # -- heartbeat loop ----------------------------------------------------
 
     def _registry_channel(self) -> grpc.Channel:
-        return dial(self._endpoints.current(), self.tls, "component.registry")
+        """The POOLED channel to the current registry endpoint: the
+        heartbeat loop renews a lease every registry_delay seconds for
+        the process lifetime — the single worst per-call-dial churn in
+        the control plane (a TLS handshake per renewal, forever). A dead
+        endpoint's channel is evicted by the loop's error path and
+        re-dialed on recovery."""
+        return self._pool.get(
+            self._endpoints.current(), self.tls, "component.registry")
+
+    def _evict_registry_channel(self, err: Exception) -> None:
+        self._pool.maybe_evict(err, self._endpoints.current())
 
     def register_once(self) -> None:
-        """One full registration (address + mesh, with lease) over a fresh
-        channel (controller.go:448-468)."""
+        """One full registration (address + mesh, with lease) over the
+        pooled channel (controller.go:448-468, minus its per-call dial)."""
         faultinject.fire("controller.register", controller_id=self.controller_id)
-        channel = self._registry_channel()
+        stub = RegistryStub(self._registry_channel())
         try:
-            stub = RegistryStub(channel)
             stub.SetValue(
                 pb.SetValueRequest(
                     value=pb.Value(
@@ -323,18 +344,19 @@ class Controller:
                     ),
                     timeout=10.0,
                 )
-        finally:
-            channel.close()
+        except grpc.RpcError as err:
+            self._evict_registry_channel(err)
+            raise
 
     def heartbeat_once(self) -> bool:
-        """One lease renewal over a fresh channel. Returns the registry's
-        ``known`` verdict (False = it lost our registration; re-register).
-        Raises grpc.RpcError with UNIMPLEMENTED against a pre-lease
-        registry (the caller degrades to plain re-registration)."""
+        """One lease renewal over the pooled channel. Returns the
+        registry's ``known`` verdict (False = it lost our registration;
+        re-register). Raises grpc.RpcError with UNIMPLEMENTED against a
+        pre-lease registry (the caller degrades to plain
+        re-registration)."""
         faultinject.fire("controller.heartbeat", controller_id=self.controller_id)
-        channel = self._registry_channel()
+        stub = RegistryStub(self._registry_channel())
         try:
-            stub = RegistryStub(channel)
             t0 = time.monotonic()
             reply = stub.Heartbeat(
                 pb.HeartbeatRequest(
@@ -345,8 +367,9 @@ class Controller:
             )
             M.HEARTBEAT_RTT.set(time.monotonic() - t0)
             return reply.known
-        finally:
-            channel.close()
+        except grpc.RpcError as err:
+            self._evict_registry_channel(err)
+            raise
 
     def start(self) -> None:
         """Begin the register-then-heartbeat loop (controller.go:411-446,

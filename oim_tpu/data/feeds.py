@@ -68,6 +68,7 @@ def feeder_batches(args, cfg: TrainConfig, tls, start_batch: int = 0,
             registry_address=args.registry,
             controller_id=args.controller_id,
             tls=tls,
+            direct_data=getattr(args, "direct_data", True),
         )
     req = pb.MapVolumeRequest(volume_id=args.volume)
     if getattr(args, "volume_webdataset", ""):

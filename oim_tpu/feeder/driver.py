@@ -23,17 +23,18 @@ import dataclasses
 import random
 import threading
 import time
+import weakref
 from typing import Any, Mapping
 
 import grpc
 
-from oim_tpu.common import faultinject, metrics as M, tracing
+from oim_tpu.common import channelpool, faultinject, metrics as M, tracing
 from oim_tpu.common.endpoints import RegistryEndpoints
 from oim_tpu.common.keymutex import KeyMutex
 from oim_tpu.common.logging import from_context
 from oim_tpu.common.meshcoord import MeshCoord
 from oim_tpu.common.pathutil import REGISTRY_ADDRESS, REGISTRY_MESH
-from oim_tpu.common.tlsutil import TLSConfig, dial
+from oim_tpu.common.tlsutil import TLSConfig
 from oim_tpu.controller.controller import ControllerService
 from oim_tpu.feeder.emulation import map_volume_params
 from oim_tpu.registry.registry import CONTROLLER_ID_META
@@ -75,6 +76,35 @@ class Feeder:
     # capped at POLL_CAP_S (well under any practical publish deadline).
     POLL_BASE_S = 0.002
     POLL_CAP_S = 0.25
+    # Direct-endpoint cache TTL: the feeder re-reads the registry's LIVE
+    # (lease-filtered) view at most this often per volume, so a
+    # controller whose lease lapsed — or whose address moved — stops
+    # being dialed directly within one TTL even when its channel happens
+    # to stay up. Failures invalidate immediately; this bounds the
+    # silent-staleness window only.
+    DIRECT_TTL_S = 30.0
+    # Preferred ReadVolume chunk size requested from the server (the
+    # server clamps to its MAX_READ_CHUNK): big windows stream in a few
+    # large messages instead of dozens of 3 MiB ones.
+    WINDOW_CHUNK_BYTES = 16 << 20
+    # Deadline for the first-use probe of a freshly (re)dialed direct
+    # channel: a registered-but-unroutable endpoint (firewalled pod IP —
+    # TCP may connect but nothing speaks gRPC) HANGS instead of
+    # refusing, and this bounds that failure mode on the probe instead
+    # of on the window read itself, which gets the caller's full
+    # remaining budget. Verified channels (WeakSet) skip the probe, so
+    # steady state pays zero extra RPCs.
+    DIRECT_PROBE_TIMEOUT_S = 5.0
+    # A window READ's DEADLINE_EXCEEDED only arms the one-TTL direct
+    # back-off when the deadline that expired was at least this long: a
+    # sub-second read budget (heal loop near its deadline) missing is
+    # evidence about the BUDGET, not the endpoint, and must not pin
+    # later well-budgeted windows to the proxy for 30s. The 1-byte
+    # PROBE is different — it should complete in milliseconds, so
+    # missing ANY deadline is endpoint evidence and always arms
+    # (otherwise a tight-budget feed against a black-holed endpoint
+    # would re-pay the probe hang on every single window).
+    BACKOFF_MIN_DEADLINE_S = 1.0
 
     def __init__(
         self,
@@ -83,6 +113,9 @@ class Feeder:
         controller_id: str = "",
         tls: TLSConfig | None = None,
         warm_standby: bool = False,
+        direct_data: bool = True,
+        window_chunk_bytes: int = 0,
+        pool: channelpool.ChannelPool | None = None,
     ):
         local = controller is not None
         remote = bool(registry_address or controller_id)
@@ -110,6 +143,30 @@ class Feeder:
         # re-publish then hits the replica's stage cache in O(1) instead
         # of re-staging O(volume) from source.
         self.warm_standby = warm_standby
+        # Remote mode data plane: resolve the owning controller's DIRECT
+        # endpoint from the registry topology and stream ReadVolume
+        # straight to it — the registry proxy stays the fallback (first
+        # contact, direct-dial failure, direct_data=False). The control
+        # plane (MapVolume/StageStatus/UnmapVolume) always rides the
+        # proxy: the registry owns routing and authorization there.
+        self.direct_data = direct_data
+        if window_chunk_bytes < 0:
+            raise ValueError(
+                f"window_chunk_bytes must be positive (0 = default "
+                f"{self.WINDOW_CHUNK_BYTES}), got {window_chunk_bytes}")
+        self.window_chunk_bytes = window_chunk_bytes or self.WINDOW_CHUNK_BYTES
+        self._pool = pool if pool is not None else channelpool.shared()
+        # (pinned controller's address, resolved_at monotonic) — one entry:
+        # the direct endpoint is a property of the controller, not of any
+        # volume. _direct_retry_at > now suppresses the direct path after
+        # a deadline-class failure (see _fetch_window_once).
+        self._direct_addr: tuple[str, float] | None = None
+        self._direct_retry_at = 0.0
+        # Channels that have answered at least one RPC: first use of a
+        # (re)dialed direct channel is probed (hang insurance), verified
+        # ones are not. Weak so an evicted channel's entry dies with it.
+        self._direct_verified: "weakref.WeakSet[grpc.Channel]" = (
+            weakref.WeakSet())
         self._published: dict[str, PublishedVolume] = {}
         self._lock = threading.Lock()
         self._keymutex = KeyMutex()
@@ -117,9 +174,12 @@ class Feeder:
     # -- plumbing ---------------------------------------------------------
 
     def _registry_channel(self) -> grpc.Channel:
-        """Fresh dial per operation (reference DialRegistry,
-        oim-driver.go:219-232); targets the endpoint list's current pick."""
-        return dial(self._endpoints.current(), self.tls, "component.registry")
+        """The pooled channel to the endpoint list's current pick (one
+        persistent channel per registry endpoint, not the reference's
+        fresh DialRegistry per operation — oim-driver.go:219-232 — whose
+        per-window TLS handshake the direct data path exists to kill)."""
+        return self._pool.get(
+            self._endpoints.current(), self.tls, "component.registry")
 
     def _fire_rpc_fault(self, method: str) -> None:
         """Fault point for the remote data plane: an armed ``feeder.rpc``
@@ -149,15 +209,16 @@ class Feeder:
     # -- failure recovery: re-resolve + failover ---------------------------
 
     def _registry_entries(self, include_stale: bool = False) -> dict[str, str]:
-        channel = self._registry_channel()
+        address = self._endpoints.current()
         try:
-            reply = RegistryStub(channel).GetValues(
+            reply = RegistryStub(self._registry_channel()).GetValues(
                 pb.GetValuesRequest(path="", include_stale=include_stale),
                 timeout=10.0,
             )
-            return {v.path: v.value for v in reply.values}
-        finally:
-            channel.close()
+        except grpc.RpcError as err:
+            self._pool.maybe_evict(err, address)
+            raise
+        return {v.path: v.value for v in reply.values}
 
     def _failover_target(self) -> str | None:
         """A LIVE controller registered at the same mesh coordinate as the
@@ -211,6 +272,12 @@ class Feeder:
         )
         M.FEEDER_FAILOVERS.inc()
         self.controller_id = target
+        # The direct-endpoint cache is per PINNED controller: it points
+        # at the dead one's address now — and so does any armed direct
+        # back-off, which must not pin windows to the proxy for a TTL
+        # against the healthy replacement.
+        self._direct_addr = None
+        self._direct_retry_at = 0.0
         return True
 
     def prestage_replica(self, request: pb.MapVolumeRequest) -> str | None:
@@ -228,9 +295,9 @@ class Feeder:
         target = self._failover_target()
         if target is None:
             return None
-        channel = self._registry_channel()
+        address = self._endpoints.current()
         try:
-            ControllerStub(channel).PrestageVolume(
+            ControllerStub(self._registry_channel()).PrestageVolume(
                 request,
                 metadata=[(CONTROLLER_ID_META, target)],
                 timeout=30.0,
@@ -241,14 +308,13 @@ class Feeder:
             )
             return target
         except grpc.RpcError as err:
+            self._pool.maybe_evict(err, address)
             from_context().warning(
                 "standby prestage failed",
                 volume=request.volume_id, target=target,
                 error=err.code().name,
             )
             return None
-        finally:
-            channel.close()
 
     class _LocalContext:
         """Adapts grpc abort() to exceptions for in-process calls."""
@@ -375,86 +441,85 @@ class Feeder:
         )
 
     def _publish_remote(self, request, deadline) -> PublishedVolume:
+        address = self._endpoints.current()
         channel = self._registry_channel()
+        registry = RegistryStub(channel)
+        # The proxy routes Controller methods by metadata
+        # (nodeserver.go:230-251).
+        stub = ControllerStub(channel)
+        metadata = [(CONTROLLER_ID_META, self.controller_id)]
+        self._fire_rpc_fault("MapVolume")
         try:
-            registry = RegistryStub(channel)
-            # The proxy routes Controller methods by metadata
-            # (nodeserver.go:230-251).
-            stub = ControllerStub(channel)
-            metadata = [(CONTROLLER_ID_META, self.controller_id)]
-            self._fire_rpc_fault("MapVolume")
-            try:
-                # Inside the RpcError-to-PublishError wrapper: a dead
-                # registry must surface as code=UNAVAILABLE so the
-                # endpoint-list failover in the caller can rotate.
-                default_coord = self._default_mesh(registry)
-                reply = stub.MapVolume(
-                    request,
-                    metadata=metadata,
-                    timeout=deadline - time.monotonic(),
-                )
-                # Wait for materialization (the waitForDevice analog,
-                # nodeserver.go:325-366): poll StageStatus until ready. Every
-                # RPC is bounded by the caller's remaining deadline.
-                def remaining() -> float:
-                    rem = deadline - time.monotonic()
-                    if rem <= 0:
-                        raise DeadlineExceeded(
-                            f"staging {request.volume_id!r} timed out"
-                        )
-                    return rem
+            # Inside the RpcError-to-PublishError wrapper: a dead
+            # registry must surface as code=UNAVAILABLE so the
+            # endpoint-list failover in the caller can rotate.
+            default_coord = self._default_mesh(registry)
+            reply = stub.MapVolume(
+                request,
+                metadata=metadata,
+                timeout=deadline - time.monotonic(),
+            )
+            # Wait for materialization (the waitForDevice analog,
+            # nodeserver.go:325-366): poll StageStatus until ready. Every
+            # RPC is bounded by the caller's remaining deadline.
+            def remaining() -> float:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    raise DeadlineExceeded(
+                        f"staging {request.volume_id!r} timed out"
+                    )
+                return rem
 
-                # Decorrelated-jitter backoff (capped well under any
-                # sane deadline): a fast stage is noticed in ~ms instead
-                # of a fixed 50 ms quantum, a long one is polled gently,
-                # and a fleet of feeders never beats on the controller in
-                # lockstep. The histogram makes publish latency spent in
-                # this loop attributable from /metrics alone.
-                wait_t0 = time.monotonic()
-                delay = self.POLL_BASE_S
-                try:
-                    while True:
-                        status = stub.StageStatus(
-                            pb.StageStatusRequest(volume_id=request.volume_id),
-                            metadata=metadata,
-                            timeout=remaining(),
-                        )
-                        if status.error:
-                            raise PublishError(status.error)
-                        if status.ready:
-                            break
-                        delay = min(
-                            self.POLL_CAP_S,
-                            random.uniform(  # noqa: S311 - jitter
-                                self.POLL_BASE_S, delay * 3),
-                        )
-                        time.sleep(min(delay, remaining()))
-                finally:
-                    M.STAGE_WAIT_SECONDS.observe(time.monotonic() - wait_t0)
-                reply = stub.MapVolume(
-                    request, metadata=metadata, timeout=remaining()
-                )  # refresh placement with final byte count
-            except grpc.RpcError as err:
-                if err.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
-                    raise DeadlineExceeded(err.details()) from err
-                raise PublishError(
-                    f"{err.code().name}: {err.details()}",
-                    code=err.code().name,
-                ) from err
-            # Merge returned coordinate with the registry default, exactly
-            # CompletePCIAddress (nodeserver.go:253-273, pci.go:51-65).
-            coord = MeshCoord.from_proto(reply.placement.coordinate).complete(
-                default_coord
-            )
-            return PublishedVolume(
-                volume_id=request.volume_id,
-                coordinate=coord,
-                device_id=reply.placement.device_id,
-                bytes=reply.placement.bytes,
-                handle=reply.buffer_handle,
-            )
-        finally:
-            channel.close()
+            # Decorrelated-jitter backoff (capped well under any
+            # sane deadline): a fast stage is noticed in ~ms instead
+            # of a fixed 50 ms quantum, a long one is polled gently,
+            # and a fleet of feeders never beats on the controller in
+            # lockstep. The histogram makes publish latency spent in
+            # this loop attributable from /metrics alone.
+            wait_t0 = time.monotonic()
+            delay = self.POLL_BASE_S
+            try:
+                while True:
+                    status = stub.StageStatus(
+                        pb.StageStatusRequest(volume_id=request.volume_id),
+                        metadata=metadata,
+                        timeout=remaining(),
+                    )
+                    if status.error:
+                        raise PublishError(status.error)
+                    if status.ready:
+                        break
+                    delay = min(
+                        self.POLL_CAP_S,
+                        random.uniform(  # noqa: S311 - jitter
+                            self.POLL_BASE_S, delay * 3),
+                    )
+                    time.sleep(min(delay, remaining()))
+            finally:
+                M.STAGE_WAIT_SECONDS.observe(time.monotonic() - wait_t0)
+            reply = stub.MapVolume(
+                request, metadata=metadata, timeout=remaining()
+            )  # refresh placement with final byte count
+        except grpc.RpcError as err:
+            self._pool.maybe_evict(err, address)
+            if err.code() == grpc.StatusCode.DEADLINE_EXCEEDED:
+                raise DeadlineExceeded(err.details()) from err
+            raise PublishError(
+                f"{err.code().name}: {err.details()}",
+                code=err.code().name,
+            ) from err
+        # Merge returned coordinate with the registry default, exactly
+        # CompletePCIAddress (nodeserver.go:253-273, pci.go:51-65).
+        coord = MeshCoord.from_proto(reply.placement.coordinate).complete(
+            default_coord
+        )
+        return PublishedVolume(
+            volume_id=request.volume_id,
+            coordinate=coord,
+            device_id=reply.placement.device_id,
+            bytes=reply.placement.bytes,
+            handle=reply.buffer_handle,
+        )
 
     # -- data window --------------------------------------------------------
 
@@ -462,8 +527,9 @@ class Feeder:
         """The staged volume's data as a host numpy array.
 
         Local mode: the live array, zero-copy from the shared runtime.
-        Remote mode: streamed through the registry proxy via ReadVolume
-        (the vhost-user data-window analog, spec.md ReadVolume).
+        Remote mode: the whole-volume window — ReadVolume direct to the
+        owning controller when resolvable, through the registry proxy
+        otherwise, assembled without a join copy (_fetch_window_once).
         """
         import numpy as np
 
@@ -474,33 +540,12 @@ class Feeder:
             if volume is None:
                 raise PublishError(f"no volume {volume_id!r}", code="NOT_FOUND")
             return np.asarray(volume.array)
-        channel = self._registry_channel()
-        try:
-            stub = ControllerStub(channel)
-            parts: list[bytes] = []
-            spec = None
-            try:
-                for chunk in stub.ReadVolume(
-                    pb.ReadVolumeRequest(volume_id=volume_id),
-                    metadata=[(CONTROLLER_ID_META, self.controller_id)],
-                    timeout=timeout,
-                ):
-                    if spec is None and chunk.HasField("spec"):
-                        spec = chunk.spec
-                    parts.append(chunk.data)
-            except grpc.RpcError as err:
-                raise PublishError(
-                    f"{err.code().name}: {err.details()}",
-                    code=err.code().name,
-                ) from err
-            raw = np.frombuffer(b"".join(parts), dtype=np.uint8)
-            if spec is None:
-                return raw
-            arr = raw.view(spec_dtype(spec))
-            shape = tuple(int(d) for d in spec.shape)
-            return arr.reshape(shape) if shape else arr
-        finally:
-            channel.close()
+        raw, _, spec = self._fetch_window_once(volume_id, 0, 0, timeout)
+        if spec is None:
+            return raw
+        arr = raw.view(spec_dtype(spec))
+        shape = tuple(int(d) for d in spec.shape)
+        return arr.reshape(shape) if shape else arr
 
     # gRPC status codes (PublishError.code — never message text) that heal
     # treats as control-plane transients worth retrying or restaging.
@@ -518,6 +563,14 @@ class Feeder:
         smaller than the volume streams windows instead of materializing
         the whole thing host-side (the data window stays bounded the way
         the reference bounds SCSI targets, controller.go:127-148).
+
+        Remote mode serves the window CONTROLLER-DIRECT over a pooled
+        channel when the registry topology resolves the owning
+        controller's endpoint (direct_data=True, the default); the
+        registry proxy is the always-correct fallback — first contact,
+        direct-dial failure, or ``Feeder(direct_data=False)``. Which path
+        served it is recorded on the span (``path=direct|proxy``) and in
+        ``oim_window_path_total``.
 
         ``heal=True`` makes the window survive control-plane failures
         within ``timeout``: transient UNAVAILABLE (registry/controller
@@ -617,6 +670,163 @@ class Feeder:
                 just_failed_over = False
                 just_rotated_registry = False
 
+    def _direct_endpoint(self, budget: float = 10.0) -> str | None:
+        """The pinned controller's directly-dialable address, from the
+        registry's LIVE (lease-filtered) view, cached for DIRECT_TTL_S.
+        A PREFIX read of exactly the one address key — never the whole
+        registry dump — so resolution stays O(1) on the data hot path.
+        None when direct data is disabled or backing off after a
+        deadline-class failure, when the registry is unreachable
+        (first-contact: the proxy call will surface the real error), or
+        when the controller's lease has expired (the key vanishes from
+        the live view; the proxy fast-fails those — the direct path must
+        not outlive the lease)."""
+        if not self.direct_data:
+            return None
+        now = time.monotonic()
+        if now < self._direct_retry_at:
+            return None
+        cached = self._direct_addr
+        if cached is not None and now - cached[1] < self.DIRECT_TTL_S:
+            return cached[0]
+        key = f"{self.controller_id}/{REGISTRY_ADDRESS}"
+        address = self._endpoints.current()
+        if budget <= 0:
+            return None
+        try:
+            # Clamped to the caller's window budget: resolution must
+            # never overshoot the deadline the read itself lives under.
+            reply = RegistryStub(self._registry_channel()).GetValues(
+                pb.GetValuesRequest(path=key), timeout=min(10.0, budget))
+        except grpc.RpcError as err:
+            self._pool.maybe_evict(err, address)
+            return None
+        resolved = next(
+            (v.value for v in reply.values if v.path == key), "")
+        if not resolved:
+            self._direct_addr = None
+            return None
+        self._direct_addr = (resolved, now)
+        return resolved
+
+    def _read_window(self, channel, volume_id: str, offset: int, length: int,
+                     timeout: float):
+        """One ReadVolume stream off ``channel`` (direct or proxy),
+        assembled zero-copy: the first chunk's total_bytes sizes ONE
+        preallocated bytearray, every chunk lands in a memoryview slice
+        at its offset, and np.frombuffer wraps the buffer — no
+        join-the-parts copy, so one full window allocation is gone from
+        the training-feed hot loop. Raises grpc.RpcError raw — the
+        caller owns eviction/fallback policy."""
+        import numpy as np
+
+        call = ControllerStub(channel).ReadVolume(
+            pb.ReadVolumeRequest(
+                volume_id=volume_id, offset=offset, length=length,
+                chunk_bytes=self.window_chunk_bytes,
+            ),
+            metadata=[(CONTROLLER_ID_META, self.controller_id)],
+            timeout=timeout,
+        )
+        buf = None
+        view = None
+        spec = None
+        total = 0
+        end_rel = 0
+        try:
+            for chunk in call:
+                if spec is None and chunk.HasField("spec"):
+                    spec = chunk.spec
+                if buf is None:
+                    # First chunk: total_bytes bounds the window exactly
+                    # the way the server computes it.
+                    total = int(chunk.total_bytes)
+                    end = total if length == 0 else min(offset + length, total)
+                    buf = bytearray(max(end - offset, 0))
+                    view = memoryview(buf)
+                if chunk.data:
+                    rel = int(chunk.offset) - offset
+                    view[rel:rel + len(chunk.data)] = chunk.data
+                    end_rel = max(end_rel, rel + len(chunk.data))
+        except grpc.RpcError as err:
+            # Annotate how far the stream got before failing: the
+            # caller's deadline policy distinguishes "no bytes ever
+            # arrived" (stalled endpoint) from "a large window was still
+            # streaming fine when the caller's budget ran out".
+            err.oim_bytes_received = end_rel
+            raise
+        if buf is None:  # stream yielded nothing (cancelled mid-setup)
+            buf = bytearray()
+        raw = np.frombuffer(buf, dtype=np.uint8)
+        if end_rel != len(buf):
+            # Defensive: a server that streamed short must not hand the
+            # consumer uninitialized tail bytes as data.
+            raw = raw[:end_rel]
+        return raw, total, spec
+
+    def _record_window(self, path: str, nbytes: int, seconds: float) -> None:
+        M.WINDOW_PATH_TOTAL.labels(path=path).inc()
+        if seconds > 0:
+            M.WINDOW_GBPS.observe(nbytes / seconds / 1e9)
+        span = tracing.current()
+        if span is not None:
+            span.attrs["path"] = path
+
+    def _direct_transport_failure(self, code, arm_backoff: bool,
+                                  volume_id: str, direct: str,
+                                  what: str) -> None:
+        """Shared bookkeeping for a transport-class direct failure: drop
+        the channel and the cached endpoint, and — when the caller's
+        ``arm_backoff`` says the expired deadline is evidence about the
+        ENDPOINT rather than the budget (see BACKOFF_MIN_DEADLINE_S) —
+        arm the one-TTL back-off that keeps subsequent windows off the
+        stalled direct path."""
+        self._pool.evict(direct)
+        self._direct_addr = None
+        if code == grpc.StatusCode.DEADLINE_EXCEEDED and arm_backoff:
+            self._direct_retry_at = time.monotonic() + self.DIRECT_TTL_S
+        from_context().warning(
+            f"direct {what} failed; falling back to proxy",
+            volume=volume_id, endpoint=direct, code=code.name,
+        )
+
+    def _direct_channel_usable(self, channel, direct: str, volume_id: str,
+                               timeout: float) -> bool:
+        """Hang insurance for the direct path, paid once per (re)dialed
+        channel: a registered-but-unroutable endpoint (firewalled pod
+        IP) HANGS instead of refusing, so an unprobed channel's first
+        contact is a 1-byte ReadVolume bounded at
+        min(DIRECT_PROBE_TIMEOUT_S, timeout/2) — the window read itself
+        then gets the caller's FULL remaining budget (a legitimately
+        slow large window must not lose half its time to insurance).
+        A refused endpoint (UNAVAILABLE: dead port, restarted
+        controller) keeps fail-fast semantics — evict and fall through
+        to the proxy with NO back-off, so the next window re-resolves
+        and goes direct again; only a hang (the probe deadline) arms
+        the one-TTL back-off. An ANSWERED status (NOT_FOUND, ...)
+        verifies the channel too: the real read will surface the same
+        verdict."""
+        if channel in self._direct_verified:
+            return True
+        probe_timeout = min(self.DIRECT_PROBE_TIMEOUT_S, timeout / 2)
+        try:
+            list(ControllerStub(channel).ReadVolume(
+                pb.ReadVolumeRequest(
+                    volume_id=volume_id, offset=0, length=1),
+                metadata=[(CONTROLLER_ID_META, self.controller_id)],
+                timeout=probe_timeout,
+            ))
+        except grpc.RpcError as err:
+            code = err.code()
+            if code in (grpc.StatusCode.UNAVAILABLE,
+                        grpc.StatusCode.CANCELLED,
+                        grpc.StatusCode.DEADLINE_EXCEEDED):
+                self._direct_transport_failure(
+                    code, True, volume_id, direct, "endpoint probe")
+                return False
+        self._direct_verified.add(channel)
+        return True
+
     def _fetch_window_once(self, volume_id: str, offset: int, length: int,
                            timeout: float):
         import numpy as np
@@ -638,33 +848,81 @@ class Feeder:
             raw = host.view(np.uint8)[offset - e0 * itemsize:end - e0 * itemsize]
             return raw, total, volume.spec
         self._fire_rpc_fault("ReadVolume")
-        channel = self._registry_channel()
-        try:
-            stub = ControllerStub(channel)
-            parts: list[bytes] = []
-            spec = None
-            total = 0
+        # t_start tracks the caller's BUDGET (resolution + read + any
+        # fallback all spend it); per-path throughput is timed separately
+        # so the occasional TTL-expiry registry round trip never lands in
+        # the data histogram as a slow window.
+        t_start = time.monotonic()
+        deadline = t_start + timeout
+        direct = self._direct_endpoint(budget=timeout)
+        usable = False
+        if direct is not None and deadline - time.monotonic() > 0:
+            channel = self._pool.get(
+                direct, self.tls, f"controller.{self.controller_id}")
+            usable = self._direct_channel_usable(
+                channel, direct, volume_id, deadline - time.monotonic())
+        read_budget = deadline - time.monotonic()
+        if usable and read_budget > 0:
+            t0 = time.monotonic()
             try:
-                for chunk in stub.ReadVolume(
-                    pb.ReadVolumeRequest(
-                        volume_id=volume_id, offset=offset, length=length
-                    ),
-                    metadata=[(CONTROLLER_ID_META, self.controller_id)],
-                    timeout=timeout,
-                ):
-                    if spec is None and chunk.HasField("spec"):
-                        spec = chunk.spec
-                        total = chunk.total_bytes
-                    parts.append(chunk.data)
+                result = self._read_window(
+                    channel, volume_id, offset, length, read_budget)
+                self._record_window(
+                    "direct", result[0].size, time.monotonic() - t0)
+                return result
             except grpc.RpcError as err:
-                raise PublishError(
-                    f"{err.code().name}: {err.details()}",
-                    code=err.code().name,
-                ) from err
-            raw = np.frombuffer(b"".join(parts), dtype=np.uint8)
-            return raw, total, spec
-        finally:
-            channel.close()
+                # Transport-class failures fall THROUGH to the proxy —
+                # the first rung of the heal ladder, inside one call:
+                # UNAVAILABLE (dead/refusing endpoint, fails fast) and
+                # CANCELLED (the pooled channel was retired under us).
+                # DEADLINE_EXCEEDED splits on stream progress: a stream
+                # that WAS moving bytes is a healthy endpoint outrun by
+                # the caller's budget — surface the deadline honestly
+                # rather than evicting a good channel to re-move the
+                # same bytes over the strictly slower two-hop proxy —
+                # while zero bytes received means the endpoint went
+                # silent after verification: treat it like a probe hang
+                # (evict + back off). Anything else means the
+                # controller ANSWERED (NOT_FOUND, OUT_OF_RANGE...): the
+                # proxy would return the identical verdict, so surface
+                # it — the heal ladder branches on the code, not the
+                # path.
+                code = err.code()
+                if (code == grpc.StatusCode.DEADLINE_EXCEEDED
+                        and getattr(err, "oim_bytes_received", 0) > 0):
+                    raise DeadlineExceeded(
+                        f"direct window of {volume_id!r} was still "
+                        f"streaming when the {timeout:.1f}s budget ran out"
+                    ) from err
+                if code not in (
+                        grpc.StatusCode.UNAVAILABLE,
+                        grpc.StatusCode.CANCELLED,
+                        grpc.StatusCode.DEADLINE_EXCEEDED):
+                    raise PublishError(
+                        f"{code.name}: {err.details()}",
+                        code=code.name,
+                    ) from err
+                self._direct_transport_failure(
+                    code, read_budget >= self.BACKOFF_MIN_DEADLINE_S,
+                    volume_id, direct, "window read")
+        remaining = timeout - (time.monotonic() - t_start)
+        if remaining <= 0:
+            raise DeadlineExceeded(
+                f"window of {volume_id!r} timed out before the proxy "
+                "fallback could run")
+        address = self._endpoints.current()
+        t1 = time.monotonic()
+        try:
+            result = self._read_window(
+                self._registry_channel(), volume_id, offset, length, remaining)
+            self._record_window("proxy", result[0].size, time.monotonic() - t1)
+            return result
+        except grpc.RpcError as err:
+            self._pool.maybe_evict(err, address)
+            raise PublishError(
+                f"{err.code().name}: {err.details()}",
+                code=err.code().name,
+            ) from err
 
     # -- unpublish ---------------------------------------------------------
 
@@ -679,18 +937,16 @@ class Feeder:
                     pb.UnmapVolumeRequest(volume_id=volume_id), self._LocalContext()
                 )
                 return
-            channel = self._registry_channel()
+            address = self._endpoints.current()
             try:
-                stub = ControllerStub(channel)
-                stub.UnmapVolume(
+                ControllerStub(self._registry_channel()).UnmapVolume(
                     pb.UnmapVolumeRequest(volume_id=volume_id),
                     metadata=[(CONTROLLER_ID_META, self.controller_id)],
                     timeout=30.0,
                 )
             except grpc.RpcError as err:
+                self._pool.maybe_evict(err, address)
                 raise PublishError(
                     f"{err.code().name}: {err.details()}",
                     code=err.code().name,
                 ) from err
-            finally:
-                channel.close()

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import grpc
 
+from oim_tpu.controller.controller import ControllerService
 from oim_tpu.common.identity import IdentityService
 from oim_tpu.common.interceptors import LogServerInterceptor
 from oim_tpu.common.server import NonBlockingGRPCServer
@@ -31,9 +32,13 @@ from oim_tpu.spec import (
     pb,
 )
 
-# Same headroom rule as ControllerService.DEFAULT_READ_CHUNK: chunks must
-# clear gRPC's 4 MiB default message cap with framing to spare.
-READ_CHUNK = 3 << 20
+# Same rules as ControllerService (literally its constants, so the two
+# read paths can never drift): the default chunk clears gRPC's stock
+# 4 MiB message cap with framing to spare (clients that dialed without
+# the raised oim caps still stream), and a client-REQUESTED chunk_bytes
+# may go up to MAX_READ_CHUNK under the 32 MiB oim channel ceiling.
+READ_CHUNK = ControllerService.DEFAULT_READ_CHUNK
+MAX_READ_CHUNK = ControllerService.MAX_READ_CHUNK
 
 
 def _reply_for(pub: PublishedVolume, spec: pb.ArraySpec | None = None) -> pb.PublishVolumeReply:
@@ -107,8 +112,8 @@ class FeederDaemon(FeederServicer):
         volume_id = request.volume_id
         offset = int(request.offset)
         length = int(request.length)
-        chunk = int(request.chunk_bytes) or READ_CHUNK
-        chunk = max(1, min(chunk, READ_CHUNK))
+        chunk = int(request.chunk_bytes)
+        chunk = min(chunk, MAX_READ_CHUNK) if chunk > 0 else READ_CHUNK
         try:
             window, total, spec = self.feeder.fetch_window(
                 volume_id, offset, length, timeout=self.default_timeout

@@ -1,0 +1,450 @@
+"""Direct data path (ISSUE 5): proxy-free windows over pooled channels.
+
+The reference's core rule is that the control plane stays off the data
+path (README.md:39-40) — these tests pin the consume half: a feeder
+resolves the owning controller's registered endpoint and streams
+ReadVolume straight to it over ONE pooled channel; the registry's
+transparent proxy remains the always-correct fallback. Pinned here:
+
+* byte identity: direct ≡ proxy ≡ source, for windows and whole volumes;
+* fallback: a blackholed direct endpoint degrades to the proxy inside
+  one call, with identical bytes;
+* pooling: N windows dial the controller exactly once (spy on
+  tlsutil.dial), and a controller restart evicts the stale channel while
+  the healed window still completes;
+* zero-copy: the window path assembles into one preallocated buffer —
+  no b"".join anywhere in the driver (source-pinned).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+import grpc
+import numpy as np
+import pytest
+
+from oim_tpu.common import metrics as M, tlsutil
+from oim_tpu.common.channelpool import ChannelPool
+from oim_tpu.controller import ControllerService, MallocBackend
+from oim_tpu.controller.controller import controller_server
+from oim_tpu.feeder import Feeder
+from oim_tpu.feeder.driver import PublishError
+from oim_tpu.registry import MemRegistryDB, RegistryService
+from oim_tpu.registry.registry import registry_server
+from oim_tpu.spec import pb
+
+
+def _publish_file(feeder, volume_id, tmp_path, nbytes=100_000, seed=5):
+    data = np.random.RandomState(seed).bytes(nbytes)
+    path = tmp_path / f"{volume_id}.bin"
+    path.write_bytes(data)
+    feeder.publish(pb.MapVolumeRequest(
+        volume_id=volume_id,
+        file=pb.FileParams(path=str(path), format="raw"),
+    ))
+    return data
+
+
+def _read_all(feeder, volume_id, window=33_000):
+    got = bytearray()
+    offset = 0
+    while True:
+        w, total, spec = feeder.fetch_window(volume_id, offset, window)
+        assert spec is not None
+        got += w.tobytes()
+        offset += w.size
+        if offset >= total:
+            return bytes(got)
+
+
+def dead_endpoint() -> str:
+    """An address nothing listens on (bound, then closed)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return f"127.0.0.1:{s.getsockname()[1]}"
+
+
+class TestChannelPool:
+    def test_get_memoizes_per_target_and_peer(self):
+        dialed = []
+
+        def spy(address, tls, peer_name):
+            dialed.append((address, peer_name))
+            return grpc.insecure_channel(address)
+
+        pool = ChannelPool(dial=spy)
+        a = pool.get("localhost:1", None, "component.registry")
+        assert pool.get("localhost:1", None, "component.registry") is a
+        b = pool.get("localhost:1", None, "controller.host-0")
+        assert b is not a  # distinct pinned peer = distinct channel
+        pool.get("localhost:2", None, "component.registry")
+        assert len(dialed) == 3
+        assert len(pool) == 3
+        assert pool.stats()[("localhost:1", "component.registry")] == 1
+        pool.close()
+
+    def test_evict_closes_and_redial_counts(self):
+        pool = ChannelPool(
+            dial=lambda a, t, p: grpc.insecure_channel(a))
+        pool.get("localhost:1", None, "x")
+        pool.get("localhost:1", None, "y")
+        before = M.CHANNEL_POOL_SIZE.value
+        assert pool.evict("localhost:1") == 2
+        assert M.CHANNEL_POOL_SIZE.value == before - 2
+        assert len(pool) == 0
+        pool.get("localhost:1", None, "x")
+        assert pool.stats()[("localhost:1", "x")] == 2  # re-dialed
+        pool.close()
+
+    def test_maybe_evict_only_on_transport_codes(self):
+        """Answered statuses keep the channel; transport-class ones
+        (refused AND black-holed — DEADLINE_EXCEEDED is how a dead
+        established flow presents) drop it so the next get re-dials."""
+        pool = ChannelPool(
+            dial=lambda a, t, p: grpc.insecure_channel(a))
+
+        class Err(grpc.RpcError):
+            def __init__(self, code):
+                self._code = code
+
+            def code(self):
+                return self._code
+
+        pool.get("localhost:1")
+        assert not pool.maybe_evict(
+            Err(grpc.StatusCode.NOT_FOUND), "localhost:1")
+        assert len(pool) == 1
+        assert pool.maybe_evict(
+            Err(grpc.StatusCode.UNAVAILABLE), "localhost:1")
+        assert len(pool) == 0
+        pool.get("localhost:1")
+        assert pool.maybe_evict(
+            Err(grpc.StatusCode.DEADLINE_EXCEEDED), "localhost:1")
+        assert len(pool) == 0
+        pool.close()
+
+    def test_concurrent_get_dials_once(self):
+        dials = []
+        gate = threading.Barrier(8)
+
+        def spy(address, tls, peer_name):
+            dials.append(address)
+            return grpc.insecure_channel(address)
+
+        pool = ChannelPool(dial=spy)
+        results = []
+
+        def run():
+            gate.wait()
+            results.append(pool.get("localhost:9", None, "p"))
+
+        threads = [threading.Thread(target=run) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(dials) == 1
+        assert len({id(c) for c in results}) == 1
+        pool.close()
+
+
+class TestDirectWindows:
+    @pytest.fixture(autouse=True)
+    def _close_pools(self):
+        # Tests create private pools (the process-wide shared() pool
+        # would leak channels across tests); close them so no channel is
+        # garbage-collected with gRPC machinery still attached.
+        self._pools: list[ChannelPool] = []
+        yield
+        for pool in self._pools:
+            pool.close()
+
+    @pytest.fixture
+    def cluster(self):
+        db = MemRegistryDB()
+        registry = registry_server("tcp://localhost:0", RegistryService(db=db))
+        service = ControllerService(MallocBackend())
+        controller = controller_server("tcp://localhost:0", service)
+        db.set("host-0/address", controller.addr)
+        db.set("host-0/mesh", "1,2,3")
+        yield db, registry, controller
+        registry.force_stop()
+        controller.force_stop()
+
+    def feeder_for(self, registry, **kw):
+        pool = kw.setdefault("pool", ChannelPool())
+        self._pools.append(pool)
+        return Feeder(registry_address=registry.addr, controller_id="host-0",
+                      **kw)
+
+    def test_direct_and_proxy_windows_byte_identical(self, cluster, tmp_path):
+        _, registry, _ = cluster
+        direct = self.feeder_for(registry)
+        data = _publish_file(direct, "vol-d", tmp_path)
+        proxy = self.feeder_for(registry, direct_data=False)
+        d_before = M.WINDOW_PATH_TOTAL.labels(path="direct").value
+        p_before = M.WINDOW_PATH_TOTAL.labels(path="proxy").value
+        assert _read_all(direct, "vol-d") == data
+        assert _read_all(proxy, "vol-d") == data
+        assert M.WINDOW_PATH_TOTAL.labels(path="direct").value > d_before
+        assert M.WINDOW_PATH_TOTAL.labels(path="proxy").value > p_before
+        # Whole-volume fetch rides the same machinery on both paths.
+        assert direct.fetch("vol-d").tobytes() == data
+        assert proxy.fetch("vol-d").tobytes() == data
+
+    def test_n_windows_reuse_exactly_one_controller_channel(
+            self, cluster, tmp_path, monkeypatch):
+        _, registry, controller = cluster
+        dialed: list[str] = []
+        real_dial = tlsutil.dial
+
+        def spy(address, tls, peer_name=""):
+            dialed.append(address)
+            return real_dial(address, tls, peer_name)
+
+        monkeypatch.setattr(tlsutil, "dial", spy)
+        feeder = self.feeder_for(registry)
+        data = _publish_file(feeder, "vol-n", tmp_path)
+        dialed.clear()
+        for i in range(8):
+            w, total, _ = feeder.fetch_window("vol-n", i * 10_000, 10_000)
+            assert w.tobytes() == data[i * 10_000:(i + 1) * 10_000]
+        # 8 windows: ONE direct channel to the controller, and at most
+        # one (pre-pooled) registry channel for endpoint resolution —
+        # never a dial per window.
+        assert dialed.count(controller.addr) == 1
+        assert len(dialed) <= 2
+
+    def test_blackholed_direct_endpoint_falls_back_to_proxy(
+            self, cluster, tmp_path):
+        _, registry, _ = cluster
+        feeder = self.feeder_for(registry)
+        data = _publish_file(feeder, "vol-b", tmp_path)
+        # Blackhole ONLY the direct path: seed the resolver cache with an
+        # address nothing serves (the registry still routes the proxy to
+        # the live controller).
+        import time as _time
+
+        feeder._direct_addr = (dead_endpoint(), _time.monotonic())
+        p_before = M.WINDOW_PATH_TOTAL.labels(path="proxy").value
+        w, total, _ = feeder.fetch_window("vol-b", 0, 10_000)
+        assert w.tobytes() == data[:10_000] and total == len(data)
+        assert M.WINDOW_PATH_TOTAL.labels(path="proxy").value == p_before + 1
+        # The dead endpoint was invalidated: the next window re-resolves
+        # the real one and goes direct again.
+        d_before = M.WINDOW_PATH_TOTAL.labels(path="direct").value
+        w2, _, _ = feeder.fetch_window("vol-b", 10_000, 10_000)
+        assert w2.tobytes() == data[10_000:20_000]
+        assert M.WINDOW_PATH_TOTAL.labels(path="direct").value == d_before + 1
+
+    def test_hanging_direct_endpoint_falls_back_and_backs_off(
+            self, cluster, tmp_path):
+        """A registered-but-unroutable endpoint HANGS instead of refusing
+        (firewalled pod IP): the unverified channel's 1-byte first-
+        contact probe — bounded at min(5s, half the budget) — eats the
+        hang instead of the window read burning the caller's whole
+        deadline. The same call must still complete via the proxy, and
+        the direct path backs off so the NEXT window doesn't stall
+        again."""
+        _, registry, _ = cluster
+        feeder = self.feeder_for(registry)
+        data = _publish_file(feeder, "vol-hang", tmp_path)
+        # A listener that accepts TCP but never speaks HTTP/2: the RPC
+        # hangs until its deadline.
+        listener = socket.socket()
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(1)
+        hang_addr = f"127.0.0.1:{listener.getsockname()[1]}"
+        try:
+            import time as _time
+
+            feeder._direct_addr = (hang_addr, _time.monotonic())
+            p_before = M.WINDOW_PATH_TOTAL.labels(path="proxy").value
+            t0 = _time.monotonic()
+            w, total, _ = feeder.fetch_window("vol-hang", 0, 10_000,
+                                              timeout=4.0)
+            assert _time.monotonic() - t0 < 4.0
+            assert w.tobytes() == data[:10_000] and total == len(data)
+            assert (M.WINDOW_PATH_TOTAL.labels(path="proxy").value
+                    == p_before + 1)
+            # Back-off armed: the next window goes straight to the proxy
+            # instead of waiting out another probe deadline.
+            assert feeder._direct_endpoint() is None
+            t0 = _time.monotonic()
+            w2, _, _ = feeder.fetch_window("vol-hang", 10_000, 10_000,
+                                           timeout=4.0)
+            assert _time.monotonic() - t0 < 1.0
+            assert w2.tobytes() == data[10_000:20_000]
+        finally:
+            listener.close()
+
+    def test_negative_chunk_bytes_rejected_client_and_server(
+            self, cluster, tmp_path):
+        """A negative chunk request must not clamp to 1-byte messages:
+        the Feeder rejects it at construction, and a raw stub sending one
+        anyway gets the server DEFAULT, not millions of tiny chunks."""
+        _, registry, controller = cluster
+        with pytest.raises(ValueError, match="window_chunk_bytes"):
+            Feeder(registry_address=registry.addr, controller_id="host-0",
+                   window_chunk_bytes=-1, pool=ChannelPool())
+        feeder = self.feeder_for(registry)
+        data = _publish_file(feeder, "vol-neg", tmp_path)
+        channel = tlsutil.dial(controller.addr, None)
+        try:
+            from oim_tpu.spec import ControllerStub
+
+            chunks = list(ControllerStub(channel).ReadVolume(
+                pb.ReadVolumeRequest(volume_id="vol-neg", chunk_bytes=-5),
+                timeout=30,
+            ))
+        finally:
+            channel.close()
+        assert len(chunks) == 1  # 100 KB under the 3 MiB default chunk
+        assert chunks[0].data == data
+
+    def test_direct_not_found_is_not_masked_by_fallback(self, cluster):
+        _, registry, _ = cluster
+        feeder = self.feeder_for(registry)
+        with pytest.raises(PublishError, match="NOT_FOUND"):
+            feeder.fetch_window("ghost", 0, 100)
+
+    def test_controller_restart_evicts_pooled_channel_and_heals(
+            self, cluster, tmp_path):
+        db, registry, controller = cluster
+        feeder = self.feeder_for(registry)
+        data = _publish_file(feeder, "vol-r", tmp_path)
+        w, _, _ = feeder.fetch_window("vol-r", 0, 10_000)
+        assert w.tobytes() == data[:10_000]
+        old_addr = controller.addr
+        assert old_addr in feeder._pool.targets()  # direct channel pooled
+        # Controller dies; a replacement with empty soft state registers
+        # at a NEW address (the restart story of test_feeder, now with a
+        # pooled direct channel pointing at the corpse).
+        controller.force_stop()
+        svc2 = ControllerService(MallocBackend())
+        ctrl2 = controller_server("tcp://localhost:0", svc2)
+        db.set("host-0/address", ctrl2.addr)
+        try:
+            w2, total2, _ = feeder.fetch_window(
+                "vol-r", 10_000, 10_000, timeout=30, heal=True)
+            assert w2.tobytes() == data[10_000:20_000]
+            assert total2 == len(data)
+            assert svc2.get_volume("vol-r") is not None  # restaged
+            # The dead endpoint's channel is gone from the pool; the new
+            # one is in (no half-dead channels accumulate across heals).
+            assert old_addr not in feeder._pool.targets()
+            assert ctrl2.addr in feeder._pool.targets()
+        finally:
+            ctrl2.force_stop()
+
+    def test_direct_disabled_never_dials_controller(
+            self, cluster, tmp_path, monkeypatch):
+        _, registry, controller = cluster
+        dialed: list[str] = []
+        real_dial = tlsutil.dial
+
+        def spy(address, tls, peer_name=""):
+            dialed.append(address)
+            return real_dial(address, tls, peer_name)
+
+        monkeypatch.setattr(tlsutil, "dial", spy)
+        feeder = self.feeder_for(registry, direct_data=False)
+        data = _publish_file(feeder, "vol-p", tmp_path)
+        dialed.clear()
+        w, _, _ = feeder.fetch_window("vol-p", 0, 10_000)
+        assert w.tobytes() == data[:10_000]
+        assert controller.addr not in dialed
+
+    def test_big_window_streams_in_large_chunks(self, cluster, tmp_path):
+        """A >4 MiB window must cross in few messages (the raised server
+        cap + requested chunk_bytes), not in 3 MiB shards — and arrive
+        byte-identical."""
+        _, registry, controller = cluster
+        feeder = self.feeder_for(registry)
+        data = _publish_file(feeder, "vol-big", tmp_path, nbytes=12 << 20,
+                             seed=11)
+        fetched = feeder.fetch("vol-big")
+        assert fetched.tobytes() == data
+        # Raw stub with a big requested chunk: the server honors it now
+        # that MAX_READ_CHUNK > DEFAULT_READ_CHUNK.
+        channel = tlsutil.dial(controller.addr, None)
+        try:
+            from oim_tpu.spec import ControllerStub
+
+            chunks = list(ControllerStub(channel).ReadVolume(
+                pb.ReadVolumeRequest(volume_id="vol-big",
+                                     chunk_bytes=16 << 20),
+                timeout=30,
+            ))
+        finally:
+            channel.close()
+        assert len(chunks) == 1  # 12 MiB in ONE message
+        assert chunks[0].data == data
+
+
+class TestHeartbeatPooling:
+    def test_heartbeat_loop_reuses_one_channel(self, monkeypatch):
+        from oim_tpu.controller.controller import Controller
+
+        db = MemRegistryDB()
+        registry = registry_server("tcp://localhost:0", RegistryService(db=db))
+        dialed: list[str] = []
+        real_dial = tlsutil.dial
+
+        def spy(address, tls, peer_name=""):
+            dialed.append(address)
+            return real_dial(address, tls, peer_name)
+
+        monkeypatch.setattr(tlsutil, "dial", spy)
+        try:
+            ctl = Controller(
+                "host-hb", backend=MallocBackend(),
+                controller_address="localhost:1",
+                registry_address=registry.addr,
+                pool=ChannelPool(),
+            )
+            ctl.register_once()
+            for _ in range(3):
+                assert ctl.heartbeat_once() is True
+            assert dialed.count(registry.addr) == 1
+        finally:
+            registry.force_stop()
+
+
+class TestZeroCopyAssembly:
+    def test_no_join_copy_on_the_window_path(self):
+        """The acceptance criterion 'no b"".join remains on the window
+        path', pinned at the source level like the metrics drift test."""
+        from pathlib import Path
+
+        import oim_tpu.feeder.driver as driver_mod
+
+        source = Path(driver_mod.__file__).read_text()
+        assert 'b"".join' not in source and "b''.join" not in source
+
+    def test_window_lands_in_one_preallocated_buffer(self, tmp_path):
+        """Multi-chunk windows must come back as ONE contiguous buffer
+        (np.frombuffer over the preallocated bytearray), not a
+        concatenation result."""
+        db = MemRegistryDB()
+        registry = registry_server("tcp://localhost:0", RegistryService(db=db))
+        service = ControllerService(MallocBackend())
+        controller = controller_server("tcp://localhost:0", service)
+        db.set("host-0/address", controller.addr)
+        pool = ChannelPool()
+        try:
+            feeder = Feeder(registry_address=registry.addr,
+                            controller_id="host-0", pool=pool,
+                            window_chunk_bytes=4 << 10)  # force many chunks
+            data = _publish_file(feeder, "vol-z", tmp_path, nbytes=64 << 10)
+            w, total, _ = feeder.fetch_window("vol-z", 1_000, 50_000)
+            assert w.tobytes() == data[1_000:51_000]
+            assert total == len(data)
+            assert w.base is not None  # a view over the landing buffer
+            assert isinstance(w.base, (bytearray, memoryview, np.ndarray))
+        finally:
+            pool.close()
+            registry.force_stop()
+            controller.force_stop()
