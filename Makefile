@@ -9,7 +9,7 @@
 PY ?= python
 RUFF := $(shell command -v ruff 2>/dev/null)
 
-.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke
+.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke
 
 # drift and tsan are standalone conveniences; the full pytest target
 # already runs both (SpecDrift + the TSAN stream test build in-fixture).
@@ -50,6 +50,14 @@ tsan:
 # nor the window path can silently regress.
 bench-smoke:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --smoke
+
+# Tiny serving-plane correctness loop (seconds): weights published once
+# through the control plane (cache-hit republish proven), then an
+# open-loop streaming load through the continuous-batching engine over
+# real gRPC — every output byte-identical to its solo generate() run.
+# Also runs in tier-1 as tests/test_serve_smoke.py.
+serve-smoke:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --serve --smoke
 
 demo:
 	bash scripts/demo_cluster.sh demo

@@ -57,8 +57,24 @@ def main(argv=None) -> int:
     parser.add_argument("--smoke", action="store_true",
                         help="tiny CPU-only stage-and-train correctness "
                              "loop (seconds): byte-identical staging, "
-                             "cache-hit republish, converging train steps")
+                             "cache-hit republish, converging train steps "
+                             "(with --serve: the asserting serve smoke)")
+    parser.add_argument("--serve", action="store_true",
+                        help="serving-plane bench: synthetic open-loop "
+                             "load against an in-process oim-serve "
+                             "cluster; reports serve_qps and p50/p99 "
+                             "token latency")
     args = parser.parse_args(argv)
+
+    if args.serve:
+        extras = serve_smoke() if args.smoke else serve_bench()
+        print(json.dumps({
+            "metric": "serve_qps",
+            "value": extras["serve_qps"],
+            "unit": "req/s",
+            "extras": extras,
+        }))
+        return 0
 
     if args.smoke:
         print(json.dumps({"metric": "bench_smoke", "value": 1,
@@ -578,6 +594,221 @@ def bench_llama(chain_short: int, chain_long: int, profile_dir: str = "") -> dic
         "llama_params_m": round(llama.num_params(cfg) / 1e6),
         "llama_final_loss": round(loss, 4),
     }
+
+
+def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
+                max_batch: int = 8, max_new: int = 16,
+                verify_all: bool = False) -> dict:
+    """Serving-plane bench: a synthetic OPEN-LOOP load (requests arrive
+    on a fixed clock whether or not earlier ones finished — the arrival
+    process of real traffic, not a closed feedback loop) against an
+    in-process cluster that exercises the whole serving tier:
+
+    1. weight distribution — pack a params tree, publish it as a volume
+       through the control plane, prove the cache-hit republish, restore
+       the tree from the staged bytes;
+    2. the continuous-batching engine behind the real ``oim.v1.Serve``
+       gRPC server, one streaming client thread per request.
+
+    Reports ``serve_qps`` (completed requests over the load window) and
+    client-observed token latency percentiles: ``first_token_*`` is
+    submit-to-first-delta (queue wait + prefill), ``token_*`` is the gap
+    between consecutive deltas of a stream (decode cadence; deltas
+    coalesce bursts, so one sample per delta). A slice of outputs is
+    verified byte-identical to solo generate() runs (every output with
+    ``verify_all`` — the serve-smoke configuration)."""
+    import threading
+
+    import jax
+
+    from oim_tpu.controller.controller import ControllerService
+    from oim_tpu.controller.malloc_backend import MallocBackend
+    from oim_tpu.feeder import Feeder
+    from oim_tpu.models import generate as gen, llama
+    from oim_tpu.serve import ServeEngine, ServeService
+    from oim_tpu.serve.service import serve_server
+    from oim_tpu.serve.weights import (
+        publish_weights,
+        restore_weights,
+        save_packed,
+    )
+    from oim_tpu.spec import ServeStub, pb
+    from oim_tpu.common import tlsutil
+
+    cfg = llama.tiny(vocab=64, dim=32, n_layers=2)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    max_seq = 64
+
+    # ---- weight distribution through the control plane -----------------
+    tmp = tempfile.NamedTemporaryFile(suffix=".oimw", delete=False)
+    tmp.close()
+    engine = None
+    server = None
+    try:
+        save_packed(params, tmp.name)
+        feeder = Feeder(controller=ControllerService(MallocBackend()))
+        t0 = time.monotonic()
+        pub = publish_weights(feeder, "bench-weights", tmp.name)
+        weights_publish_s = time.monotonic() - t0
+        # Identical republish must be the O(1) stage-cache path —
+        # proven by the hit counter, not wall clock.
+        from oim_tpu.common import metrics as M
+
+        hits_before = M.STAGE_CACHE_HITS.value
+        feeder.unpublish("bench-weights")
+        t0 = time.monotonic()
+        publish_weights(feeder, "bench-weights", tmp.name)
+        weights_cache_hit_s = time.monotonic() - t0
+        weights_cache_hit = M.STAGE_CACHE_HITS.value == hits_before + 1
+        tree = restore_weights(feeder, "bench-weights")
+
+        # ---- open-loop load over gRPC ----------------------------------
+        engine = ServeEngine(tree, cfg, max_batch=max_batch,
+                             max_seq=max_seq, queue_depth=n_requests)
+        server = serve_server("tcp://127.0.0.1:0", ServeService(engine))
+        # Warmup: compile the prefill bucket + decode program outside the
+        # measured window, so first-token latency is queue+prefill time,
+        # not jit time.
+        engine.submit([1, 2, 3], max_new=2).result(timeout=300)
+
+        rng = np.random.RandomState(42)
+        reqs = [
+            (
+                rng.randint(1, cfg.vocab, size=rng.randint(2, 9)).tolist(),
+                int(rng.randint(4, max_new + 1)),
+                0.0 if i % 2 == 0 else 0.8,
+                i,
+            )
+            for i in range(n_requests)
+        ]
+        results: list[list[int] | None] = [None] * n_requests
+        first_token_s: list[float] = []
+        token_gap_s: list[float] = []
+        finished_at: list[float] = []
+        rejected = [0]
+        errors: list[Exception] = []
+        lock = threading.Lock()
+
+        def run_one(i):
+            prompt, n_new, temp, seed = reqs[i]
+            start = time.monotonic()
+            try:
+                with tlsutil.dial(server.addr, None) as channel:
+                    last = start
+                    toks: list[int] = []
+                    gaps: list[float] = []
+                    first = None
+                    for delta in ServeStub(channel).Generate(
+                            pb.GenerateRequest(
+                                prompt=prompt, max_new_tokens=n_new,
+                                temperature=temp, seed=seed),
+                            timeout=300):
+                        now = time.monotonic()
+                        if first is None:
+                            first = now - start
+                        else:
+                            gaps.append(now - last)
+                        last = now
+                        toks.extend(delta.tokens)
+                with lock:
+                    results[i] = toks
+                    first_token_s.append(first)
+                    token_gap_s.extend(gaps)
+                    finished_at.append(last)
+            except Exception as err:  # noqa: BLE001 - tallied below
+                import grpc
+
+                if (isinstance(err, grpc.RpcError) and err.code()
+                        is grpc.StatusCode.RESOURCE_EXHAUSTED):
+                    with lock:
+                        rejected[0] += 1
+                else:
+                    # Raising in a daemon thread would vanish into
+                    # stderr and silently shrink the completed count —
+                    # collect, and fail the bench after join.
+                    with lock:
+                        errors.append(err)
+
+        interval = 1.0 / offered_rps
+        threads = []
+        load_t0 = time.monotonic()
+        for i in range(n_requests):
+            # Open loop: the NEXT arrival never waits for this one.
+            t = threading.Thread(target=run_one, args=(i,), daemon=True)
+            t.start()
+            threads.append(t)
+            deadline = load_t0 + (i + 1) * interval
+            delay = deadline - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+        for t in threads:
+            t.join(timeout=300)
+        if errors:
+            raise AssertionError(
+                f"{len(errors)} serve requests failed; first: {errors[0]!r}")
+
+        completed = [r for r in results if r is not None]
+        if not completed:
+            raise AssertionError("serve bench completed zero requests")
+        window = max(max(finished_at) - load_t0, 1e-6)
+        serve_qps = len(completed) / window
+
+        # Byte-identity tripwire vs solo generate() (every request in the
+        # smoke; a slice in the bench, where n_requests solo runs would
+        # dominate the wall time).
+        check = range(n_requests) if verify_all else range(
+            0, n_requests, max(n_requests // 4, 1))
+        for i in check:
+            if results[i] is None:
+                continue
+            prompt, n_new, temp, seed = reqs[i]
+            solo = gen.generate(
+                params, np.asarray([prompt], np.int32), n_new, cfg,
+                temperature=temp, rng=jax.random.PRNGKey(seed),
+                max_seq=max_seq)[0, len(prompt):].tolist()
+            if results[i] != solo:
+                raise AssertionError(
+                    f"served tokens diverge from solo generate() for "
+                    f"request {i}: {results[i]} != {solo}")
+
+        pct = lambda xs, q: (  # noqa: E731
+            round(float(np.percentile(xs, q)) * 1e3, 3) if xs else None)
+        return {
+            "serve_qps": round(serve_qps, 2),
+            "serve_requests": n_requests,
+            "serve_completed": len(completed),
+            "serve_rejected": rejected[0],
+            "serve_offered_rps": offered_rps,
+            "serve_slots": max_batch,
+            "serve_tokens_total": sum(len(r) for r in completed),
+            "first_token_p50_ms": pct(first_token_s, 50),
+            "first_token_p99_ms": pct(first_token_s, 99),
+            "token_p50_ms": pct(token_gap_s, 50),
+            "token_p99_ms": pct(token_gap_s, 99),
+            "weights_bytes": int(pub.bytes),
+            "weights_publish_s": round(weights_publish_s, 4),
+            "weights_cache_hit": weights_cache_hit,
+            "weights_cache_hit_s": round(weights_cache_hit_s, 4),
+        }
+    finally:
+        if server is not None:
+            server.force_stop()
+        if engine is not None:
+            engine.stop(drain=False, timeout=30)
+        os.unlink(tmp.name)
+
+
+def serve_smoke() -> dict:
+    """Tiny asserting serve run (seconds): every output byte-identical
+    to its solo generate() run, weights distributed through the control
+    plane. The tier-1 guard wired in as tests/test_serve_smoke.py and
+    `make serve-smoke`."""
+    extras = serve_bench(n_requests=12, offered_rps=24.0, max_batch=4,
+                         max_new=8, verify_all=True)
+    if extras["serve_completed"] != extras["serve_requests"]:
+        raise AssertionError(
+            f"serve smoke dropped requests: {extras}")
+    return extras
 
 
 if __name__ == "__main__":

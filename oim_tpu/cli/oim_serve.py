@@ -1,0 +1,257 @@
+"""oim-serve: the continuous-batching inference daemon (new scope — the
+serving plane ROADMAP item 2 turns the storage control plane into a
+weight-distribution system).
+
+Weights come from exactly one of three sources:
+
+* ``--checkpoint-dir`` (+ ``--model``) — restore a trainer checkpoint in
+  process (no control plane; single-node serving and smoke tests).
+  ``--pack-to FILE`` additionally writes the packed weights blob, the
+  artifact every replica publishes from.
+* ``--weights-file`` — a packed blob (serve/weights.py). The daemon
+  PUBLISHES it as a volume through its feeder — local (``--backend``) or
+  remote (``--registry`` + ``--controller-id``) — and restores from the
+  staged bytes. Publishing is idempotent and content-addressed: the
+  FIRST replica stages from source, every replica whose controller was
+  prestaged (``--prestage PEER_ID``, repeatable, or a prior replica's
+  ``--prestage``) boots from an O(1) stage-cache hit with zero source
+  re-reads.
+* ``--weights-volume`` alone (remote mode) — the volume is already
+  mapped on this replica's controller; just restore from it.
+
+Serving: a fixed ``[max-batch, max-seq]`` continuous batch
+(serve/engine.py) behind the ``oim.v1.Serve`` streaming Generate RPC.
+SIGTERM / Ctrl-C drains gracefully: residents finish, queued requests
+close as "drained", new ones get UNAVAILABLE.
+
+    oim-serve --checkpoint-dir /ckpt --model llama-tiny \
+        --endpoint tcp://0.0.0.0:9002 --max-batch 8 --max-seq 256
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import threading
+
+from oim_tpu.cli.common import (
+    add_common_flags,
+    add_observability_flags,
+    add_registry_flag,
+    load_tls_flags,
+    setup_logging,
+    start_observability,
+)
+from oim_tpu.common.logging import from_context
+
+DEFAULT_VOLUME = "weights"
+
+
+def _load_params(args, log):
+    """The params tree + model config from whichever source was given."""
+    from oim_tpu.train import TrainConfig, Trainer
+
+    if args.checkpoint_dir:
+        cfg = TrainConfig(
+            model=args.model, checkpoint_dir=args.checkpoint_dir)
+        mcfg = cfg.model_config()
+        trainer = Trainer(cfg)
+        step = trainer.init_or_resume()
+        if step == 0:
+            raise SystemExit(
+                f"no checkpoint found in {args.checkpoint_dir!r} "
+                "(refusing to serve random init)"
+            )
+        params = trainer.state.params
+        log.info("restored checkpoint", step=step, model=args.model)
+        if args.pack_to:
+            from oim_tpu.serve.weights import save_packed
+
+            size = save_packed(params, args.pack_to)
+            log.info("packed weights", path=args.pack_to, bytes=size)
+        return params, mcfg
+
+    # Packed-blob modes need the model config to shape the KV cache; the
+    # blob itself carries only the param tree.
+    mcfg = TrainConfig(model=args.model).model_config()
+    feeder = _make_feeder(args)
+    from oim_tpu.serve.weights import (
+        publish_weights,
+        restore_weights,
+        weights_request,
+    )
+
+    if args.weights_file:
+        request = weights_request(
+            args.weights_volume, args.weights_file,
+            os.path.getsize(args.weights_file))
+        publish_weights(feeder, args.weights_volume, args.weights_file)
+        for peer in args.prestage:
+            _prestage_peer(feeder, request, peer, log)
+    params = restore_weights(feeder, args.weights_volume)
+    log.info("restored weights volume", volume=args.weights_volume)
+    return params, mcfg
+
+
+def _make_feeder(args):
+    from oim_tpu.feeder import Feeder
+
+    if args.backend:
+        from oim_tpu.controller.controller import ControllerService
+
+        if args.backend == "tpu":
+            from oim_tpu.controller.tpu_backend import TPUBackend
+
+            backend = TPUBackend()
+        else:
+            from oim_tpu.controller import MallocBackend
+
+            backend = MallocBackend()
+        return Feeder(controller=ControllerService(backend))
+    if not (args.registry and args.controller_id):
+        raise SystemExit(
+            "--weights-file/--weights-volume need --backend (local) or "
+            "--registry + --controller-id (remote)"
+        )
+    return Feeder(
+        registry_address=args.registry,
+        controller_id=args.controller_id,
+        tls=load_tls_flags(args),
+    )
+
+
+def _prestage_peer(feeder, request, peer: str, log) -> None:
+    """Warm ``peer``'s stage cache with the weights content through the
+    registry proxy, so that replica's later publish is an O(1) hit."""
+    import grpc
+
+    from oim_tpu.registry.registry import CONTROLLER_ID_META
+    from oim_tpu.spec import ControllerStub
+
+    try:
+        ControllerStub(feeder._registry_channel()).PrestageVolume(
+            request, metadata=[(CONTROLLER_ID_META, peer)], timeout=60.0)
+        log.info("prestaged replica", peer=peer, volume=request.volume_id)
+    except grpc.RpcError as err:
+        log.warning("replica prestage failed", peer=peer,
+                    error=err.code().name)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser("oim-serve")
+    parser.add_argument(
+        "--endpoint", default="tcp://0.0.0.0:9002",
+        help="listen endpoint (tcp:// or unix://)",
+    )
+    parser.add_argument("--model", default="llama-tiny",
+                        choices=("llama-tiny", "llama-tiny-moe", "llama3-8b"))
+    parser.add_argument("--checkpoint-dir", default="",
+                        help="restore a trainer checkpoint in process")
+    parser.add_argument(
+        "--pack-to", default="",
+        help="with --checkpoint-dir: also write the packed weights blob "
+             "(the file replicas publish with --weights-file)")
+    parser.add_argument(
+        "--weights-file", default="",
+        help="packed weights blob to publish-and-restore through the "
+             "control plane (idempotent; a prestaged replica's publish "
+             "is an O(1) stage-cache hit)")
+    parser.add_argument(
+        "--weights-volume", default=DEFAULT_VOLUME,
+        help="volume id for the weights (with --weights-file: publish "
+             "under this id; alone in remote mode: restore the already-"
+             "mapped volume)")
+    parser.add_argument(
+        "--restore-only", action="store_true",
+        help="remote mode without --weights-file: restore "
+             "--weights-volume as already mapped on the controller")
+    parser.add_argument("--backend", default="",
+                        choices=("", "malloc", "tpu"),
+                        help="local mode: in-process controller backend")
+    add_registry_flag(parser, help_suffix="remote mode")
+    parser.add_argument("--controller-id", default="",
+                        help="remote mode: this replica's controller")
+    parser.add_argument(
+        "--prestage", action="append", default=[],
+        help="controller id to PrestageVolume the weights to after "
+             "publishing (repeatable: fan the content out so each "
+             "replica's own publish hits its stage cache)")
+    parser.add_argument("--max-batch", type=int, default=8,
+                        help="decode-batch slots (continuous batch width)")
+    parser.add_argument("--max-seq", type=int, default=256,
+                        help="KV cache length: prompt + generated tokens "
+                             "per request must fit")
+    parser.add_argument("--queue-depth", type=int, default=64,
+                        help="bounded admission queue; full = new requests "
+                             "answer RESOURCE_EXHAUSTED")
+    parser.add_argument("--default-max-new", type=int, default=64,
+                        help="decode budget when the request leaves "
+                             "max_new_tokens unset")
+    parser.add_argument("--drain-timeout", type=float, default=60.0,
+                        help="graceful-drain budget on shutdown")
+    parser.add_argument("--platform", default="",
+                        help="force a jax platform (e.g. cpu)")
+    add_common_flags(parser)
+    add_observability_flags(parser)
+    args = parser.parse_args(argv)
+    setup_logging(args)
+    log = from_context()
+
+    sources = bool(args.checkpoint_dir) + bool(args.weights_file) \
+        + bool(args.restore_only)
+    if sources != 1:
+        raise SystemExit(
+            "exactly one weights source required: --checkpoint-dir, "
+            "--weights-file, or --restore-only (+ --weights-volume)"
+        )
+    if args.prestage and args.backend:
+        # _prestage_peer routes through the registry proxy; a local
+        # in-process backend has no registry to route through.
+        raise SystemExit("--prestage needs remote mode (--registry + "
+                         "--controller-id), not --backend")
+    if args.platform:
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", args.platform)
+    obs = start_observability(args, "oim-serve")
+
+    from oim_tpu.serve import ServeEngine, ServeService, serve_server
+
+    params, mcfg = _load_params(args, log)
+    engine = ServeEngine(
+        params, mcfg,
+        max_batch=args.max_batch,
+        max_seq=args.max_seq,
+        queue_depth=args.queue_depth,
+        default_max_new=args.default_max_new,
+    )
+    server = serve_server(
+        args.endpoint, ServeService(engine), tls=load_tls_flags(args))
+    log.info(
+        "oim-serve serving", endpoint=args.endpoint, addr=server.addr,
+        model=args.model, max_batch=args.max_batch, max_seq=args.max_seq,
+    )
+
+    drained = threading.Event()
+
+    def drain(*_):
+        # Signal-safe: flip an event the main thread acts on.
+        drained.set()
+
+    signal.signal(signal.SIGTERM, drain)
+    try:
+        while not drained.wait(0.5):
+            pass
+    except KeyboardInterrupt:
+        pass
+    log.info("draining", active=engine.active_slots,
+             queued=engine.queue_len)
+    engine.stop(drain=True, timeout=args.drain_timeout)
+    server.stop()
+    obs.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

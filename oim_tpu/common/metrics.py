@@ -389,6 +389,32 @@ WINDOW_GBPS = DEFAULT.histogram(
 CHANNEL_POOL_SIZE = DEFAULT.gauge(
     "oim_channel_pool_size",
     "live pooled gRPC channels across every ChannelPool in this process")
+# Serving plane (oim_tpu/serve: continuous-batching inference tier).
+SERVE_QPS = DEFAULT.gauge(
+    "oim_serve_qps",
+    "completed Generate requests per second over the engine's sliding "
+    "window (all outcomes)")
+SERVE_QUEUE_DEPTH = DEFAULT.gauge(
+    "oim_serve_queue_depth",
+    "requests waiting in the admission queue (queue full => new requests "
+    "are refused RESOURCE_EXHAUSTED)")
+SERVE_SLOT_OCCUPANCY = DEFAULT.gauge(
+    "oim_serve_slot_occupancy",
+    "fraction of decode-batch slots holding a live request (1.0 = the "
+    "continuous batch is full)")
+SERVE_REQUESTS_TOTAL = DEFAULT.counter(
+    "oim_serve_requests_total",
+    "Generate requests finished, by outcome: eos | length | cancelled | "
+    "drained | rejected",
+    labelnames=("outcome",))
+SERVE_TOKENS_TOTAL = DEFAULT.counter(
+    "oim_serve_tokens_total", "tokens emitted by the serving engine")
+SERVE_TOKEN_LATENCY = DEFAULT.histogram(
+    "oim_serve_token_latency_seconds",
+    "latency of each emitted token: submit-to-first-token for the "
+    "prefill token, inter-token gap for decode tokens",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5))
 # Labeled RPC telemetry (common/tracing.py interceptors — the
 # go-grpc-prometheus analog; recorded by client and server vantage alike).
 RPC_LATENCY = DEFAULT.histogram(

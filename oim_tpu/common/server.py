@@ -66,6 +66,13 @@ class NonBlockingGRPCServer:
         self._server: grpc.Server | None = None
         self._addr: str | None = None
         self._unix_path: str | None = None
+        self._on_stop: list[Callable[[], None]] = []
+
+    def add_cleanup(self, fn: Callable[[], None]) -> None:
+        """Run ``fn`` once when the server stops (graceful or forced) —
+        for resources whose lifetime is the server's, like a handler's
+        channel pool."""
+        self._on_stop.append(fn)
 
     @property
     def addr(self) -> str:
@@ -138,6 +145,8 @@ class NonBlockingGRPCServer:
 
     def _cleanup(self) -> None:
         self._server = None
+        while self._on_stop:
+            self._on_stop.pop()()
         if self._unix_path and os.path.exists(self._unix_path):
             try:
                 os.unlink(self._unix_path)

@@ -35,19 +35,54 @@ from oim_tpu.common.meshcoord import MeshCoord
 from oim_tpu.common.pathutil import REGISTRY_ADDRESS, REGISTRY_MESH
 from oim_tpu.common.server import NonBlockingGRPCServer
 from oim_tpu.common.interceptors import LogServerInterceptor
-from oim_tpu.common.tlsutil import TLSConfig
+from oim_tpu.common.tlsutil import TLSConfig, peer_common_name
 from oim_tpu.controller.backend import StagedVolume, StageState, StagingBackend
 from oim_tpu.spec import ControllerServicer, RegistryStub, add_controller_to_server, pb
 
 
 class ControllerService(ControllerServicer):
-    def __init__(self, backend: StagingBackend):
+    def __init__(self, backend: StagingBackend, controller_id: str = ""):
         self.backend = backend
+        # Own identity, for the direct-path peer check (_authorize_data):
+        # "" (bare test/local services) disables enforcement.
+        self.controller_id = controller_id
         self._volumes: dict[str, StagedVolume] = {}
         self._vol_lock = threading.Lock()
         self._keymutex = KeyMutex()
 
     # -- helpers ----------------------------------------------------------
+
+    def _authorize_data(self, context, rpc: str) -> None:
+        """The ``host.<id>`` -> ``<id>`` rule, bound on the DIRECT path
+        — for EVERY controller RPC (a direct UnmapVolume is at least as
+        dangerous as a direct ReadVolume).
+
+        The transparent proxy enforces that only controller <id>'s
+        assigned host may reach it — but PR 5's direct data path dials
+        the controller straight, where mTLS alone admits ANY CA-signed
+        peer (the CA-domain-only hole in doc/architecture.md's security
+        note). So the controller re-checks its caller itself: the
+        assigned host (``host.<own id>``), the registry's proxy hop
+        (``component.registry`` — the registry already applied the host
+        rule to the ORIGINAL caller before forwarding), or an operator
+        (``user.admin``). Enforcement needs a verified peer, so it binds
+        exactly when the transport authenticated one (mTLS); insecure
+        deployments have no CN to check — same condition the proxy uses.
+        """
+        if not self.controller_id:
+            return
+        if not hasattr(context, "auth_context"):
+            return  # in-process call (Feeder._LocalContext): no transport
+        peer = peer_common_name(context)
+        if peer is None:  # insecure/unauthenticated transport
+            return
+        if peer not in (f"host.{self.controller_id}",
+                        "component.registry", "user.admin"):
+            context.abort(
+                grpc.StatusCode.PERMISSION_DENIED,
+                f"{peer!r} may not {rpc} on controller "
+                f"{self.controller_id!r}",
+            )
 
     def get_volume(self, volume_id: str) -> StagedVolume | None:
         with self._vol_lock:
@@ -71,6 +106,7 @@ class ControllerService(ControllerServicer):
     # -- RPCs -------------------------------------------------------------
 
     def MapVolume(self, request, context):
+        self._authorize_data(context, "MapVolume")
         if not request.volume_id:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "empty volume_id")
         params_kind = request.WhichOneof("params")
@@ -107,6 +143,7 @@ class ControllerService(ControllerServicer):
             return self._placement(volume)
 
     def UnmapVolume(self, request, context):
+        self._authorize_data(context, "UnmapVolume")
         if not request.volume_id:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "empty volume_id")
         with self._keymutex.locked(request.volume_id):
@@ -121,6 +158,7 @@ class ControllerService(ControllerServicer):
             return pb.UnmapVolumeReply()
 
     def ProvisionMallocBDev(self, request, context):
+        self._authorize_data(context, "ProvisionMallocBDev")
         if not request.bdev_name:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "empty bdev_name")
         if request.size < 0:
@@ -133,6 +171,7 @@ class ControllerService(ControllerServicer):
             return pb.ProvisionMallocBDevReply()
 
     def CheckMallocBDev(self, request, context):
+        self._authorize_data(context, "CheckMallocBDev")
         if not request.bdev_name:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "empty bdev_name")
         if not self.backend.check(request.bdev_name):
@@ -142,6 +181,7 @@ class ControllerService(ControllerServicer):
         return pb.CheckMallocBDevReply()
 
     def StageStatus(self, request, context):
+        self._authorize_data(context, "StageStatus")
         volume = self.get_volume(request.volume_id)
         if volume is None:
             context.abort(
@@ -156,6 +196,7 @@ class ControllerService(ControllerServicer):
         cache, so a later MapVolume of identical content hits in O(1).
         Idempotent and volume-table-free — prestaging never conflicts
         with a mapped volume_id."""
+        self._authorize_data(context, "PrestageVolume")
         params_kind = request.WhichOneof("params")
         if not params_kind:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "no volume params")
@@ -203,6 +244,7 @@ class ControllerService(ControllerServicer):
         """Stream a staged volume back to a cross-process consumer — the
         data window of remote mode (spec.md ReadVolume; the vhost-user
         shared-memory analog, reference README.md:153-170)."""
+        self._authorize_data(context, "ReadVolume")
         volume = self.get_volume(request.volume_id)
         if volume is None:
             context.abort(
@@ -279,7 +321,7 @@ class Controller:
         if registry_address and not controller_address:
             raise ValueError("registration requires a controller address")
         self.controller_id = controller_id
-        self.service = ControllerService(backend)
+        self.service = ControllerService(backend, controller_id=controller_id)
         self.controller_address = controller_address
         # ``registry_address`` may be a comma-separated endpoint list
         # (primary,standby): the heartbeat loop fails over to the next

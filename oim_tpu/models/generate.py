@@ -55,6 +55,9 @@ def init_cache(cfg: Config, batch: int, max_seq: int):
 def _cache_attention(q, ck, cv, pos, cfg: Config):
     """q [B,T,H,hd] over the full cache [B,S,kvh,hd], masked to positions
     <= pos+t (unwritten cache slots mask out with everything else).
+    ``pos`` is a scalar (every row at the same depth — prefill/solo
+    decode) or a [B] vector (the serving batch, where mid-flight
+    admission puts every slot at its own depth).
 
     GQA rides a grouped einsum against the kv-head cache directly — no
     head-expanded copy of the cache, no f32 materialization of K (the
@@ -67,8 +70,10 @@ def _cache_attention(q, ck, cv, pos, cfg: Config):
     scores = jnp.einsum(
         "btkgd,bskd->bkgts", qg, ck, preferred_element_type=jnp.float32
     ) * (hd ** -0.5)
-    mask = (pos + jnp.arange(T))[:, None] >= jnp.arange(S)[None, :]  # [T,S]
-    scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+    pos_b = jnp.broadcast_to(jnp.asarray(pos), (B,))
+    mask = (pos_b[:, None] + jnp.arange(T))[:, :, None] \
+        >= jnp.arange(S)[None, None, :]  # [B,T,S]
+    scores = jnp.where(mask[:, None, None], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     # Probs drop to the cache dtype (what the flash kernels do) so the V
     # side also avoids an f32 copy of the cache; accumulation stays f32.
@@ -117,6 +122,86 @@ def cached_forward(params, tokens, cache, pos, cfg: Config):
     x = rmsnorm(x, params["final_norm"])
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, {"k": ck, "v": cv}
+
+
+# -- serving entry points (oim_tpu/serve: continuous batching) ------------
+#
+# The serving engine shares ONE [B, S] cache across live requests and
+# needs two operations generate() fuses: insert a new request's prefill
+# into a single batch row while other rows keep decoding, and advance the
+# whole batch one token with PER-ROW positions. Both reuse
+# cached_forward / the same attention, so there is still exactly one
+# cached-forward implementation to keep correct.
+
+
+def prefill_into_slot(params, tokens, n_tokens, cache, slot, cfg: Config):
+    """Prefill ``tokens`` [1, T] (first ``n_tokens`` real, rest pad — the
+    engine buckets prompt lengths so one compiled program serves many) into
+    batch row ``slot`` of the shared cache.
+
+    Returns (last real token's logits [vocab] f32, updated cache). Runs
+    cached_forward at batch 1 against a FRESH zero slot cache — the exact
+    solo numerics of generate()'s prefill, and provably no K/V leakage
+    from the slot's previous occupant. Pad positions >= n_tokens get their
+    K/V zeroed before the slot is written back: the causal mask keeps them
+    out of the prefill's own logits, but later decode steps WOULD attend
+    to them (pad positions fall below the advancing decode position).
+    """
+    S = cache["k"].shape[2]
+    sub = init_cache(cfg, 1, S)
+    logits, sub = cached_forward(params, tokens, sub, 0, cfg)
+    keep = (jnp.arange(S) < n_tokens)[None, None, :, None, None]
+    cache = {
+        name: lax.dynamic_update_slice_in_dim(
+            cache[name], jnp.where(keep, sub[name], 0), slot, axis=1)
+        for name in ("k", "v")
+    }
+    last = lax.dynamic_index_in_dim(
+        logits[0], n_tokens - 1, axis=0, keepdims=False)
+    return last, cache
+
+
+def decode_step(params, tokens, cache, pos, cfg: Config):
+    """One lockstep decode step over the whole slot batch: ``tokens`` [B]
+    int32 (each slot's previous token) at absolute positions ``pos`` [B].
+    Returns (logits [B, vocab] f32, updated cache).
+
+    The per-slot generalization of ``cached_forward`` at T=1: mid-flight
+    admission leaves every slot at its own depth, so cache writes are
+    per-row scatters and the attention mask is per-row (_cache_attention
+    takes the [B] position vector directly). Idle slots decode a garbage
+    row the engine discards — the cost of lockstep is one batch row,
+    never a second compiled program.
+    """
+    B = tokens.shape[0]
+    S = cache["k"].shape[2]
+    cfg = _no_drop(cfg)
+    params = jax.tree.map(jnp.asarray, params)
+    cos, sin = rope_frequencies(cfg.head_dim, S, cfg.rope_theta)
+    positions = pos[:, None]  # [B, 1]
+    x = params["embed"][tokens[:, None]].astype(cfg.dtype)
+    rows = jnp.arange(B)
+
+    def body(x, inp):
+        layer, ck, cv = inp
+        h = rmsnorm(x, layer["attn_norm"])
+        q = (h @ layer["wq"]).reshape(B, 1, cfg.n_heads, cfg.head_dim)
+        k = (h @ layer["wk"]).reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ layer["wv"]).reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        ck = ck.at[rows, pos].set(k[:, 0])
+        cv = cv.at[rows, pos].set(v[:, 0])
+        attn = _cache_attention(q, ck, cv, pos, cfg)
+        x = x + attn.reshape(B, 1, cfg.q_dim) @ layer["wo"]
+        h = rmsnorm(x, layer["mlp_norm"])
+        ffn, _ = _ffn(h, layer, cfg)
+        return x + ffn, (ck, cv)
+
+    x, (ck, cv) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rmsnorm(x, params["final_norm"])
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits[:, 0], {"k": ck, "v": cv}
 
 
 def generate(params, prompt, n_new: int, cfg: Config,

@@ -12,9 +12,12 @@ The transparent proxy (registry.go:149-210): every gRPC method outside
 ``oim.v1.Registry`` is forwarded to the controller named in the
 ``controllerid`` request metadata. The caller's CN must be ``host.<id>`` for
 that exact controller id; the registry looks up ``<id>/address`` in its DB and
-dials per-call with the far end's identity pinned to ``controller.<id>``
-(ssl_target_name_override), closing the channel when the call completes —
-control connections are short-lived by design (README.md:39-40).
+forwards over a POOLED channel with the far end's identity pinned to
+``controller.<id>`` (ssl_target_name_override). The reference dialed per-call
+(control connections short-lived by design, README.md:39-40); with the pool a
+proxied call rides one persistent channel per (address, identity) and a
+transport failure evicts it, so a restarted controller still heals on the
+caller's next attempt.
 """
 
 from __future__ import annotations
@@ -34,7 +37,8 @@ from oim_tpu.common.pathutil import (
 )
 from oim_tpu.common.server import NonBlockingGRPCServer
 from oim_tpu.common.interceptors import LogServerInterceptor
-from oim_tpu.common.tlsutil import TLSConfig, dial, peer_common_name
+from oim_tpu.common.channelpool import ChannelPool
+from oim_tpu.common.tlsutil import TLSConfig, peer_common_name
 from oim_tpu.registry.db import MemRegistryDB, RegistryDB, get_registry_entries
 from oim_tpu.registry.leases import LeaseTable
 from oim_tpu.spec import (
@@ -293,11 +297,24 @@ class TransparentProxy(grpc.GenericRpcHandler):
         dial: Callable[[str, str], grpc.Channel] | None = None,
     ):
         self._service = service
-        # dial(address, expected_peer_name) -> channel; overridable for tests.
-        self._dial = dial or self._default_dial
+        # Controller channels are POOLED: one persistent channel per
+        # (address, pinned identity) instead of a dial/close per proxied
+        # call (the last per-call dialer on the serving path). Transport
+        # failures evict, so a restarted controller heals on the caller's
+        # next attempt exactly as per-call dialing did.
+        if dial is not None:
+            # dial(address, expected_peer_name) -> channel (test override).
+            self._pool = ChannelPool(
+                dial=lambda address, tls, peer_name: dial(address, peer_name))
+        else:
+            self._pool = ChannelPool()
 
-    def _default_dial(self, address: str, peer_name: str) -> grpc.Channel:
-        return dial(address, self._service.tls, peer_name)
+    def _channel(self, address: str, peer_name: str) -> grpc.Channel:
+        return self._pool.get(address, self._service.tls, peer_name)
+
+    def close(self) -> None:
+        """Release the pooled controller channels (registry shutdown)."""
+        self._pool.close()
 
     def service(self, handler_call_details):
         method = handler_call_details.method
@@ -362,7 +379,8 @@ class TransparentProxy(grpc.GenericRpcHandler):
                 f"injected dial failure for controller {controller_id!r}",
             )
         log.debug("proxying", method=method, controller=controller_id, address=address)
-        # Per-call dialing with pinned far-end identity (registry.go:191-210).
+        # Pooled channel with pinned far-end identity (registry.go:191-210
+        # dialed per call; see __init__).
         # The hop is traced explicitly — extract the caller's context from
         # the raw metadata and re-inject the hop span's own id — because
         # the generic handler's generator body cannot rely on the server
@@ -375,7 +393,7 @@ class TransparentProxy(grpc.GenericRpcHandler):
             forwarded = tracing.inject(
                 [(k, v) for k, v in metadata if k != CONTROLLER_ID_META],
                 span.context)
-            channel = self._dial(address, f"controller.{controller_id}")
+            channel = self._channel(address, f"controller.{controller_id}")
             try:
                 call = channel.stream_stream(
                     method, request_serializer=_IDENTITY,
@@ -385,14 +403,15 @@ class TransparentProxy(grpc.GenericRpcHandler):
                     timeout=context.time_remaining(),
                     metadata=forwarded,
                 )
-                try:
-                    for response in call:
-                        yield response
-                except grpc.RpcError as err:
-                    span.attrs["code"] = err.code().name
-                    context.abort(err.code(), err.details())
-            finally:
-                channel.close()
+                for response in call:
+                    yield response
+            except grpc.RpcError as err:
+                # Transport failure: drop the pooled channel so the next
+                # proxied call re-dials (a restarted controller heals on
+                # the caller's retry, same as per-call dialing).
+                self._pool.maybe_evict(err, address)
+                span.attrs["code"] = err.code().name
+                context.abort(err.code(), err.details())
 
 
 def registry_server(
@@ -406,9 +425,15 @@ def registry_server(
         endpoint, tls=service.tls, interceptors=(LogServerInterceptor(),)
     )
 
+    proxy = TransparentProxy(service, dial)
+
     def register(grpc_server: grpc.Server) -> None:
         add_registry_to_server(service, grpc_server)
-        grpc_server.add_generic_rpc_handlers((TransparentProxy(service, dial),))
+        grpc_server.add_generic_rpc_handlers((proxy,))
 
+    # The proxy's pooled controller channels live exactly as long as the
+    # registry serves (a test process running several registries must not
+    # accumulate channels across their lifetimes).
+    server.add_cleanup(proxy.close)
     server.start(register)
     return server

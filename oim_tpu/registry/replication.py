@@ -57,7 +57,7 @@ from oim_tpu.common import faultinject, metrics as M
 from oim_tpu.common.endpoints import RegistryEndpoints
 from oim_tpu.common.logging import from_context
 from oim_tpu.common.pathutil import REGISTRY_ADDRESS, REGISTRY_MESH
-from oim_tpu.common.tlsutil import dial
+from oim_tpu.common.channelpool import ChannelPool
 from oim_tpu.registry.db import get_registry_entries
 from oim_tpu.spec import RegistryStub, pb
 
@@ -200,6 +200,10 @@ class ReplicationManager:
         self._wake = threading.Event()
         self._threads: list[threading.Thread] = []
         self._call = None  # in-flight follower stream, cancellable
+        # Own pool (not the process-shared one): stop() closes it, and a
+        # test process running several registries must not cross their
+        # channel lifetimes.
+        self._pool = ChannelPool()
         # Follower state. (_applied, _peer_log_id) always describe a
         # CONSISTENT position: they only move together at SNAPSHOT_END or
         # record-by-record while tailing — never at HELLO, so a stream
@@ -476,6 +480,7 @@ class ReplicationManager:
         for t in self._threads:
             t.join(timeout=5.0)
         self._threads.clear()
+        self._pool.close()
 
     def _pause(self, timeout: float) -> bool:
         """Sleep until ``timeout``, a role transition, or shutdown.
@@ -484,27 +489,30 @@ class ReplicationManager:
         self._wake.clear()
         return self._stop.is_set()
 
-    def _peer_channel(self) -> grpc.Channel:
-        return dial(self.peer.current(), self.tls, "component.registry")
+    def _peer_channel(self, target: str) -> grpc.Channel:
+        # Pooled: the follow loop reconnects every stream loss and every
+        # backoff tick — per-reconnect dialing paid a TLS handshake each
+        # time. Transport failures evict (``maybe_evict``), so a restarted
+        # or re-pointed peer still gets a fresh dial.
+        return self._pool.get(target, self.tls, "component.registry")
 
     def _probe_peer(self, timeout: float = 5.0):
         """One HELLO round trip. Demotes a primary that discovers a
         higher-epoch peer (or loses the equal-epoch ``log_id`` tie-break
         against another primary — operator-error dual primaries converge
         to exactly one)."""
-        channel = self._peer_channel()
+        target = self.peer.current()
         try:
-            call = RegistryStub(channel).Replicate(
+            call = RegistryStub(self._peer_channel(target)).Replicate(
                 pb.ReplicateRequest(
                     epoch=self.epoch, log_id=self.log.log_id, probe=True),
                 timeout=timeout,
             )
             hello = next(iter(call), None)
-        except grpc.RpcError:
+        except grpc.RpcError as err:
+            self._pool.maybe_evict(err, target)
             self.peer.advance()
             return None
-        finally:
-            channel.close()
         if hello is None or hello.kind != KIND_HELLO:
             return None
         with self._lock:
@@ -556,7 +564,8 @@ class ReplicationManager:
             delay = min(delay * 2, cap)
 
     def _follow_once(self) -> None:
-        channel = self._peer_channel()
+        target = self.peer.current()
+        channel = self._peer_channel(target)
         try:
             with self._lock:
                 request = pb.ReplicateRequest(
@@ -571,13 +580,18 @@ class ReplicationManager:
                     call.cancel()
                     return
                 self._apply(rec)
+        except grpc.RpcError as err:
+            # A dead stream is the one place the pool can't self-heal:
+            # evict before the tail loop's backoff so the reconnect dials
+            # fresh instead of riding the broken socket.
+            self._pool.maybe_evict(err, target)
+            raise
         finally:
             self._call = None
             # A stream that died mid-snapshot must not leave apply state
             # behind: the next stream restarts its own snapshot.
             self._in_snapshot = False
             self._snapshot_seen = set()
-            channel.close()
 
     def _apply(self, rec) -> None:
         faultinject.fire("replication.apply", kind=rec.kind)

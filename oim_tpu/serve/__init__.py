@@ -1,0 +1,34 @@
+"""Serving plane: a continuous-batching inference tier over CSI-staged
+weights.
+
+The PR 4/5 storage machinery (content-addressed stage cache,
+PrestageVolume fan-out, proxy-free ReadVolume) is a model
+weight-distribution system; this package puts the request path on top:
+
+* ``weights``   — pack a checkpoint's params into ONE raw volume, publish
+  it through the feeder, prestage it to N serving replicas, restore it
+  into a params tree (O(1) cache-hit boots after the first replica).
+* ``engine``    — the slot-based continuous-batching scheduler: requests
+  are admitted into a fixed [max_batch, max_seq] decode batch mid-flight
+  (per-slot prefill insert + lockstep decode over a shared KV cache),
+  with per-request retirement, bounded-queue backpressure, and graceful
+  drain. The scheduler stays off the decode hot path the way OIM keeps
+  the control plane off the data path.
+* ``service``   — the ``oim.v1.Serve`` gRPC daemon (server-streaming
+  token deltas; cancel/deadline evicts the slot).
+"""
+
+from oim_tpu.serve.engine import (  # noqa: F401
+    Draining,
+    GenHandle,
+    QueueFull,
+    ServeEngine,
+)
+from oim_tpu.serve.service import ServeService, serve_server  # noqa: F401
+from oim_tpu.serve.weights import (  # noqa: F401
+    pack_params,
+    publish_weights,
+    restore_weights,
+    save_packed,
+    unpack_params,
+)

@@ -1,0 +1,423 @@
+"""Continuous-batching decode engine: the serving tier's scheduler.
+
+One fixed ``[max_batch, max_seq]`` KV cache is shared by every live
+request. A request is admitted into a free batch row MID-FLIGHT — its
+prefill (models/generate.py ``prefill_into_slot``, batch-1 numerics
+against a fresh zero slot cache) runs between decode steps of the
+residents, then the whole batch advances in lockstep through ONE compiled
+decode program (``decode_step``, per-row positions). Retirement is
+per-slot: an EOS token or the request's max-tokens budget frees the row
+for the next admission, so throughput is bounded by slot occupancy, not
+by the slowest request in a static batch.
+
+Scheduling stays off the decode hot path: the engine thread's loop is
+admit-if-free-slot, one device step, emit — no locks are held across the
+device dispatch, and token streams drain through per-request queues so a
+slow consumer never stalls the batch.
+
+Invariants the tests pin (tests/test_serve.py):
+* outputs are byte-identical to a solo ``generate()`` run per request —
+  admission order, batch-mates, and slot reuse must not change a single
+  token (greedy AND sampled: the per-request RNG chain splits exactly the
+  way generate() does);
+* a retired slot leaks nothing into its next occupant (prefill starts
+  from a zero slot cache and zeroes its pad tail);
+* a full admission queue refuses new work (``QueueFull`` →
+  RESOURCE_EXHAUSTED at the service layer) instead of queueing silently;
+* cancel evicts the slot at the next step boundary;
+* ``stop(drain=True)`` finishes residents, fails the queue as "drained".
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from oim_tpu.common import metrics as M, tracing
+from oim_tpu.common.logging import from_context
+from oim_tpu.models.llama import Config
+
+
+class QueueFull(Exception):
+    """The bounded admission queue is full — backpressure, never silent
+    queueing (the service maps this to RESOURCE_EXHAUSTED)."""
+
+
+class Draining(Exception):
+    """The engine is draining/stopped and admits nothing new."""
+
+
+_DONE = object()  # sentinel closing a request's token stream
+
+
+@dataclasses.dataclass
+class _Request:
+    prompt: list[int]
+    max_new: int
+    temperature: float
+    seed: int
+    eos: int
+    out: "queue.Queue[Any]" = dataclasses.field(
+        default_factory=lambda: queue.Queue())
+    cancelled: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+    finish_reason: str = ""
+    submitted_at: float = 0.0
+    admitted_at: float = 0.0
+    finished_at: float = 0.0
+    emitted: int = 0
+    last_emit_at: float = 0.0
+    trace_ctx: Any = None
+
+
+class GenHandle:
+    """Caller-side view of one submitted request: a token stream, a
+    cancel switch, and the post-mortem stats the service puts on spans."""
+
+    def __init__(self, req: _Request):
+        self._req = req
+
+    def tokens(self, timeout: float | None = None):
+        """Yield token ids as the batch produces them; returns when the
+        request finishes (see ``finish_reason``). ``timeout`` bounds the
+        wait for EACH token, raising ``queue.Empty`` when it lapses."""
+        while True:
+            item = self._req.out.get(timeout=timeout)
+            if item is _DONE:
+                return
+            yield item
+
+    def result(self, timeout: float | None = None) -> list[int]:
+        return list(self.tokens(timeout=timeout))
+
+    def cancel(self) -> None:
+        """Ask the engine to evict this request's slot at the next step
+        boundary (idempotent; also unblocks a queued request)."""
+        self._req.cancelled.set()
+
+    @property
+    def finish_reason(self) -> str:
+        return self._req.finish_reason
+
+    @property
+    def stats(self) -> dict:
+        r = self._req
+        return {
+            "queue_wait_s": max(r.admitted_at - r.submitted_at, 0.0)
+            if r.admitted_at else 0.0,
+            "tokens": r.emitted,
+            "finish_reason": r.finish_reason,
+        }
+
+
+class ServeEngine:
+    # Sliding window (seconds) behind the oim_serve_qps gauge.
+    QPS_WINDOW_S = 10.0
+    # Smallest prefill bucket: prompts are padded up to the next power of
+    # two >= this, so a handful of compiled prefill programs serve every
+    # prompt length (the pad tail's K/V is zeroed by prefill_into_slot).
+    MIN_PREFILL_BUCKET = 8
+
+    def __init__(
+        self,
+        params,
+        cfg: Config,
+        max_batch: int = 8,
+        max_seq: int = 256,
+        queue_depth: int = 64,
+        default_max_new: int = 64,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from oim_tpu.models import generate as gen
+
+        if max_batch < 1 or max_seq < 2:
+            raise ValueError(f"need max_batch >= 1 and max_seq >= 2, got "
+                             f"{max_batch}x{max_seq}")
+        self._jax, self._jnp = jax, jnp
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.max_seq = max_seq
+        self.queue_depth = queue_depth
+        self.default_max_new = default_max_new
+        self.params = jax.tree.map(jnp.asarray, params)
+        self._cache = gen.init_cache(cfg, max_batch, max_seq)
+
+        def step(params, cache, tokens, pos, keys, temps):
+            logits, cache = gen.decode_step(params, tokens, cache, pos, cfg)
+            split = jax.vmap(jax.random.split)(keys)  # [B, 2, key]
+            carry, subs = split[:, 0], split[:, 1]
+            # Sampling matches generate() bit-for-bit per row: each slot
+            # samples its OWN key against a [1, vocab] row — the shapes a
+            # solo batch-1 run feeds categorical — so a sampled request's
+            # tokens don't depend on its batch-mates. Greedy rows compute
+            # the (discarded) sampled branch against temperature 1.
+            safe = jnp.where(temps > 0, temps, 1.0)
+
+            def samp(key, row, t):
+                return jax.random.categorical(key, (row / t)[None, :])[0]
+
+            sampled = jax.vmap(samp)(subs, logits, safe)
+            greedy = jnp.argmax(logits, axis=-1)
+            tok = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+            return tok, cache, carry
+
+        self._step = jax.jit(step, donate_argnums=(1,))
+
+        def prefill(params, cache, tokens, n_tokens, slot, key, temp):
+            last, cache = gen.prefill_into_slot(
+                params, tokens, n_tokens, cache, slot, cfg)
+            carry, sub = jax.random.split(key)
+            safe = jnp.where(temp > 0, temp, 1.0)
+            sampled = jax.random.categorical(sub, (last / safe)[None, :])[0]
+            tok = jnp.where(
+                temp > 0, sampled, jnp.argmax(last)).astype(jnp.int32)
+            return tok, cache, carry
+
+        # One compiled program per prompt-length BUCKET (tokens shape is
+        # static); buckets are powers of two, so log2(max_seq) programs
+        # cover every admissible prompt.
+        self._prefill = jax.jit(prefill, donate_argnums=(1,))
+
+        # Per-slot host state (the scheduler's view; device state is the
+        # cache + whatever the last step returned).
+        self._slots: list[_Request | None] = [None] * max_batch
+        self._tokens = np.zeros(max_batch, np.int32)
+        self._pos = np.zeros(max_batch, np.int32)
+        self._temps = np.zeros(max_batch, np.float32)
+        # Zero keys for idle rows (their split/sample is discarded); a
+        # slot's real key chain starts at PRNGKey(seed) on admission.
+        self._keys = np.zeros((max_batch, 2), np.uint32)
+        self._pending: collections.deque[_Request] = collections.deque()
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._stopping = False
+        self._draining = False
+        self._completions: collections.deque[float] = collections.deque()
+        self._thread = threading.Thread(
+            target=self._run, name="oim-serve-engine", daemon=True)
+        self._thread.start()
+
+    # -- submission ---------------------------------------------------------
+
+    def submit(self, prompt, max_new: int = 0, temperature: float = 0.0,
+               seed: int = 0, eos: int = -1) -> GenHandle:
+        """Queue one request; returns immediately with its handle.
+        Raises ``QueueFull`` (bounded queue) or ``Draining`` (engine
+        stopping), and ``ValueError`` for an inadmissible request."""
+        prompt = [int(t) for t in prompt]
+        max_new = int(max_new) or self.default_max_new
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+        if len(prompt) + max_new > self.max_seq:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({max_new}) "
+                f"exceeds the engine's max_seq {self.max_seq}")
+        req = _Request(
+            prompt=prompt, max_new=max_new, temperature=float(temperature),
+            seed=int(seed), eos=int(eos),
+            submitted_at=time.monotonic(),
+            trace_ctx=tracing.current_context(),
+        )
+        with self._lock:
+            if self._stopping or self._draining:
+                raise Draining("engine is draining; not accepting requests")
+            if len(self._pending) >= self.queue_depth:
+                M.SERVE_REQUESTS_TOTAL.labels(outcome="rejected").inc()
+                raise QueueFull(
+                    f"admission queue full ({self.queue_depth} waiting)")
+            self._pending.append(req)
+            M.SERVE_QUEUE_DEPTH.set(len(self._pending))
+            self._work.notify()
+        return GenHandle(req)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
+        """Shut the engine down. ``drain=True`` (graceful) finishes every
+        RESIDENT request first; queued-but-unadmitted requests finish as
+        "drained" either way (their stream closes with no tokens)."""
+        with self._lock:
+            self._draining = True
+            if not drain:
+                self._stopping = True
+            self._work.notify()
+        self._thread.join(timeout=timeout)
+
+    @property
+    def active_slots(self) -> int:
+        with self._lock:
+            return sum(s is not None for s in self._slots)
+
+    @property
+    def queue_len(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    # -- engine loop --------------------------------------------------------
+
+    def _run(self) -> None:
+        log = from_context()
+        try:
+            while True:
+                with self._lock:
+                    while (not self._pending
+                           and not any(s is not None for s in self._slots)
+                           and not (self._stopping or self._draining)):
+                        self._work.wait()
+                    if self._stopping or self._draining:
+                        self._fail_pending_locked("drained")
+                    stop_now = self._stopping
+                    done = (self._stopping or self._draining) and not any(
+                        s is not None for s in self._slots)
+                if done:
+                    return
+                if stop_now:
+                    self._evict_all("drained")
+                    return
+                self._admit()
+                if any(s is not None for s in self._slots):
+                    self._decode_once()
+        except Exception as err:  # noqa: BLE001 - the loop IS the process
+            import traceback
+
+            log.error("serve engine died; failing all requests",
+                      error=repr(err), traceback=traceback.format_exc())
+            self._evict_all("error")
+            with self._lock:
+                self._stopping = True
+                self._fail_pending_locked("error")
+
+    def _fail_pending_locked(self, reason: str) -> None:
+        while self._pending:
+            req = self._pending.popleft()
+            self._finish(req, reason)
+        M.SERVE_QUEUE_DEPTH.set(0)
+
+    def _evict_all(self, reason: str) -> None:
+        for i, req in enumerate(self._slots):
+            if req is not None:
+                self._slots[i] = None
+                self._finish(req, reason)
+        self._occupancy()
+
+    def _occupancy(self) -> None:
+        M.SERVE_SLOT_OCCUPANCY.set(
+            sum(s is not None for s in self._slots) / self.max_batch)
+
+    def _finish(self, req: _Request, reason: str) -> None:
+        req.finish_reason = reason
+        req.finished_at = time.monotonic()
+        req.out.put(_DONE)
+        M.SERVE_REQUESTS_TOTAL.labels(outcome=reason).inc()
+        now = req.finished_at
+        self._completions.append(now)
+        while (self._completions
+               and now - self._completions[0] > self.QPS_WINDOW_S):
+            self._completions.popleft()
+        span = max(now - self._completions[0], 1e-3)
+        M.SERVE_QPS.set(
+            len(self._completions) / max(span, self.QPS_WINDOW_S / 2))
+
+    def _emit(self, req: _Request, token: int) -> None:
+        now = time.monotonic()
+        base = req.last_emit_at or req.submitted_at
+        M.SERVE_TOKEN_LATENCY.observe(now - base)
+        M.SERVE_TOKENS_TOTAL.inc()
+        req.last_emit_at = now
+        req.emitted += 1
+        req.out.put(int(token))
+
+    def _bucket(self, n: int) -> int:
+        b = self.MIN_PREFILL_BUCKET
+        while b < n:
+            b *= 2
+        return min(b, self.max_seq)
+
+    def _admit(self) -> None:
+        """Insert queued requests into free slots (prefill between decode
+        steps: new work overlaps residents' decoding at step granularity)."""
+        jnp = self._jnp
+        while True:
+            with self._lock:
+                free = next(
+                    (i for i, s in enumerate(self._slots) if s is None), None)
+                if free is None or not self._pending:
+                    return
+                req = self._pending.popleft()
+                M.SERVE_QUEUE_DEPTH.set(len(self._pending))
+            if req.cancelled.is_set():
+                self._finish(req, "cancelled")
+                continue
+            req.admitted_at = time.monotonic()
+            n = len(req.prompt)
+            padded = np.zeros((1, self._bucket(n)), np.int32)
+            padded[0, :n] = req.prompt
+            with tracing.start_span(
+                    "serve.prefill", parent=req.trace_ctx,
+                    slot=free, prompt_tokens=n):
+                tok, self._cache, key = self._prefill(
+                    self.params, self._cache, jnp.asarray(padded),
+                    jnp.int32(n), jnp.int32(free),
+                    self._jax.random.PRNGKey(req.seed),
+                    jnp.float32(req.temperature))
+                tok = int(tok)
+            self._keys[free] = np.asarray(key)
+            self._tokens[free] = tok
+            self._pos[free] = n
+            self._temps[free] = req.temperature
+            with self._lock:
+                self._slots[free] = req
+            self._occupancy()
+            self._emit(req, tok)
+            self._retire_if_done(free, req, tok)
+
+    def _retire_if_done(self, slot: int, req: _Request, token: int) -> bool:
+        if req.cancelled.is_set():
+            reason = "cancelled"
+        elif req.eos >= 0 and token == req.eos:
+            reason = "eos"
+        elif req.emitted >= req.max_new:
+            reason = "length"
+        else:
+            return False
+        with self._lock:
+            self._slots[slot] = None
+        self._occupancy()
+        self._finish(req, reason)
+        return True
+
+    def _decode_once(self) -> None:
+        """One lockstep decode step over every resident slot; idle rows
+        compute a discarded garbage token."""
+        jnp = self._jnp
+        tok, self._cache, keys = self._step(
+            self.params, self._cache, jnp.asarray(self._tokens),
+            jnp.asarray(self._pos), jnp.asarray(self._keys),
+            jnp.asarray(self._temps))
+        tok = np.asarray(tok)
+        # np.array, not asarray: a view of a jax array is read-only, and
+        # the next admission writes its slot's key chain in place.
+        self._keys = np.array(keys)
+        with self._lock:
+            live = [(i, r) for i, r in enumerate(self._slots) if r is not None]
+        for i, req in live:
+            if req.cancelled.is_set():
+                with self._lock:
+                    self._slots[i] = None
+                self._occupancy()
+                self._finish(req, "cancelled")
+                continue
+            self._tokens[i] = tok[i]
+            self._pos[i] += 1
+            self._emit(req, int(tok[i]))
+            self._retire_if_done(i, req, int(tok[i]))

@@ -18,12 +18,16 @@ from oim_tpu.spec.services import (  # noqa: F401
     IdentityServicer,
     RegistryStub,
     RegistryServicer,
+    ServeStub,
+    ServeServicer,
     add_controller_to_server,
     add_feeder_to_server,
     add_identity_to_server,
     add_registry_to_server,
+    add_serve_to_server,
     CONTROLLER_SERVICE,
     FEEDER_SERVICE,
     IDENTITY_SERVICE,
     REGISTRY_SERVICE,
+    SERVE_SERVICE,
 )

@@ -16,6 +16,7 @@ REGISTRY_SERVICE = "oim.v1.Registry"
 CONTROLLER_SERVICE = "oim.v1.Controller"
 IDENTITY_SERVICE = "oim.v1.Identity"
 FEEDER_SERVICE = "oim.v1.Feeder"
+SERVE_SERVICE = "oim.v1.Serve"
 
 # method name -> (request class, reply class)
 REGISTRY_METHODS = {
@@ -55,6 +56,12 @@ FEEDER_METHODS = {
 
 FEEDER_STREAM_METHODS = {
     "ReadPublished": (pb.ReadVolumeRequest, pb.ReadVolumeChunk),
+}
+
+SERVE_METHODS: dict = {}
+
+SERVE_STREAM_METHODS = {
+    "Generate": (pb.GenerateRequest, pb.GenerateDelta),
 }
 
 
@@ -109,6 +116,12 @@ class FeederStub(_Stub):
     _service = FEEDER_SERVICE
     _methods = FEEDER_METHODS
     _stream_methods = FEEDER_STREAM_METHODS
+
+
+class ServeStub(_Stub):
+    _service = SERVE_SERVICE
+    _methods = SERVE_METHODS
+    _stream_methods = SERVE_STREAM_METHODS
 
 
 class RegistryServicer:
@@ -181,6 +194,11 @@ class IdentityServicer:
         context.abort(grpc.StatusCode.UNIMPLEMENTED, "Probe not implemented")
 
 
+class ServeServicer:
+    def Generate(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "Generate not implemented")
+
+
 class FeederServicer:
     def PublishVolume(self, request, context):
         context.abort(grpc.StatusCode.UNIMPLEMENTED, "PublishVolume not implemented")
@@ -216,4 +234,10 @@ def add_identity_to_server(servicer: IdentityServicer, server: grpc.Server) -> N
 def add_feeder_to_server(servicer: FeederServicer, server: grpc.Server) -> None:
     _add_service(
         server, servicer, FEEDER_SERVICE, FEEDER_METHODS, FEEDER_STREAM_METHODS
+    )
+
+
+def add_serve_to_server(servicer: ServeServicer, server: grpc.Server) -> None:
+    _add_service(
+        server, servicer, SERVE_SERVICE, SERVE_METHODS, SERVE_STREAM_METHODS
     )

@@ -1,0 +1,487 @@
+"""Ring-1 tests for the serving plane (oim_tpu/serve).
+
+The invariants the continuous-batching engine must hold (engine.py
+docstring): mid-flight admission produces BYTE-IDENTICAL tokens vs. a
+solo ``generate()`` run per request (greedy and sampled); a retired
+slot leaks nothing into its next occupant; the bounded admission queue
+refuses (never silently queues); cancel evicts the slot. Plus the
+weight-distribution path (pack -> publish -> prestage -> O(1) restore)
+and the ``oim.v1.Serve`` gRPC surface, ending in the PR's acceptance
+run: publish a checkpoint once, prestage 2 serving replicas (second
+restore provably re-reads NOTHING from source), then 16+ concurrent
+streaming requests admitted mid-flight, each byte-identical to solo.
+"""
+
+import threading
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+import jax
+
+from oim_tpu.common import metrics as M
+from oim_tpu.common.meshcoord import MeshCoord
+from oim_tpu.controller import malloc_backend
+from oim_tpu.controller.controller import (
+    Controller,
+    ControllerService,
+    controller_server,
+)
+from oim_tpu.controller.malloc_backend import MallocBackend
+from oim_tpu.data import plane
+from oim_tpu.feeder import Feeder
+from oim_tpu.models import generate as gen, llama
+from oim_tpu.registry.db import MemRegistryDB
+from oim_tpu.registry.registry import CONTROLLER_ID_META, RegistryService, registry_server
+from oim_tpu.serve import (
+    Draining,
+    QueueFull,
+    ServeEngine,
+    ServeService,
+    pack_params,
+    save_packed,
+    unpack_params,
+)
+from oim_tpu.serve.service import serve_server
+from oim_tpu.serve.weights import publish_weights, restore_weights, weights_request
+from oim_tpu.spec import ControllerStub, RegistryStub, ServeStub, pb
+from oim_tpu.common import tlsutil
+
+
+def wait_for(predicate, timeout=15.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+@pytest.fixture(scope="module")
+def model():
+    """One tiny model for the whole module: every ServeEngine build pays
+    a prefill+decode jit, so tests share params/config where they can."""
+    cfg = llama.tiny(vocab=64, dim=32, n_layers=2)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def solo_tokens(params, cfg, prompt, n_new, temperature=0.0, seed=0,
+                max_seq=64):
+    """What a per-request generate() run yields — the byte-identity
+    reference for every engine output."""
+    out = gen.generate(
+        params, np.asarray([prompt], np.int32), n_new, cfg,
+        temperature=temperature, rng=jax.random.PRNGKey(seed),
+        max_seq=max_seq)
+    return out[0, len(prompt):].tolist()
+
+
+@pytest.fixture
+def engine(model):
+    params, cfg = model
+    eng = ServeEngine(params, cfg, max_batch=2, max_seq=64, queue_depth=8)
+    yield eng
+    eng.stop(drain=False, timeout=30)
+
+
+class TestEngineInvariants:
+    def test_midflight_admission_byte_identical(self, model):
+        """More requests than slots, mixed greedy/sampled, mixed lengths:
+        every admission happens against a batch mid-decode, and every
+        output must still match its solo run token-for-token."""
+        params, cfg = model
+        eng = ServeEngine(params, cfg, max_batch=2, max_seq=64,
+                          queue_depth=16)
+        try:
+            reqs = [
+                ([1, 2, 3], 8, 0.0, 0),
+                ([5, 6], 10, 0.7, 1),
+                ([7, 8, 9, 10, 11], 6, 0.0, 2),
+                ([12], 12, 1.3, 3),
+                ([3, 1, 4, 1, 5, 9, 2, 6], 7, 0.0, 4),
+                ([42, 17], 9, 0.5, 5),
+            ]
+            handles = [
+                eng.submit(p, max_new=n, temperature=t, seed=s)
+                for p, n, t, s in reqs
+            ]
+            outs = [h.result(timeout=120) for h in handles]
+        finally:
+            eng.stop(timeout=30)
+        for (p, n, t, s), out in zip(reqs, outs):
+            assert out == solo_tokens(params, cfg, p, n, t, s), (p, t, s)
+
+    def test_slot_reuse_leaks_nothing(self, model):
+        """A slot's next occupant sees a zero cache: with max_batch=1
+        every request reuses THE slot, and each must still match solo —
+        including a short prompt right after a long one (the pad tail
+        and the old occupant's K/V both must not bleed in)."""
+        params, cfg = model
+        eng = ServeEngine(params, cfg, max_batch=1, max_seq=64,
+                          queue_depth=8)
+        try:
+            seq = [([9] * 40, 8), ([9], 8), ([5, 5, 5], 5)]
+            for prompt, n_new in seq:
+                out = eng.submit(prompt, max_new=n_new).result(timeout=120)
+                assert out == solo_tokens(params, cfg, prompt, n_new), prompt
+        finally:
+            eng.stop(timeout=30)
+
+    def test_queue_backpressure(self, model):
+        params, cfg = model
+        eng = ServeEngine(params, cfg, max_batch=1, max_seq=512,
+                          queue_depth=1)
+        try:
+            resident = eng.submit([1], max_new=400)
+            assert wait_for(lambda: eng.active_slots == 1)
+            eng.submit([2], max_new=400)  # fills the 1-deep queue
+            before = M.SERVE_REQUESTS_TOTAL.labels(outcome="rejected").value
+            with pytest.raises(QueueFull):
+                eng.submit([3], max_new=2)
+            after = M.SERVE_REQUESTS_TOTAL.labels(outcome="rejected").value
+            assert after == before + 1
+            assert resident.finish_reason == ""  # resident unharmed
+        finally:
+            eng.stop(drain=False, timeout=30)
+
+    def test_cancel_evicts_slot_and_queued(self, model):
+        params, cfg = model
+        eng = ServeEngine(params, cfg, max_batch=1, max_seq=512,
+                          queue_depth=4)
+        try:
+            resident = eng.submit([1], max_new=400)
+            assert wait_for(lambda: eng.active_slots == 1)
+            queued = eng.submit([2], max_new=400)
+            resident.cancel()
+            queued.cancel()
+            assert wait_for(
+                lambda: eng.active_slots == 0 and eng.queue_len == 0)
+            # Streams close; both retire as cancelled.
+            resident.result(timeout=30)
+            queued.result(timeout=30)
+            assert resident.finish_reason == "cancelled"
+            assert queued.finish_reason == "cancelled"
+            # The freed slot serves the next request correctly.
+            out = eng.submit([4, 5], max_new=4).result(timeout=120)
+            assert out == solo_tokens(params, cfg, [4, 5], 4, max_seq=512)
+        finally:
+            eng.stop(timeout=30)
+
+    def test_eos_retires_early(self, model):
+        """Declaring the solo run's second token as EOS must retire the
+        request right when it appears, with reason "eos"."""
+        params, cfg = model
+        ref = solo_tokens(params, cfg, [1, 2, 3], 8)
+        eos = ref[1]
+        expect = ref[:ref.index(eos) + 1]  # retire at FIRST occurrence
+        assert len(expect) < len(ref)
+        eng = ServeEngine(params, cfg, max_batch=2, max_seq=64)
+        try:
+            h = eng.submit([1, 2, 3], max_new=8, eos=eos)
+            out = h.result(timeout=120)
+            assert out == expect
+            assert h.finish_reason == "eos"
+        finally:
+            eng.stop(timeout=30)
+
+    def test_graceful_drain(self, model):
+        """stop(drain=True): residents finish their full budget, the
+        queued request closes as "drained", new submits refuse."""
+        params, cfg = model
+        eng = ServeEngine(params, cfg, max_batch=1, max_seq=64,
+                          queue_depth=4)
+        resident = eng.submit([6, 7], max_new=6)
+        assert wait_for(lambda: eng.active_slots == 1)
+        queued = eng.submit([8], max_new=6)
+        eng.stop(drain=True, timeout=60)
+        assert resident.result(timeout=5) == solo_tokens(
+            params, cfg, [6, 7], 6)
+        assert resident.finish_reason == "length"
+        assert queued.result(timeout=5) == []
+        assert queued.finish_reason == "drained"
+        with pytest.raises(Draining):
+            eng.submit([1], max_new=2)
+
+    def test_inadmissible_requests(self, model):
+        params, cfg = model
+        eng = ServeEngine(params, cfg, max_batch=1, max_seq=16)
+        try:
+            with pytest.raises(ValueError):
+                eng.submit([], max_new=2)
+            with pytest.raises(ValueError):
+                eng.submit([1] * 10, max_new=8)  # 10 + 8 > max_seq 16
+            with pytest.raises(ValueError):
+                eng.submit([1], max_new=-1)
+        finally:
+            eng.stop(timeout=30)
+
+    def test_occupancy_and_queue_metrics(self, model):
+        params, cfg = model
+        eng = ServeEngine(params, cfg, max_batch=2, max_seq=512,
+                          queue_depth=4)
+        try:
+            a = eng.submit([1], max_new=400)
+            b = eng.submit([2], max_new=400)
+            assert wait_for(lambda: eng.active_slots == 2)
+            assert M.SERVE_SLOT_OCCUPANCY.value == 1.0
+            c = eng.submit([3], max_new=400)
+            assert eng.queue_len == 1
+            assert M.SERVE_QUEUE_DEPTH.value >= 1.0
+            for h in (a, b, c):
+                h.cancel()
+        finally:
+            eng.stop(drain=False, timeout=30)
+
+
+class TestWeights:
+    def test_pack_unpack_roundtrip(self, model):
+        params, _ = model
+        blob = pack_params(params)
+        assert pack_params(params) == blob  # content-addressable
+        tree = unpack_params(blob)
+        ref = jax.tree_util.tree_flatten_with_path(params)[0]
+        got = jax.tree_util.tree_flatten_with_path(tree)[0]
+        assert [jax.tree_util.keystr(p) for p, _ in ref] == \
+            [jax.tree_util.keystr(p) for p, _ in got]
+        for (_, a), (_, b) in zip(ref, got):
+            a, b = np.asarray(a), np.asarray(b)
+            assert a.dtype == b.dtype and a.shape == b.shape
+            np.testing.assert_array_equal(a, b)
+
+    def test_unpack_is_zero_copy_over_arrays(self, model):
+        params, _ = model
+        buf = np.frombuffer(pack_params(params), np.uint8)
+        tree = unpack_params(buf)
+        leaf = tree["embed"]
+        # A view into the staged buffer, not a copy.
+        assert leaf.base is not None
+
+    def test_bad_magic_refused(self):
+        with pytest.raises(ValueError, match="magic"):
+            unpack_params(b"\x00" * 64)
+
+    def test_publish_restore_local(self, model, tmp_path):
+        params, cfg = model
+        path = tmp_path / "w.oimw"
+        save_packed(params, str(path))
+        feeder = Feeder(controller=ControllerService(MallocBackend()))
+        publish_weights(feeder, "weights", str(path))
+        tree = restore_weights(feeder, "weights")
+        for (_, a), (_, b) in zip(
+                jax.tree_util.tree_flatten_with_path(params)[0],
+                jax.tree_util.tree_flatten_with_path(tree)[0]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestServeService:
+    """The gRPC surface: streaming deltas, wire statuses, slot eviction
+    on stream death."""
+
+    @pytest.fixture
+    def cluster(self, model):
+        params, cfg = model
+        eng = ServeEngine(params, cfg, max_batch=1, max_seq=512,
+                          queue_depth=1)
+        server = serve_server("tcp://127.0.0.1:0", ServeService(eng))
+        channel = tlsutil.dial(server.addr, None)
+        yield eng, ServeStub(channel), params, cfg
+        channel.close()
+        server.force_stop()
+        eng.stop(drain=False, timeout=30)
+
+    def test_stream_matches_solo(self, cluster):
+        eng, stub, params, cfg = cluster
+        deltas = list(stub.Generate(
+            pb.GenerateRequest(prompt=[1, 2, 3], max_new_tokens=6),
+            timeout=120))
+        toks = [t for d in deltas for t in d.tokens]
+        assert toks == solo_tokens(params, cfg, [1, 2, 3], 6, max_seq=512)
+        assert deltas[-1].done and deltas[-1].finish_reason == "length"
+        assert all(not d.done for d in deltas[:-1])
+
+    def test_queue_full_resource_exhausted(self, cluster):
+        eng, stub, params, cfg = cluster
+        resident = eng.submit([1], max_new=400)
+        assert wait_for(lambda: eng.active_slots == 1)
+        queued = eng.submit([2], max_new=400)
+        with pytest.raises(grpc.RpcError) as err:
+            list(stub.Generate(
+                pb.GenerateRequest(prompt=[3], max_new_tokens=2),
+                timeout=30))
+        assert err.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        resident.cancel()
+        queued.cancel()
+
+    def test_client_cancel_evicts_slot(self, cluster):
+        eng, stub, params, cfg = cluster
+        call = stub.Generate(
+            pb.GenerateRequest(prompt=[5], max_new_tokens=400), timeout=120)
+        next(call)  # stream is live, the slot is held
+        call.cancel()
+        assert wait_for(lambda: eng.active_slots == 0)
+
+    def test_invalid_argument(self, cluster):
+        _, stub, _, _ = cluster
+        with pytest.raises(grpc.RpcError) as err:
+            list(stub.Generate(
+                pb.GenerateRequest(prompt=[], max_new_tokens=2), timeout=30))
+        assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+    def test_draining_unavailable(self, model):
+        params, cfg = model
+        eng = ServeEngine(params, cfg, max_batch=1, max_seq=64)
+        server = serve_server("tcp://127.0.0.1:0", ServeService(eng))
+        channel = tlsutil.dial(server.addr, None)
+        try:
+            eng.stop(drain=True, timeout=30)
+            with pytest.raises(grpc.RpcError) as err:
+                list(ServeStub(channel).Generate(
+                    pb.GenerateRequest(prompt=[1], max_new_tokens=2),
+                    timeout=30))
+            assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+        finally:
+            channel.close()
+            server.force_stop()
+
+
+@pytest.fixture
+def counted_reads(monkeypatch):
+    """Counts source reads on both backend paths, so "zero source
+    re-reads" is provable (same seam as test_stagecache.py)."""
+    counts = {"reads": 0}
+    orig_reader = plane.READERS["file"]
+
+    def counting_reader(*args, **kwargs):
+        counts["reads"] += 1
+        return orig_reader(*args, **kwargs)
+
+    orig_load = malloc_backend.load_source
+
+    def counting_load(*args, **kwargs):
+        counts["reads"] += 1
+        return orig_load(*args, **kwargs)
+
+    monkeypatch.setitem(plane.READERS, "file", counting_reader)
+    monkeypatch.setattr(malloc_backend, "load_source", counting_load)
+    return counts
+
+
+class TestServeAcceptance:
+    """The PR's end-to-end acceptance: one checkpoint publish, prestage
+    fan-out to a second serving replica (its restore re-reads NOTHING
+    from source — stage-cache hit counters prove it), then 16+
+    concurrent streaming requests through the continuous-batching
+    engine, admitted mid-flight, each byte-identical to its solo
+    generate() run."""
+
+    N_REQUESTS = 16
+
+    def test_publish_prestage_serve(self, model, tmp_path, counted_reads):
+        params, cfg = model
+        path = tmp_path / "ckpt.oimw"
+        save_packed(params, str(path))
+
+        db = MemRegistryDB()
+        registry = registry_server("tcp://localhost:0",
+                                   RegistryService(db=db))
+        backends = [MallocBackend(), MallocBackend()]
+        controllers = [
+            Controller(
+                controller_id=f"host-{i}", backend=backends[i],
+                controller_address="pending",
+                registry_address=registry.addr, registry_delay=0.1,
+                mesh_coord=MeshCoord.parse("0,0,0"),
+            )
+            for i in range(2)
+        ]
+        servers = [controller_server("tcp://localhost:0", c.service)
+                   for c in controllers]
+        for c, s in zip(controllers, servers):
+            c.controller_address = s.addr
+        engine = None
+        try:
+            for c in controllers:
+                c.start()
+            with grpc.insecure_channel(registry.addr) as ch:
+                stub = RegistryStub(ch)
+                assert wait_for(lambda: len([
+                    v for v in stub.GetValues(
+                        pb.GetValuesRequest(path="")).values
+                    if v.path.endswith("/address")]) == 2)
+
+            # Replica 0: publish ONCE (the only source read), then fan
+            # the content out to replica 1's stage cache.
+            request = weights_request("weights", str(path),
+                                      path.stat().st_size)
+            feeder0 = Feeder(registry_address=registry.addr,
+                             controller_id="host-0")
+            publish_weights(feeder0, "weights", str(path))
+            assert counted_reads["reads"] > 0
+            ControllerStub(feeder0._registry_channel()).PrestageVolume(
+                request, metadata=[(CONTROLLER_ID_META, "host-1")],
+                timeout=60.0)
+            assert wait_for(lambda: len(backends[1].cache) == 1)
+            # The fan-out stage above is the LAST time the source is
+            # touched; replica 1's boot must add nothing.
+            reads_after_fanout = counted_reads["reads"]
+
+            # Replica 1 boots: its own publish of the identical content
+            # is an O(1) cache hit — ZERO new source reads.
+            hits_before = M.STAGE_CACHE_HITS.value
+            feeder1 = Feeder(registry_address=registry.addr,
+                             controller_id="host-1")
+            publish_weights(feeder1, "weights", str(path))
+            tree = restore_weights(feeder1, "weights")
+            assert counted_reads["reads"] == reads_after_fanout, \
+                "replica 1's restore must not touch the source"
+            assert M.STAGE_CACHE_HITS.value == hits_before + 1
+
+            # Serve through the restored tree: 16 concurrent streaming
+            # requests into a 4-slot batch — admission is mid-flight by
+            # construction (4x oversubscribed).
+            engine = ServeEngine(tree, cfg, max_batch=4, max_seq=64,
+                                 queue_depth=self.N_REQUESTS)
+            server = serve_server("tcp://127.0.0.1:0", ServeService(engine))
+            servers.append(server)
+            reqs = [
+                ([1 + i, 2 + i, 3 + i % 5], 6 + i % 5,
+                 0.0 if i % 2 == 0 else 0.8, i)
+                for i in range(self.N_REQUESTS)
+            ]
+            results: list[list[int] | None] = [None] * self.N_REQUESTS
+            errors: list[Exception] = []
+
+            def run(i):
+                prompt, n_new, temp, seed = reqs[i]
+                try:
+                    with tlsutil.dial(server.addr, None) as ch:
+                        deltas = list(ServeStub(ch).Generate(
+                            pb.GenerateRequest(
+                                prompt=prompt, max_new_tokens=n_new,
+                                temperature=temp, seed=seed),
+                            timeout=300))
+                    results[i] = [t for d in deltas for t in d.tokens]
+                except Exception as err:  # noqa: BLE001 - collected
+                    errors.append(err)
+
+            threads = [threading.Thread(target=run, args=(i,))
+                       for i in range(self.N_REQUESTS)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            assert not errors, errors
+            for (prompt, n_new, temp, seed), out in zip(reqs, results):
+                assert out == solo_tokens(
+                    params, cfg, prompt, n_new, temp, seed), (prompt, seed)
+        finally:
+            if engine is not None:
+                engine.stop(drain=False, timeout=30)
+            for c in controllers:
+                c.stop()
+            for s in servers:
+                s.force_stop()
+            registry.force_stop()

@@ -1,0 +1,21 @@
+"""Tier-1 wiring of `make serve-smoke`: the tiny serving-plane load runs
+inside the normal (non-slow) test pass — weights distributed through the
+control plane (publish + O(1) cache-hit republish + restore), then an
+open-loop streaming load through the continuous-batching engine over
+real gRPC, with EVERY output asserted byte-identical to its solo
+generate() run by bench.serve_smoke() itself."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def test_serve_smoke_weights_and_batching():
+    import bench
+
+    extras = bench.serve_smoke()  # raises AssertionError on divergence
+    assert extras["serve_completed"] == extras["serve_requests"]
+    assert extras["serve_qps"] > 0
+    assert extras["token_p99_ms"] is not None
+    assert extras["weights_cache_hit"] is True
