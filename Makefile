@@ -9,7 +9,7 @@
 PY ?= python
 RUFF := $(shell command -v ruff 2>/dev/null)
 
-.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke
+.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke
 
 # drift and tsan are standalone conveniences; the full pytest target
 # already runs both (SpecDrift + the TSAN stream test build in-fixture).
@@ -58,6 +58,14 @@ bench-smoke:
 # Also runs in tier-1 as tests/test_serve_smoke.py.
 serve-smoke:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --serve --smoke
+
+# Tiny request-router correctness loop (seconds): in-process registry +
+# 2 serve replicas heartbeating TTL-leased serve/<id> rows + oim-router;
+# every routed output byte-identical to its solo generate() run and >=1
+# request served per replica (the least-loaded pick must spread).
+# Also runs in tier-1 as tests/test_router_smoke.py.
+router-smoke:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --serve --smoke --replicas 2
 
 demo:
 	bash scripts/demo_cluster.sh demo

@@ -64,10 +64,38 @@ def main(argv=None) -> int:
                              "load against an in-process oim-serve "
                              "cluster; reports serve_qps and p50/p99 "
                              "token latency")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="with --serve: N serve replicas behind an "
+                             "oim-router; reports the serve_qps scaling "
+                             "curve at 1->2->...->N replicas (with "
+                             "--smoke: the asserting in-process router "
+                             "smoke over N replicas)")
+    parser.add_argument("--in-process-replicas", action="store_true",
+                        help="with --serve --replicas N: keep the "
+                             "engines in-process instead of one pinned "
+                             "subprocess per replica (the default is "
+                             "the deployment shape)")
     args = parser.parse_args(argv)
 
     if args.serve:
-        extras = serve_smoke() if args.smoke else serve_bench()
+        if args.replicas > 1 and not args.smoke:
+            # Must land before grpc/jax import: process completion-queue
+            # events of unary-stream calls on the consuming thread
+            # instead of a channel_spin thread per channel (measured 3x
+            # cheaper client path), and keep XLA off the extra cores on
+            # a production host where a replica owns its chip.
+            os.environ.setdefault(
+                "GRPC_SINGLE_THREADED_UNARY_STREAM", "true")
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_cpu_multi_thread_eigen=false").strip()
+        if args.replicas > 1:
+            extras = (router_smoke(args.replicas) if args.smoke
+                      else router_bench(
+                          args.replicas,
+                          replica_procs=not args.in_process_replicas))
+        else:
+            extras = serve_smoke() if args.smoke else serve_bench()
         print(json.dumps({
             "metric": "serve_qps",
             "value": extras["serve_qps"],
@@ -809,6 +837,543 @@ def serve_smoke() -> dict:
         raise AssertionError(
             f"serve smoke dropped requests: {extras}")
     return extras
+
+
+@contextlib.contextmanager
+def router_cluster(params, cfg, replicas: int, max_batch: int,
+                   max_seq: int, queue_depth: int, heartbeat_s: float = 0.5,
+                   stream_tokens: int = 1, unix_sockets: bool = False):
+    """N in-process serve replicas behind an oim-router, wired through a
+    real in-process registry: each replica serves ``oim.v1.Serve`` on
+    localhost and heartbeats a TTL-leased ``serve/<id>`` load row; the
+    router polls the lease-filtered table and balances streams across
+    them. ``unix_sockets`` moves the serve/router hops onto unix domain
+    sockets (measurably cheaper than loopback TCP under a syscall-
+    intercepting sandbox). Yields (router_server, engines,
+    registrations, pool)."""
+    import tempfile
+
+    from oim_tpu.common.channelpool import ChannelPool
+    from oim_tpu.registry import MemRegistryDB, RegistryService
+    from oim_tpu.registry.registry import registry_server
+    from oim_tpu.router import ReplicaTable, RouterService, router_server
+    from oim_tpu.serve import ServeEngine, ServeRegistration, ServeService
+    from oim_tpu.serve.service import serve_server
+
+    sockdir = tempfile.mkdtemp(prefix="oim-router-bench-") \
+        if unix_sockets else None
+
+    def endpoint(name: str) -> str:
+        if sockdir is None:
+            return "tcp://127.0.0.1:0"
+        return f"unix://{sockdir}/{name}.sock"
+
+    pool = ChannelPool()
+    reg_srv = registry_server(
+        "tcp://localhost:0", RegistryService(db=MemRegistryDB()))
+    engines, servers, registrations = [], [], []
+    table = None
+    router_srv = None
+    try:
+        for i in range(replicas):
+            engine = ServeEngine(params, cfg, max_batch=max_batch,
+                                 max_seq=max_seq, queue_depth=queue_depth)
+            server = serve_server(
+                endpoint(f"r{i}"),
+                ServeService(engine, stream_tokens=stream_tokens))
+            registration = ServeRegistration(
+                f"r{i}", server.addr, engine, reg_srv.addr,
+                interval=heartbeat_s, pool=pool)
+            registration.beat_once()  # deterministic first registration
+            registration.start()
+            engines.append(engine)
+            servers.append(server)
+            registrations.append(registration)
+        table = ReplicaTable(reg_srv.addr, interval=heartbeat_s,
+                             pool=pool)
+        table.refresh()
+        if len(table) != replicas:
+            raise AssertionError(
+                f"routing table has {len(table)} of {replicas} replicas")
+        table.start()
+        router_srv = router_server(
+            endpoint("router"), RouterService(table, pool=pool))
+        yield router_srv, engines, registrations, pool
+    finally:
+        if router_srv is not None:
+            router_srv.force_stop()
+        if table is not None:
+            table.stop()
+        for registration in registrations:
+            registration.stop(deregister=False)
+        for server in servers:
+            server.force_stop()
+        for engine in engines:
+            engine.stop(drain=False, timeout=30)
+        reg_srv.force_stop()
+        pool.close()
+        if sockdir is not None:
+            import shutil
+
+            shutil.rmtree(sockdir, ignore_errors=True)
+
+
+def _routed_load(targets, reqs, concurrency: int,
+                 timeout: float = 300.0, channels: int = 4):
+    """Closed-loop load: ``concurrency`` worker threads drain the shared
+    request list back-to-back, striped over a SMALL shared channel set —
+    both extremes lose: every stream on ONE HTTP/2 connection serializes
+    on its flow-control window and single event thread, and a channel
+    PER WORKER spawns a completion-queue thread per channel (grpc
+    Python's channel_spin), whose GIL churn starves the rest of the
+    process. ``targets`` is the router address, or a list of replica
+    addresses for a router-free baseline (workers stripe across them).
+    Returns (results, first_token_s, wall_s, errors)."""
+    import queue as queue_mod
+    import threading
+
+    from oim_tpu.common import tlsutil
+    from oim_tpu.spec import ServeStub, pb
+
+    if isinstance(targets, str):
+        targets = [targets]
+    work: "queue_mod.Queue[int]" = queue_mod.Queue()
+    for i in range(len(reqs)):
+        work.put(i)
+    results: list[list[int] | None] = [None] * len(reqs)
+    first_token_s: list[float] = []
+    errors: list[Exception] = []
+    lock = threading.Lock()
+    chans = [tlsutil.dial(target, None) for target in targets
+             for _ in range(max(1, min(channels, concurrency)
+                                // len(targets)))]
+    stubs = [ServeStub(c) for c in chans]
+
+    def worker(wi: int):
+        stub = stubs[wi % len(stubs)]
+        while True:
+            try:
+                i = work.get_nowait()
+            except queue_mod.Empty:
+                return
+            prompt, n_new, temp, seed = reqs[i]
+            start = time.monotonic()
+            try:
+                toks: list[int] = []
+                first = None
+                for delta in stub.Generate(
+                        pb.GenerateRequest(
+                            prompt=prompt, max_new_tokens=n_new,
+                            temperature=temp, seed=seed),
+                        timeout=timeout):
+                    if first is None:
+                        first = time.monotonic() - start
+                    toks.extend(delta.tokens)
+                with lock:
+                    results[i] = toks
+                    first_token_s.append(first)
+            except Exception as err:  # noqa: BLE001 - tallied by caller
+                with lock:
+                    errors.append(err)
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(concurrency)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    wall = time.monotonic() - t0
+    for channel in chans:
+        channel.close()
+    return results, first_token_s, wall, errors
+
+
+REPLICA_SPEC_ENV = "OIM_BENCH_REPLICA"
+
+
+def replica_main() -> int:
+    """Entry point of ONE bench replica subprocess (router_bench): build
+    the shared tiny model from the shared seed (deterministic, so every
+    process holds byte-identical params), warm the jit programs, serve
+    ``oim.v1.Serve`` on an ephemeral port and heartbeat the TTL-leased
+    ``serve/<id>`` row; print ``READY <addr>`` when routable, drain on
+    SIGTERM (the oim-serve daemon's lifecycle, minus the weights
+    plumbing the serve bench already times)."""
+    import signal
+    import threading
+
+    import jax
+
+    from oim_tpu.models import llama
+    from oim_tpu.serve import ServeEngine, ServeRegistration, ServeService
+    from oim_tpu.serve.service import serve_server
+
+    spec = json.loads(os.environ[REPLICA_SPEC_ENV])
+    if spec.get("pin_core") is not None and hasattr(os, "sched_setaffinity"):
+        # One core per replica, kernel-enforced: the CPU analog of "a
+        # replica owns its accelerator". XLA's CPU runtime multi-threads
+        # regardless of --xla_cpu_multi_thread_eigen (measured: 1.45
+        # cores for one 'single-threaded' engine), so without affinity
+        # the 1-replica baseline quietly eats the whole box and the
+        # scaling curve measures nothing.
+        os.sched_setaffinity(0, {spec["pin_core"] % os.cpu_count()})
+    cfg = llama.tiny(vocab=spec["vocab"], dim=spec["dim"],
+                     n_layers=spec["n_layers"])
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    engine = ServeEngine(params, cfg, max_batch=spec["max_batch"],
+                         max_seq=spec["max_seq"],
+                         queue_depth=spec["queue_depth"])
+    # Compile the load's prefill bucket + the decode program off the
+    # routed clock.
+    engine.submit(list(range(1, spec["warm_prompt"] + 1)),
+                  max_new=2).result(timeout=600)
+    server = serve_server(
+        spec.get("endpoint", "tcp://127.0.0.1:0"),
+        ServeService(engine, stream_tokens=spec.get("stream_tokens", 1)))
+    registration = ServeRegistration(
+        spec["serve_id"], server.addr, engine, spec["registry"],
+        interval=spec["heartbeat_s"])
+    registration.beat_once()  # routable BEFORE READY is announced
+    registration.start()
+    print(f"READY {server.addr}", flush=True)
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    stop.wait()
+    registration.announce_draining()
+    engine.stop(drain=True, timeout=60)
+    registration.stop(deregister=True)
+    server.force_stop()
+    return 0
+
+
+@contextlib.contextmanager
+def router_cluster_procs(replicas: int, spec: dict, heartbeat_s: float = 0.5):
+    """N serve replicas as SUBPROCESSES behind an in-process oim-router
+    and registry. A replica per process is the deployment shape (one
+    replica per host/chip) — and on a small bench box the difference
+    between measuring replica scaling and measuring N engines convoying
+    on one interpreter's GIL: each subprocess owns its own GIL and a
+    single-threaded XLA, so 2 replicas genuinely occupy 2 cores. Yields
+    the router server."""
+    import subprocess
+    import tempfile
+
+    from oim_tpu.common.channelpool import ChannelPool
+    from oim_tpu.registry import MemRegistryDB, RegistryService
+    from oim_tpu.registry.registry import registry_server
+    from oim_tpu.router import ReplicaTable, RouterService, router_server
+
+    sockdir = tempfile.mkdtemp(prefix="oim-router-bench-")
+    pool = ChannelPool()
+    reg_srv = registry_server(
+        "tcp://localhost:0", RegistryService(db=MemRegistryDB()))
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                   + " --xla_cpu_multi_thread_eigen=false").strip())
+    procs: list = []
+    table = None
+    router_srv = None
+    try:
+        for i in range(replicas):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c",
+                 "import bench; raise SystemExit(bench.replica_main())"],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                env=dict(env, **{REPLICA_SPEC_ENV: json.dumps(dict(
+                    spec, registry=reg_srv.addr, serve_id=f"r{i}",
+                    endpoint=f"unix://{sockdir}/r{i}.sock",
+                    pin_core=i, heartbeat_s=heartbeat_s))}),
+                stdout=subprocess.PIPE, text=True))
+        addrs = []
+        for proc in procs:  # blocks on each replica's warm-up compile
+            line = proc.stdout.readline()
+            if not line.startswith("READY"):
+                raise AssertionError(f"replica failed to boot: {line!r}")
+            addrs.append(line.split(None, 1)[1].strip())
+        table = ReplicaTable(reg_srv.addr, interval=heartbeat_s, pool=pool)
+        table.refresh()
+        if len(table) != replicas:
+            raise AssertionError(
+                f"routing table has {len(table)} of {replicas} replicas")
+        table.start()
+        router_srv = router_server(
+            f"unix://{sockdir}/router.sock", RouterService(table, pool=pool))
+        yield router_srv, addrs
+    finally:
+        for proc in procs:
+            proc.terminate()  # SIGTERM: graceful drain + deregister
+        if router_srv is not None:
+            router_srv.force_stop()
+        if table is not None:
+            table.stop()
+        for proc in procs:
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            proc.stdout.close()
+        reg_srv.force_stop()
+        pool.close()
+        import shutil
+
+        shutil.rmtree(sockdir, ignore_errors=True)
+
+
+def router_bench(replicas: int = 2, max_batch: int = 8, max_new: int = 4,
+                 requests_per_slot: int = 6, dim: int = 256,
+                 n_layers: int = 8, rounds: int = 2,
+                 replica_procs: bool = True) -> dict:
+    """The serving tier's scaling curve: N serve replicas behind an
+    oim-router (real registry, real serve/<id> heartbeats, real routed
+    gRPC streams), saturated by a fixed closed-loop load, at 1 -> 2 ->
+    ... -> ``replicas`` replicas. The headline is ``serve_scaling_x`` —
+    completed-request throughput at N replicas over the 1-replica figure
+    — with first-token percentiles alongside (the fixed offered load
+    queues deepest at 1 replica, so p99 must not degrade as replicas
+    are added).
+
+    Methodology, learned the hard way on a 2-core sandboxed CI box:
+
+    * Replica counts are measured INTERLEAVED over ``rounds`` rounds and
+      the best run per count is reported (min-time benchmarking): the
+      box's deliverable CPU swings ~2x minute-to-minute, which a single
+      sequential pass turns into a scaling lottery.
+    * Replica subprocesses by default, each PINNED to one core (the
+      deployment shape — one replica per host/chip, and the only honest
+      1-replica baseline: unpinned, a lone engine's XLA pool eats the
+      whole box and the curve measures nothing).
+      ``replica_procs=False`` keeps the engines in-process (jax releases
+      the GIL during XLA compute, so they still parallelize; useful
+      where subprocess spawn is awkward).
+    * Serve/router hops ride unix sockets, responses are chunked to two
+      frames (stream_tokens), and clients stripe a small channel set —
+      each removes a measured serving-path serializer (connection-level
+      HTTP/2 flow control, per-token messages, channel_spin threads).
+
+    Per-request ENGINE compute still has to dwarf the per-message
+    serving overhead for the curve to measure replicas, and the f32
+    weights have to stay cache-resident or two replicas bottleneck on
+    shared DRAM instead of the serving path (measured: dim 256 scales
+    1.88x pure-engine on 2 cores, dim 768 only 1.64x)."""
+    import jax
+
+    from oim_tpu.common import metrics as M
+    from oim_tpu.models import generate as gen, llama
+
+    vocab, max_seq = 512, 64
+    prompt_lo, prompt_hi = 33, 48  # one prefill bucket: 33..48 -> 64
+    cfg = llama.tiny(vocab=vocab, dim=dim, n_layers=n_layers)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+
+    counts = [1]
+    while counts[-1] * 2 <= replicas:
+        counts.append(counts[-1] * 2)
+    if counts[-1] != replicas:
+        counts.append(replicas)
+
+    # The SAME offered load for every replica count (sized to saturate
+    # the largest): scaling shows up as throughput, not as a moving
+    # target.
+    concurrency = 2 * max_batch * replicas
+    n_requests = concurrency * requests_per_slot // 2
+    rng = np.random.RandomState(11)
+    reqs = [
+        (
+            rng.randint(1, vocab, size=rng.randint(
+                prompt_lo, prompt_hi + 1)).tolist(),
+            max_new,
+            0.0 if i % 2 == 0 else 0.8,
+            i,
+        )
+        for i in range(n_requests)
+    ]
+    # Two frames per response (first token, then the rest + done): the
+    # serving path's per-message cost is what competes with the replicas
+    # for the box (see serve/service.py stream_tokens).
+    stream_tokens = max_new
+    proc_spec = dict(vocab=vocab, dim=dim, n_layers=n_layers,
+                     max_batch=max_batch, max_seq=max_seq,
+                     queue_depth=concurrency + max_batch,
+                     stream_tokens=stream_tokens, warm_prompt=prompt_hi)
+
+    @contextlib.contextmanager
+    def cluster(count):
+        if replica_procs:
+            with router_cluster_procs(count, proc_spec) as (router_srv,
+                                                            addrs):
+                yield router_srv, addrs
+            return
+        with router_cluster(
+                params, cfg, count, max_batch, max_seq,
+                queue_depth=concurrency + max_batch,
+                stream_tokens=stream_tokens,
+                unix_sockets=True) as (router_srv, engines, regs, _pool):
+            for engine in engines:  # compile off the routed clock
+                engine.submit(list(range(1, prompt_hi + 1)),
+                              max_new=2).result(timeout=600)
+            yield router_srv, [r.endpoint for r in regs]
+
+    def one_run(count, measure_hop=False):
+        with cluster(count) as (router_srv, addrs):
+            # Touch the routed path (router->replica channels, stream
+            # setup) off the clock.
+            _routed_load(router_srv.addr,
+                         [(list(range(1, prompt_hi + 1)), 2, 0.0, 0)] *
+                         (2 * count), concurrency=2 * count)
+            results, first_token_s, wall, errors = _routed_load(
+                router_srv.addr, reqs, concurrency)
+            direct_qps = None
+            if measure_hop:
+                # Router-free baseline over the SAME replicas seconds
+                # later: the hop cost, controlled for the box's mood —
+                # the noise-robust claim that the router is not the
+                # tier's serializer.
+                d_results, _, d_wall, d_errors = _routed_load(
+                    addrs, reqs, concurrency)
+                if not d_errors and all(r is not None for r in d_results):
+                    direct_qps = len(d_results) / d_wall
+        if errors:
+            raise AssertionError(
+                f"{len(errors)} routed requests failed at {count} "
+                f"replicas; first: {errors[0]!r}")
+        completed = [r for r in results if r is not None]
+        if len(completed) != n_requests:
+            raise AssertionError(
+                f"router bench dropped requests at {count} replicas: "
+                f"{len(completed)}/{n_requests}")
+        # Byte-identity tripwire through the router (a slice; the smoke
+        # verifies every request).
+        for i in range(0, n_requests, max(n_requests // 4, 1)):
+            prompt, n_new, temp, seed = reqs[i]
+            solo = gen.generate(
+                params, np.asarray([prompt], np.int32), n_new, cfg,
+                temperature=temp, rng=jax.random.PRNGKey(seed),
+                max_seq=max_seq)[0, len(prompt):].tolist()
+            if results[i] != solo:
+                raise AssertionError(
+                    f"routed tokens diverge from solo generate() for "
+                    f"request {i} at {count} replicas")
+        return len(completed) / wall, first_token_s, direct_qps
+
+    extras: dict = {
+        "router_replica_counts": counts,
+        "router_requests_per_count": n_requests,
+        "router_concurrency": concurrency,
+        "router_slots_per_replica": max_batch,
+        "router_bench_rounds": rounds,
+        "router_replica_procs": replica_procs,
+    }
+    best: dict[int, tuple[float, list]] = {}
+    best_direct: float | None = None
+    retries_before = M.ROUTER_RETRIES_TOTAL.value
+    for _ in range(max(1, rounds)):
+        for count in counts:  # interleaved: noise hits every count alike
+            qps, first_token_s, direct_qps = one_run(
+                count, measure_hop=count == replicas)
+            if count not in best or qps > best[count][0]:
+                best[count] = (qps, first_token_s)
+            if direct_qps is not None and (best_direct is None
+                                           or direct_qps > best_direct):
+                best_direct = direct_qps
+    pct = lambda xs, q: (  # noqa: E731
+        round(float(np.percentile(xs, q)) * 1e3, 3) if xs else None)
+    for count, (qps, first_token_s) in best.items():
+        extras[f"serve_qps_{count}r"] = round(qps, 2)
+        extras[f"first_token_p50_ms_{count}r"] = pct(first_token_s, 50)
+        extras[f"first_token_p99_ms_{count}r"] = pct(first_token_s, 99)
+    extras["serve_qps"] = extras[f"serve_qps_{replicas}r"]
+    extras["serve_qps_per_replicas"] = {
+        str(c): extras[f"serve_qps_{c}r"] for c in counts}
+    extras["serve_scaling_x"] = round(
+        extras[f"serve_qps_{replicas}r"] / extras["serve_qps_1r"], 3)
+    if best_direct is not None:
+        # Routed over router-free throughput at the full replica count:
+        # ~1.0 means the hop adds no serialization (the scaling curve
+        # itself also reflects whatever the BOX serializes — on a
+        # shared/sandboxed runner this ratio is the robust signal).
+        extras[f"serve_qps_direct_{replicas}r"] = round(best_direct, 2)
+        extras["router_hop_ratio"] = round(
+            extras[f"serve_qps_{replicas}r"] / best_direct, 3)
+    extras["router_retries"] = int(
+        M.ROUTER_RETRIES_TOTAL.value - retries_before)
+    return extras
+
+
+def router_smoke(replicas: int = 2) -> dict:
+    """Tiny asserting router run (seconds): in-process registry + N
+    engines + router; EVERY routed output byte-identical to its solo
+    generate() run, and every replica served at least one request (the
+    least-loaded pick must actually spread). The tier-1 guard wired in
+    as tests/test_router_smoke.py and `make router-smoke`."""
+    import jax
+
+    from oim_tpu.common import metrics as M
+    from oim_tpu.models import generate as gen, llama
+
+    cfg = llama.tiny(vocab=64, dim=32, n_layers=2)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    max_batch, max_seq, max_new = 2, 64, 8
+    n_requests = 4 * max_batch * replicas
+    rng = np.random.RandomState(5)
+    reqs = [
+        (
+            rng.randint(1, cfg.vocab, size=rng.randint(2, 8)).tolist(),
+            int(rng.randint(3, max_new + 1)),
+            0.0 if i % 2 == 0 else 0.7,
+            i,
+        )
+        for i in range(n_requests)
+    ]
+
+    def replica_served(rid: str) -> float:
+        # Completed streams only (finish_reason outcomes land under the
+        # replica's label; "length"/"eos" are the possible ones here).
+        return sum(
+            M.ROUTER_REQUESTS_TOTAL.labels(replica=rid, outcome=o).value
+            for o in ("length", "eos"))
+
+    before = {f"r{i}": replica_served(f"r{i}") for i in range(replicas)}
+    with router_cluster(params, cfg, replicas, max_batch, max_seq,
+                        queue_depth=n_requests) as (
+            router_srv, engines, _regs, _pool):
+        for engine in engines:
+            engine.submit([1, 2, 3], max_new=2).result(timeout=300)
+        results, first_token_s, wall, errors = _routed_load(
+            router_srv.addr, reqs, concurrency=2 * max_batch * replicas)
+    if errors:
+        raise AssertionError(
+            f"{len(errors)} routed requests failed; first: {errors[0]!r}")
+    served = {rid: replica_served(rid) - b for rid, b in before.items()}
+    for rid, count in served.items():
+        if count < 1:
+            raise AssertionError(
+                f"replica {rid} served no requests (routing did not "
+                f"spread): {served}")
+    for i, (prompt, n_new, temp, seed) in enumerate(reqs):
+        solo = gen.generate(
+            params, np.asarray([prompt], np.int32), n_new, cfg,
+            temperature=temp, rng=jax.random.PRNGKey(seed),
+            max_seq=max_seq)[0, len(prompt):].tolist()
+        if results[i] != solo:
+            raise AssertionError(
+                f"routed tokens diverge from solo generate() for request "
+                f"{i}: {results[i]} != {solo}")
+    pct = lambda xs, q: (  # noqa: E731
+        round(float(np.percentile(xs, q)) * 1e3, 3) if xs else None)
+    return {
+        "serve_qps": round(len(reqs) / wall, 2),
+        "serve_requests": n_requests,
+        "serve_completed": sum(r is not None for r in results),
+        "router_replicas": replicas,
+        "router_served_per_replica": {k: int(v) for k, v in served.items()},
+        "first_token_p50_ms": pct(first_token_s, 50),
+        "first_token_p99_ms": pct(first_token_s, 99),
+        "router_byte_identity": True,
+    }
 
 
 if __name__ == "__main__":

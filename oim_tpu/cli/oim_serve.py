@@ -177,6 +177,24 @@ def main(argv: list[str] | None = None) -> int:
         help="controller id to PrestageVolume the weights to after "
              "publishing (repeatable: fan the content out so each "
              "replica's own publish hits its stage cache)")
+    parser.add_argument(
+        "--serve-id", default="",
+        help="register this replica in the routing table: a TTL-leased "
+             "serve/<id> registry row with endpoint + load snapshot, "
+             "re-published every --heartbeat seconds (needs --registry; "
+             "under mTLS the id must be the host's controller id or "
+             "'<controller-id>.<suffix>')")
+    parser.add_argument(
+        "--advertise", default="",
+        help="endpoint routers dial for this replica (default: the "
+             "bound listen address — override when clients reach this "
+             "host through a different name/VIP; required when the "
+             "listen endpoint binds a wildcard address)")
+    parser.add_argument(
+        "--heartbeat", type=float, default=10.0,
+        help="seconds between serve/<id> row re-publishes; the row's "
+             "lease is 2.5x this, so dead replicas vanish from routing "
+             "after ~2.5 missed beats")
     parser.add_argument("--max-batch", type=int, default=8,
                         help="decode-batch slots (continuous batch width)")
     parser.add_argument("--max-seq", type=int, default=256,
@@ -185,6 +203,12 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--queue-depth", type=int, default=64,
                         help="bounded admission queue; full = new requests "
                              "answer RESOURCE_EXHAUSTED")
+    parser.add_argument("--stream-tokens", type=int, default=1,
+                        help="token-stream granularity: the first token "
+                             "flushes immediately, later deltas batch up "
+                             "to this many tokens per message (1 = every "
+                             "token; raise to cut per-message serving "
+                             "overhead on chatty streams)")
     parser.add_argument("--default-max-new", type=int, default=64,
                         help="decode budget when the request leaves "
                              "max_new_tokens unset")
@@ -210,6 +234,9 @@ def main(argv: list[str] | None = None) -> int:
         # in-process backend has no registry to route through.
         raise SystemExit("--prestage needs remote mode (--registry + "
                          "--controller-id), not --backend")
+    if args.serve_id and not args.registry:
+        raise SystemExit("--serve-id registers in the routing table and "
+                         "needs --registry")
     if args.platform:
         import jax as _jax
 
@@ -227,11 +254,35 @@ def main(argv: list[str] | None = None) -> int:
         default_max_new=args.default_max_new,
     )
     server = serve_server(
-        args.endpoint, ServeService(engine), tls=load_tls_flags(args))
+        args.endpoint,
+        ServeService(engine, stream_tokens=args.stream_tokens),
+        tls=load_tls_flags(args))
     log.info(
         "oim-serve serving", endpoint=args.endpoint, addr=server.addr,
         model=args.model, max_batch=args.max_batch, max_seq=args.max_seq,
     )
+
+    registration = None
+    if args.serve_id:
+        from oim_tpu.serve import ServeRegistration
+
+        advertise = args.advertise or server.addr
+        host = advertise.rsplit(":", 1)[0]
+        if host in ("0.0.0.0", "[::]", "::"):
+            # Publishing the wildcard bind address would make every
+            # router dial ITS OWN loopback (connection refused at best,
+            # a different colocated replica at worst).
+            raise SystemExit(
+                f"--serve-id would advertise the wildcard address "
+                f"{advertise!r}; pass --advertise host:port with the "
+                f"address routers should dial")
+        registration = ServeRegistration(
+            args.serve_id, advertise, engine,
+            args.registry, interval=args.heartbeat,
+            tls=load_tls_flags(args))
+        registration.start()
+        log.info("registered in routing table", serve_id=args.serve_id,
+                 advertise=advertise, heartbeat_s=args.heartbeat)
 
     drained = threading.Event()
 
@@ -247,7 +298,13 @@ def main(argv: list[str] | None = None) -> int:
         pass
     log.info("draining", active=engine.active_slots,
              queued=engine.queue_len)
+    if registration is not None:
+        # ready: false FIRST, so routers rotate away while the residents
+        # below finish on their still-open streams.
+        registration.announce_draining()
     engine.stop(drain=True, timeout=args.drain_timeout)
+    if registration is not None:
+        registration.stop(deregister=True)
     server.stop()
     obs.stop()
     return 0

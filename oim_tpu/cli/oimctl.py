@@ -48,6 +48,43 @@ def health_rows(stub: RegistryStub) -> list[tuple[str, str, str, str]]:
     return rows
 
 
+def serve_health_rows(stub: RegistryStub) -> list[tuple[str, str, str, str]]:
+    """One row per registered serving replica (`oim-serve --serve-id`),
+    from the TTL-leased ``serve/<id>`` load snapshots: lease freshness
+    (ALIVE/STALE, same lease-plane semantics as the controller rows),
+    routed endpoint, and the advertised load (free decode slots, queued
+    requests, readiness — a draining replica shows ready=false for its
+    last beats before deregistering)."""
+    import json
+
+    from oim_tpu.common.pathutil import REGISTRY_SERVE
+
+    live = {
+        v.path
+        for v in stub.GetValues(
+            pb.GetValuesRequest(path=REGISTRY_SERVE), timeout=10).values
+    }
+    stale = stub.GetValues(
+        pb.GetValuesRequest(path=REGISTRY_SERVE, include_stale=True),
+        timeout=10,
+    ).values
+    rows = []
+    for value in sorted(stale, key=lambda v: v.path):
+        try:
+            snap = json.loads(value.value)
+        except ValueError:
+            snap = {}
+        if not isinstance(snap, dict):
+            snap = {}
+        status = "ALIVE" if value.path in live else "STALE"
+        load = (f"free={snap.get('free_slots', '?')}/"
+                f"{snap.get('max_batch', '?')} "
+                f"queue={snap.get('queue_depth', '?')} "
+                f"ready={str(bool(snap.get('ready', False))).lower()}")
+        rows.append((value.path, status, snap.get("endpoint", "?"), load))
+    return rows
+
+
 def registry_health_row(stub: RegistryStub) -> tuple[str, str, str, str] | None:
     """The registry's own row for the --health table, from the virtual
     ``registry/...`` status keys: role, replication lag (records/seconds),
@@ -319,13 +356,16 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{value.path}={value.value}")
     if args.health:
         def table(stub):
-            return registry_health_row(stub), health_rows(stub)
+            return (registry_health_row(stub), health_rows(stub),
+                    serve_health_rows(stub))
 
-        registry_row, rows = with_failover(table)
+        registry_row, rows, serve_rows = with_failover(table)
         if registry_row is not None:
             print("\t".join(registry_row))
         for cid, status, address, mesh in rows:
             print(f"{cid}\t{status}\t{address}\t{mesh}")
+        for key, status, endpoint, load in serve_rows:
+            print(f"{key}\t{status}\t{endpoint}\t{load}")
     if args.set is None and args.get is None and not args.health \
             and not args.promote and args.metrics is None:
         raise SystemExit(

@@ -44,9 +44,11 @@ import grpc
 from oim_tpu.common import metrics as M
 from oim_tpu.common.tlsutil import TLSConfig
 
-# (address, peer_name, TLSConfig | None): TLSConfig is a frozen dataclass,
-# so identical credentials hash to one pool slot.
-PoolKey = tuple[str, str, "TLSConfig | None"]
+# (address, peer_name, TLSConfig | None, lane): TLSConfig is a frozen
+# dataclass, so identical credentials hash to one pool slot; ``lane``
+# stripes callers that hold MANY long-lived streams to one target across
+# several connections (see ``get``).
+PoolKey = tuple[str, str, "TLSConfig | None", int]
 
 
 class ChannelPool:
@@ -87,11 +89,22 @@ class ChannelPool:
         return due
 
     def get(self, address: str, tls: TLSConfig | None = None,
-            peer_name: str = "") -> grpc.Channel:
+            peer_name: str = "", lane: int = 0) -> grpc.Channel:
         """The pooled channel for this target, dialing on first use.
         Callers never close the returned channel — they ``maybe_evict``
-        on transport failures instead."""
-        key = (address, peer_name, tls)
+        on transport failures instead.
+
+        ``lane`` selects among SEVERAL pooled connections to one target:
+        one gRPC channel is one HTTP/2 connection, whose single
+        connection-level flow-control window and in-order frame stream
+        serialize the many concurrent long-lived streams a fan-in caller
+        (the request router) lays on it — measured on the serving path,
+        enough to halve 2-replica throughput. Callers with that shape
+        stripe streams round-robin over a small lane set; unary/occasional
+        callers keep the default single lane. Eviction drops every lane
+        to the address at once (transport failures are per-endpoint, not
+        per-connection)."""
+        key = (address, peer_name, tls, lane)
         now = time.monotonic()
         with self._lock:
             due = self._reap_locked(now)

@@ -415,6 +415,21 @@ SERVE_TOKEN_LATENCY = DEFAULT.histogram(
     "prefill token, inter-token gap for decode tokens",
     buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
              1.0, 2.5))
+# Request router (oim_tpu/router: least-loaded LB over serve replicas).
+ROUTER_REQUESTS_TOTAL = DEFAULT.counter(
+    "oim_router_requests_total",
+    "routed Generate attempts, by replica and outcome: a finish_reason "
+    "(eos/length/...) for completed streams, retried = failed before the "
+    "first token and moved to the next replica, error = surfaced to the "
+    "client, cancelled = client went away, unroutable = empty table",
+    labelnames=("replica", "outcome"))
+ROUTER_RETRIES_TOTAL = DEFAULT.counter(
+    "oim_router_retries_total",
+    "pre-first-token failovers to the next replica "
+    "(RESOURCE_EXHAUSTED/UNAVAILABLE from the first pick)")
+ROUTER_REPLICAS = DEFAULT.gauge(
+    "oim_router_replicas",
+    "ready serve replicas in the router's lease-filtered routing table")
 # Labeled RPC telemetry (common/tracing.py interceptors — the
 # go-grpc-prometheus analog; recorded by client and server vantage alike).
 RPC_LATENCY = DEFAULT.histogram(

@@ -11,6 +11,11 @@ from __future__ import annotations
 # Well-known registry key components.
 REGISTRY_ADDRESS = "address"
 REGISTRY_MESH = "mesh"
+# Top-level namespace for serving-replica rows: ``serve/<serve-id>`` ->
+# JSON load snapshot (oim_tpu/serve/registration.py). Lives here, not in
+# the serve package, because the registry's authorization rules need the
+# constant without importing the jax-heavy serving stack.
+REGISTRY_SERVE = "serve"
 
 
 def split_registry_path(path: str) -> list[str]:

@@ -32,6 +32,7 @@ from oim_tpu.common.logging import from_context
 from oim_tpu.common.pathutil import (
     REGISTRY_ADDRESS,
     REGISTRY_MESH,
+    REGISTRY_SERVE,
     path_has_prefix,
     split_registry_path,
 )
@@ -100,7 +101,8 @@ class RegistryService(RegistryServicer):
 
     @staticmethod
     def _may_set(peer: str, path_parts: list[str]) -> bool:
-        """Reference registry.go:100-109, extended with the mesh key."""
+        """Reference registry.go:100-109, extended with the mesh key and
+        the serving tier's ``serve/<id>`` load rows."""
         if peer == "user.admin":
             return True
         if peer.startswith("controller."):
@@ -108,8 +110,23 @@ class RegistryService(RegistryServicer):
             return (
                 len(path_parts) == 2
                 and path_parts[0] == controller_id
+                # "serve" is reserved for replica rows: a controller named
+                # serve could otherwise write serve/address — and its
+                # Heartbeat would prefix-renew EVERY replica's lease.
+                and controller_id != REGISTRY_SERVE
                 and path_parts[1] in (REGISTRY_ADDRESS, REGISTRY_MESH)
             )
+        if peer.startswith("host.") and len(path_parts) == 2 \
+                and path_parts[0] == REGISTRY_SERVE:
+            # A serve replica registers its serve/<id> row under its host
+            # identity (remote mode dials as host.<controller-id>). The
+            # serve id must be the host's own controller id — or a
+            # dot-suffixed variant of it, for several replicas on one
+            # host — so no host can overwrite another replica's row and
+            # steal its traffic.
+            host_id = peer[len("host."):]
+            serve_id = path_parts[1]
+            return serve_id == host_id or serve_id.startswith(host_id + ".")
         return False
 
     # -- service methods --------------------------------------------------
@@ -236,6 +253,15 @@ class RegistryService(RegistryServicer):
                 grpc.StatusCode.INVALID_ARGUMENT,
                 f"controller_id {request.controller_id!r} is a path, not an id",
             )
+        if request.controller_id == REGISTRY_SERVE:
+            # Renewal is prefix-scoped: a "serve" heartbeat would renew
+            # EVERY replica row's lease at once. Replica rows renew by
+            # re-publishing their load snapshot (serve/registration.py).
+            context.abort(
+                grpc.StatusCode.INVALID_ARGUMENT,
+                f"{REGISTRY_SERVE!r} is a reserved namespace, not a "
+                "controller id",
+            )
         if not (peer == "user.admin"
                 or peer == f"controller.{request.controller_id}"):
             context.abort(
@@ -316,6 +342,27 @@ class TransparentProxy(grpc.GenericRpcHandler):
         """Release the pooled controller channels (registry shutdown)."""
         self._pool.close()
 
+    # The one proxied method a host may call on a FOREIGN controller.
+    PRESTAGE_METHOD = "/oim.v1.Controller/PrestageVolume"
+
+    def _may_prestage(self, peer: str | None, method: str) -> bool:
+        """The cross-controller prestage exemption (ROADMAP item 5 note):
+        the strict ``host.<id>`` -> ``<id>`` rule blocks warm-standby and
+        serve weight fan-out under mTLS, because both prestage PEER
+        controllers. PrestageVolume is a content-addressed cache warm —
+        it maps nothing, mutates no volume, and a bogus warm just ages
+        out of the LRU — so it is exempted for any LIVE mesh member: a
+        ``host.<x>`` whose OWN controller is registered with an unexpired
+        lease (an unregistered/expired identity stays locked out, and
+        every other method keeps the strict rule)."""
+        if method != self.PRESTAGE_METHOD:
+            return False
+        if not peer or not peer.startswith("host."):
+            return False
+        own_key = f"{peer[len('host.'):]}/{REGISTRY_ADDRESS}"
+        return bool(self._service.db.get(own_key)) \
+            and self._service.leases.expired_for(own_key) is None
+
     def service(self, handler_call_details):
         method = handler_call_details.method
         if method.startswith(f"/{REGISTRY_SERVICE}/"):
@@ -344,10 +391,12 @@ class TransparentProxy(grpc.GenericRpcHandler):
                 f"missing {CONTROLLER_ID_META} metadata",
             )
         # Authorization: only the host assigned to this controller may talk to
-        # it (reference registry.go:176-184).
+        # it (reference registry.go:176-184) — except the one narrowly-scoped
+        # cross-controller exemption, PrestageVolume (see _may_prestage).
         if self._service.tls is not None:
             peer = peer_common_name(context)
-            if peer != f"host.{controller_id}":
+            if peer != f"host.{controller_id}" and not self._may_prestage(
+                    peer, method):
                 context.abort(
                     grpc.StatusCode.PERMISSION_DENIED,
                     f"{peer!r} may not access controller {controller_id!r}",

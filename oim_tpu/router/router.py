@@ -1,0 +1,303 @@
+"""The streaming request router: ``oim.v1.Serve`` fanned out over N
+replicas.
+
+Pick policy — least-loaded with a power-of-two-choices tie-break: a
+replica's score is its advertised backlog (``queue_depth - free_slots``,
+from the heartbeat snapshot, which is up to one beat stale) plus the
+router's OWN in-flight count against it (live, and exactly the part the
+stale snapshot misses). The lowest score routes; among tied scores two
+candidates are sampled and the one with fewer router-local in-flight
+streams wins — the classic balls-into-bins result, which keeps a fleet
+of routers from herding onto one replica between heartbeats.
+
+Retry contract — before the first token delta ONLY: a replica answering
+``RESOURCE_EXHAUSTED`` (admission queue full) or ``UNAVAILABLE``
+(dead/draining) is retried once on the NEXT replica by score, and
+``UNAVAILABLE`` additionally evicts the replica from the table until a
+registry poll proves it back. After the first token has streamed, any
+upstream failure surfaces to the client unchanged: a sampled stream must
+never be silently replayed — the retry would re-sample and splice two
+different completions into one response.
+
+Cancel/deadline — the client's deadline rides the upstream call
+(``context.time_remaining()``), and a client cancel fires
+``call.cancel()`` on the upstream stream, which evicts the replica's
+decode slot at its next step boundary (serve/service.py); an abandoned
+router stream never pins replica capacity.
+
+Data plane — bytes pass-through: the router registers ``Generate`` with
+IDENTITY serializers (the registry proxy's trick, registry.py) and
+forwards raw frames, so a token delta is never deserialized or
+re-serialized on the hop. The router parses exactly two messages per
+stream — the request (for the span's prompt size) and the final delta
+(for the outcome label) — not the token stream; per-token router cost is
+one Python yield of a bytes object, which is what lets a 2-core bench
+box route 2 replicas' worth of streams without the hop eating a
+replica's share of the machine.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import random
+import threading
+
+import grpc
+
+from oim_tpu.common import channelpool, metrics as M, tracing
+from oim_tpu.common.identity import IdentityService
+from oim_tpu.common.interceptors import LogServerInterceptor
+from oim_tpu.common.logging import from_context
+from oim_tpu.common.server import NonBlockingGRPCServer
+from oim_tpu.common.tlsutil import TLSConfig
+from oim_tpu.router.table import Replica, ReplicaTable
+from oim_tpu.spec import add_identity_to_server, pb
+
+GENERATE_METHOD = "/oim.v1.Serve/Generate"
+
+_IDENTITY = lambda b: b  # noqa: E731 - bytes pass-through serdes
+
+
+class RouterService:
+    """oim.v1.Serve over a ReplicaTable: pick, pass through, retry.
+
+    ``Generate`` speaks RAW BYTES on both sides (see the module
+    docstring's data-plane note); it is registered through a generic
+    handler with identity serdes, not the typed servicer."""
+
+    # One pick plus one retry on the next replica — the whole retry
+    # budget (see the module docstring's retry contract).
+    MAX_ATTEMPTS = 2
+    RETRY_CODES = (
+        grpc.StatusCode.RESOURCE_EXHAUSTED,
+        grpc.StatusCode.UNAVAILABLE,
+    )
+
+    def __init__(
+        self,
+        table: ReplicaTable,
+        tls: TLSConfig | None = None,
+        pool: channelpool.ChannelPool | None = None,
+        upstream_lanes: int = 4,
+    ):
+        self.table = table
+        self.tls = tls
+        self._pool = pool if pool is not None else channelpool.shared()
+        # A replica hosts max_batch concurrent streams from this router;
+        # laid on ONE HTTP/2 connection they serialize on its single
+        # flow-control window and in-order frame stream (measured: enough
+        # to halve 2-replica scaling), so upstream streams stripe
+        # round-robin over ``upstream_lanes`` pooled connections per
+        # replica (common/channelpool.py lanes).
+        self.upstream_lanes = max(1, upstream_lanes)
+        self._next_lane = itertools.count()
+        # Router-local in-flight streams per replica id: the live overlay
+        # on the (one-beat-stale) heartbeat load snapshots.
+        self._inflight: collections.Counter[str] = collections.Counter()
+        self._lock = threading.Lock()
+
+    # -- pick -------------------------------------------------------------
+
+    def _score(self, replica: Replica, inflight: int) -> int:
+        return replica.queue_depth - replica.free_slots + inflight
+
+    def pick(self, exclude: frozenset | set = frozenset()) -> Replica | None:
+        """The least-loaded routable replica (power-of-two-choices among
+        ties), or None when nothing is routable."""
+        candidates = [r for r in self.table.replicas()
+                      if r.replica_id not in exclude]
+        if not candidates:
+            return None
+        with self._lock:
+            scored = [(self._score(r, self._inflight[r.replica_id]), r)
+                      for r in candidates]
+            best = min(score for score, _ in scored)
+            ties = [r for score, r in scored if score == best]
+            if len(ties) == 1:
+                return ties[0]
+            two = random.sample(ties, 2)  # noqa: S311 - load balancing
+            counts = [self._inflight[r.replica_id] for r in two]
+        if counts[0] != counts[1]:
+            return two[0] if counts[0] < counts[1] else two[1]
+        return random.choice(two)  # noqa: S311 - load balancing
+
+    # -- the streaming pass-through ---------------------------------------
+
+    def Generate(self, request, context):
+        # ``request`` is RAW BYTES (identity deserializer); parse it once
+        # for the span — the token stream itself is never parsed. The
+        # span parent comes from the RAW metadata, and the hop span is
+        # injected explicitly into the upstream call: a generator body
+        # cannot rely on the server interceptor's ambient contextvar
+        # (same stance as the registry's transparent proxy).
+        parent = tracing.extract(context.invocation_metadata())
+        try:
+            prompt_tokens = len(pb.GenerateRequest.FromString(request).prompt)
+        except Exception:  # noqa: BLE001 - malformed request: let the
+            prompt_tokens = -1  # replica answer with the real parse error
+        with tracing.start_span(
+                "router.generate", parent=parent,
+                prompt_tokens=prompt_tokens) as span:
+            yield from self._route(request, context, span)
+
+    def _one_attempt(self, replica, request, context, span):
+        """Open the upstream stream and yield ('delta', bytes) items;
+        terminal items are ('done', finish_reason) / ('err', RpcError)."""
+        metadata = tracing.inject([], span.context)
+        channel = self._pool.get(
+            replica.endpoint, self.tls,
+            lane=next(self._next_lane) % self.upstream_lanes)
+        call = channel.unary_stream(
+            GENERATE_METHOD, request_serializer=_IDENTITY,
+            response_deserializer=_IDENTITY,
+        )(request, timeout=context.time_remaining(), metadata=metadata)
+        # Client cancel / deadline expiry -> cancel the upstream stream,
+        # which evicts the replica's decode slot at the next step
+        # boundary. add_callback returns False when the RPC already
+        # terminated — then cancel here or the upstream slot leaks its
+        # full decode budget.
+        if not context.add_callback(call.cancel):
+            call.cancel()
+        last = b""
+        try:
+            for delta in call:
+                last = delta
+                yield ("delta", delta)
+            # One parse per stream, of the FINAL frame only: the outcome
+            # label for the metrics below.
+            reason = ""
+            if last:
+                try:
+                    final = pb.GenerateDelta.FromString(last)
+                    reason = final.finish_reason if final.done else ""
+                except Exception:  # noqa: BLE001 - label-only parse
+                    reason = ""
+            yield ("done", reason)
+        except grpc.RpcError as err:
+            yield ("err", err)
+
+    def _route(self, request, context, span):
+        log = from_context()
+        tried: set[str] = set()
+        last_err: grpc.RpcError | None = None
+        for attempt in range(self.MAX_ATTEMPTS):
+            replica = self.pick(exclude=tried)
+            if replica is None:
+                break
+            tried.add(replica.replica_id)
+            rid = replica.replica_id
+            span.attrs["replica"] = rid
+            with self._lock:
+                self._inflight[rid] += 1
+            streamed = 0  # frames forwarded (a frame = >=1 token delta)
+            try:
+                for kind, item in self._one_attempt(
+                        replica, request, context, span):
+                    if kind == "delta":
+                        streamed += 1
+                        yield item
+                        continue
+                    if kind == "done":
+                        span.attrs["outcome"] = item or "done"
+                        span.attrs["deltas"] = streamed
+                        M.ROUTER_REQUESTS_TOTAL.labels(
+                            replica=rid, outcome=item or "done").inc()
+                        return
+                    err = item  # kind == "err"
+                    self._pool.maybe_evict(err, replica.endpoint)
+                    if not context.is_active():
+                        # The CLIENT went away (cancel/deadline); the
+                        # upstream CANCELLED is our own doing. Nothing
+                        # to answer — the RPC is already dead.
+                        span.attrs["outcome"] = "cancelled"
+                        M.ROUTER_REQUESTS_TOTAL.labels(
+                            replica=rid, outcome="cancelled").inc()
+                        return
+                    if streamed == 0 and err.code() in self.RETRY_CODES \
+                            and attempt + 1 < self.MAX_ATTEMPTS:
+                        # Pre-first-token failure: this replica is full
+                        # or gone — try the next one, once.
+                        if err.code() is grpc.StatusCode.UNAVAILABLE:
+                            self.table.mark_failed(rid)
+                        M.ROUTER_RETRIES_TOTAL.inc()
+                        M.ROUTER_REQUESTS_TOTAL.labels(
+                            replica=rid, outcome="retried").inc()
+                        log.warning(
+                            "retrying on next replica", replica=rid,
+                            code=err.code().name)
+                        last_err = err
+                        break
+                    # Mid-stream failure (or retry budget spent): surface
+                    # it — a sampled stream is never silently replayed.
+                    span.attrs["outcome"] = "error"
+                    span.attrs["code"] = err.code().name
+                    M.ROUTER_REQUESTS_TOTAL.labels(
+                        replica=rid, outcome="error").inc()
+                    context.abort(err.code(), err.details() or
+                                  err.code().name)
+            finally:
+                with self._lock:
+                    self._inflight[rid] -= 1
+                    if self._inflight[rid] <= 0:
+                        del self._inflight[rid]
+        span.attrs["outcome"] = "unroutable"
+        M.ROUTER_REQUESTS_TOTAL.labels(
+            replica="", outcome="unroutable").inc()
+        if last_err is not None:
+            context.abort(
+                last_err.code(),
+                f"all replicas failed; last: {last_err.details()}")
+        context.abort(
+            grpc.StatusCode.UNAVAILABLE,
+            "no ready serve replicas in the routing table")
+
+
+class _GenerateHandler(grpc.GenericRpcHandler):
+    """Registers the router's Generate with IDENTITY serdes, so frames
+    pass through as raw bytes (the typed ``add_serve_to_server`` path
+    would deserialize + re-serialize every token delta on the hop)."""
+
+    def __init__(self, service: RouterService):
+        self._service = service
+
+    def service(self, handler_call_details):
+        if handler_call_details.method != GENERATE_METHOD:
+            return None
+        return grpc.unary_stream_rpc_method_handler(
+            self._service.Generate,
+            request_deserializer=_IDENTITY,
+            response_serializer=_IDENTITY,
+        )
+
+
+def router_server(
+    endpoint: str, service: RouterService, tls: TLSConfig | None = None,
+    max_workers: int = 128,
+) -> NonBlockingGRPCServer:
+    """Serve the router's Serve + Identity services on one endpoint (the
+    same co-serving shape as every other oim daemon). The Identity ready
+    probe answers false while the routing table is empty, so
+    orchestration never points clients at a router with nowhere to
+    send them.
+
+    ``max_workers`` bounds concurrent ROUTED STREAMS (each holds its
+    executor thread for the stream's lifetime), so it defaults well
+    above the worker-pool default — a router's whole job is fan-in, and
+    backpressure belongs to the replicas' bounded admission queues."""
+    identity = IdentityService(
+        "oim-router",
+        capabilities=["service:serve", "role:router"],
+        ready_fn=lambda: len(service.table) > 0,
+    )
+    server = NonBlockingGRPCServer(
+        endpoint, tls=tls, interceptors=(LogServerInterceptor(),),
+        max_workers=max_workers,
+    )
+
+    def register(s):
+        s.add_generic_rpc_handlers((_GenerateHandler(service),))
+        add_identity_to_server(identity, s)
+
+    server.start(register)
+    return server

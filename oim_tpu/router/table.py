@@ -1,0 +1,231 @@
+"""The router's replica table: a lease-filtered cached view of the
+registry's ``serve/<id>`` rows.
+
+Routing decisions must stay off the control plane's hot path (OIM's
+premise: control traffic is short-lived and infrequent). The table polls
+``GetValues("serve")`` on a jittered interval and answers every routing
+decision from that cached snapshot — a registry round trip per INTERVAL,
+not per request. Liveness comes for free: the registry's lease filter
+already hides replicas that stopped heartbeating, and a draining replica
+publishes ``ready: false`` (serve/registration.py), which the table
+treats as absent. Between polls the router overlays its own signals:
+``mark_failed`` drops a replica the data path just proved dead (the
+next successful poll re-admits it if it recovered — by then its lease
+either lapsed or it is genuinely back).
+
+Registry outages degrade gracefully, feeder-style: endpoint rotation on
+UNAVAILABLE / FAILED_PRECONDITION (replicated pair), pooled channels
+with transport-failure eviction, and the last good snapshot keeps
+serving until ``max_stale`` — a registry blip must not take the whole
+serving tier down with it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+import threading
+import time
+
+import grpc
+
+from oim_tpu.common import channelpool, metrics as M
+from oim_tpu.common.endpoints import FAILOVER_CODES, RegistryEndpoints
+from oim_tpu.common.logging import from_context
+from oim_tpu.common.tlsutil import TLSConfig
+# The serve/<id> namespace constant, via pathutil rather than the serve
+# package: the router daemon routes bytes, it never imports the model
+# stack (oim_tpu.serve.__init__ pulls in jax).
+from oim_tpu.common.pathutil import REGISTRY_SERVE as SERVE_PREFIX
+from oim_tpu.spec import RegistryStub, pb
+
+
+@dataclasses.dataclass(frozen=True)
+class Replica:
+    """One live serve replica, as advertised by its last heartbeat."""
+
+    replica_id: str
+    endpoint: str
+    free_slots: int = 0
+    queue_depth: int = 0
+    max_batch: int = 0
+    ready: bool = True
+
+    @classmethod
+    def parse(cls, path: str, value: str) -> "Replica | None":
+        """A ``serve/<id>`` row -> Replica; None for rows that cannot
+        route (malformed JSON, missing endpoint, non-numeric load
+        fields) — a bad registration must not crash the table (or the
+        poll thread above it), just not receive traffic."""
+        parts = path.split("/")
+        if len(parts) != 2:
+            return None
+        try:
+            snap = json.loads(value)
+        except ValueError:
+            return None
+        if not isinstance(snap, dict) or not snap.get("endpoint"):
+            return None
+        try:
+            return cls(
+                replica_id=parts[1],
+                endpoint=str(snap["endpoint"]),
+                free_slots=int(snap.get("free_slots", 0)),
+                queue_depth=int(snap.get("queue_depth", 0)),
+                max_batch=int(snap.get("max_batch", 0)),
+                ready=bool(snap.get("ready", True)),
+            )
+        except (TypeError, ValueError):
+            return None
+
+
+class ReplicaTable:
+    """Thread-safe cached replica set with a background jittered poll."""
+
+    def __init__(
+        self,
+        registry_address: str,
+        interval: float = 2.0,
+        max_stale: float = 30.0,
+        tls: TLSConfig | None = None,
+        pool: channelpool.ChannelPool | None = None,
+    ):
+        self._endpoints = RegistryEndpoints(registry_address)
+        self.interval = interval
+        # How long the last good snapshot keeps serving through a
+        # registry outage before the table reports itself empty: bounded
+        # by how stale a routing decision may be — replicas that died in
+        # the window fail over on the data path anyway.
+        self.max_stale = max_stale
+        self.tls = tls
+        self._pool = pool if pool is not None else channelpool.shared()
+        self._replicas: dict[str, Replica] = {}
+        # Raw row value per replica id, as of the last refresh: the
+        # freshness token for _failed below (every heartbeat re-publish
+        # changes the value — registration stamps a beat counter).
+        self._raw: dict[str, str] = {}
+        self._refreshed_at = 0.0
+        # Data-path verdicts overlaid between polls: replica id -> the
+        # raw row value at the moment of failure. A later poll clears
+        # the mark only when the row's value has CHANGED (the replica
+        # heartbeat again — it is alive) or the row is gone (lease
+        # lapsed). Merely re-reading the frozen row of a freshly-killed
+        # replica proves nothing: its lease outlives it by design, and
+        # re-admitting it would point most picks at a corpse for the
+        # whole lease window.
+        self._failed: dict[str, str | None] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- refresh ----------------------------------------------------------
+
+    def refresh(self) -> None:
+        """One GetValues poll: replace the cached replica set with the
+        registry's lease-filtered view. Raises grpc.RpcError on failure
+        (after rotating the endpoint cursor, feeder-style)."""
+        address = self._endpoints.current()
+        try:
+            reply = RegistryStub(self._pool.get(
+                address, self.tls, "component.registry")).GetValues(
+                pb.GetValuesRequest(path=SERVE_PREFIX), timeout=10.0)
+        except grpc.RpcError as err:
+            self._pool.maybe_evict(err, address)
+            if self._endpoints.multiple and err.code() in FAILOVER_CODES:
+                self._endpoints.advance()
+            raise
+        fresh = {}
+        raw = {}
+        for value in reply.values:
+            replica = Replica.parse(value.path, value.value)
+            if replica is not None and replica.ready:
+                fresh[replica.replica_id] = replica
+                raw[replica.replica_id] = value.value
+        with self._lock:
+            self._replicas = fresh
+            self._raw = raw
+            self._refreshed_at = time.monotonic()
+            # Keep a failure mark only while the failed row is still
+            # byte-identical (no heartbeat since the failure) — a
+            # changed or vanished row clears it.
+            self._failed = {
+                rid: val for rid, val in self._failed.items()
+                if rid in raw and raw[rid] == val
+            }
+            count = sum(1 for rid in fresh if rid not in self._failed)
+        M.ROUTER_REPLICAS.set(count)
+
+    def _refresh_if_due(self) -> None:
+        with self._lock:
+            due = time.monotonic() - self._refreshed_at >= self.interval
+        if due:
+            try:
+                self.refresh()
+            except grpc.RpcError:
+                pass  # serve the cached view until max_stale
+
+    # -- the routing view -------------------------------------------------
+
+    def replicas(self) -> list[Replica]:
+        """The current routable set: cached rows minus data-path
+        failures, empty once the cache ages past ``max_stale``. Refreshes
+        inline when the poll thread isn't running (tests, one-shot use)
+        or has fallen behind."""
+        if self._thread is None:
+            self._refresh_if_due()
+        with self._lock:
+            if time.monotonic() - self._refreshed_at > self.max_stale:
+                return []
+            return [r for r in self._replicas.values()
+                    if r.replica_id not in self._failed]
+
+    def mark_failed(self, replica_id: str) -> None:
+        """Data-path verdict: drop ``replica_id`` from the routable set
+        until a later poll proves it alive again — "proves" meaning its
+        ROW CHANGED (a fresh heartbeat re-publish), not merely that its
+        frozen lease is still ticking."""
+        with self._lock:
+            self._failed[replica_id] = self._raw.get(replica_id)
+            count = sum(1 for r in self._replicas.values()
+                        if r.replica_id not in self._failed)
+        M.ROUTER_REPLICAS.set(count)
+
+    def __len__(self) -> int:
+        return len(self.replicas())
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        """Begin the jittered background poll."""
+        def loop() -> None:
+            log = from_context()
+            failures = 0
+            while not self._stop.is_set():
+                try:
+                    self.refresh()
+                    failures = 0
+                except grpc.RpcError as err:
+                    failures += 1
+                    log.warning(
+                        "replica table refresh failed",
+                        registry=self._endpoints.current(),
+                        error=err.code().name, attempt=failures)
+                # Jitter spreads a router fleet's polls so the registry
+                # never sees them in lockstep (same stance as the
+                # controller heartbeat loop's backoff jitter).
+                delay = self.interval * (0.5 + random.random())  # noqa: S311
+                if failures:
+                    delay = min(delay * 2 ** (failures - 1), 30.0)
+                if self._stop.wait(delay):
+                    return
+
+        self._thread = threading.Thread(
+            target=loop, name="oim-router-table", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

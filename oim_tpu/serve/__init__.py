@@ -16,6 +16,9 @@ weight-distribution system; this package puts the request path on top:
   the control plane off the data path.
 * ``service``   — the ``oim.v1.Serve`` gRPC daemon (server-streaming
   token deltas; cancel/deadline evicts the slot).
+* ``registration`` — the replica's TTL-leased ``serve/<id>`` registry
+  row: endpoint + load snapshot re-published every heartbeat, the feed
+  for the request router's table (oim_tpu/router).
 """
 
 from oim_tpu.serve.engine import (  # noqa: F401
@@ -23,6 +26,12 @@ from oim_tpu.serve.engine import (  # noqa: F401
     GenHandle,
     QueueFull,
     ServeEngine,
+)
+from oim_tpu.serve.registration import (  # noqa: F401
+    SERVE_PREFIX,
+    ServeRegistration,
+    load_snapshot,
+    serve_key,
 )
 from oim_tpu.serve.service import ServeService, serve_server  # noqa: F401
 from oim_tpu.serve.weights import (  # noqa: F401

@@ -167,7 +167,14 @@ class ServeEngine:
             sampled = jax.vmap(samp)(subs, logits, safe)
             greedy = jnp.argmax(logits, axis=-1)
             tok = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
-            return tok, cache, carry
+            # The step returns its OWN next operands (tok / pos+1 / key
+            # chain), so steady-state decode re-dispatches device arrays
+            # instead of re-uploading host mirrors (see _decode_once).
+            # pos advances for every row; idle rows' garbage positions are
+            # clamped to max_seq so they can't drift without bound (a live
+            # row retires before its position could reach the clamp, so
+            # the clamp never alters a real request's numerics).
+            return tok, cache, carry, jnp.minimum(pos + 1, max_seq)
 
         self._step = jax.jit(step, donate_argnums=(1,))
 
@@ -195,6 +202,12 @@ class ServeEngine:
         # Zero keys for idle rows (their split/sample is discarded); a
         # slot's real key chain starts at PRNGKey(seed) on admission.
         self._keys = np.zeros((max_batch, 2), np.uint32)
+        # Device-resident step operands (tokens, pos, keys, temps): the
+        # decode hot loop feeds each step the previous step's outputs and
+        # never touches the host mirrors above — per-step host work drops
+        # to ONE [B] token fetch (the emit). None = mirrors are fresher
+        # (admission wrote a row): the next step re-uploads once.
+        self._dev: tuple | None = None
         self._pending: collections.deque[_Request] = collections.deque()
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
@@ -262,6 +275,21 @@ class ServeEngine:
     def queue_len(self) -> int:
         with self._lock:
             return len(self._pending)
+
+    def stats(self) -> dict:
+        """One consistent load snapshot — what a serve replica's registry
+        heartbeat publishes and the request router routes on (free decode
+        slots first, queued backlog as the tie-break)."""
+        with self._lock:
+            active = sum(s is not None for s in self._slots)
+            return {
+                "free_slots": self.max_batch - active,
+                "active_slots": active,
+                "queue_depth": len(self._pending),
+                "queue_capacity": self.queue_depth,
+                "max_batch": self.max_batch,
+                "ready": not (self._draining or self._stopping),
+            }
 
     # -- engine loop --------------------------------------------------------
 
@@ -343,6 +371,18 @@ class ServeEngine:
             b *= 2
         return min(b, self.max_seq)
 
+    def _sync_host(self) -> None:
+        """Pull the device-resident step operands back into the host
+        mirrors (writable copies) before an admission mutates a row; the
+        next decode step re-uploads the merged state once."""
+        if self._dev is None:
+            return
+        d_tokens, d_pos, d_keys, _ = self._dev
+        self._tokens = np.array(d_tokens)
+        self._pos = np.array(d_pos)
+        self._keys = np.array(d_keys)
+        self._dev = None
+
     def _admit(self) -> None:
         """Insert queued requests into free slots (prefill between decode
         steps: new work overlaps residents' decoding at step granularity)."""
@@ -371,6 +411,7 @@ class ServeEngine:
                     self._jax.random.PRNGKey(req.seed),
                     jnp.float32(req.temperature))
                 tok = int(tok)
+            self._sync_host()  # merge device state before writing the row
             self._keys[free] = np.asarray(key)
             self._tokens[free] = tok
             self._pos[free] = n
@@ -398,16 +439,27 @@ class ServeEngine:
 
     def _decode_once(self) -> None:
         """One lockstep decode step over every resident slot; idle rows
-        compute a discarded garbage token."""
+        compute a discarded garbage token.
+
+        The hot loop is device-resident: each step's outputs (token, pos,
+        key chain) ARE the next step's operands, so steady-state decode
+        costs one jit dispatch plus one [B] token fetch — no per-step
+        host-mirror round trips (the mirrors re-sync only around
+        admissions, in _sync_host). With several engines in one process
+        (bench --replicas, replica-packed hosts) the GIL-held Python
+        slice per step is what bounds aggregate throughput, so this is
+        the difference between replicas that scale and replicas that
+        serialize."""
         jnp = self._jnp
-        tok, self._cache, keys = self._step(
-            self.params, self._cache, jnp.asarray(self._tokens),
-            jnp.asarray(self._pos), jnp.asarray(self._keys),
-            jnp.asarray(self._temps))
-        tok = np.asarray(tok)
-        # np.array, not asarray: a view of a jax array is read-only, and
-        # the next admission writes its slot's key chain in place.
-        self._keys = np.array(keys)
+        if self._dev is None:
+            self._dev = (
+                jnp.asarray(self._tokens), jnp.asarray(self._pos),
+                jnp.asarray(self._keys), jnp.asarray(self._temps))
+        d_tokens, d_pos, d_keys, d_temps = self._dev
+        tok, self._cache, keys, pos = self._step(
+            self.params, self._cache, d_tokens, d_pos, d_keys, d_temps)
+        self._dev = (tok, pos, keys, d_temps)
+        tok = np.asarray(tok)  # forces the step; the only per-step fetch
         with self._lock:
             live = [(i, r) for i, r in enumerate(self._slots) if r is not None]
         for i, req in live:
@@ -417,7 +469,5 @@ class ServeEngine:
                 self._occupancy()
                 self._finish(req, "cancelled")
                 continue
-            self._tokens[i] = tok[i]
-            self._pos[i] += 1
             self._emit(req, int(tok[i]))
             self._retire_if_done(i, req, int(tok[i]))

@@ -1,0 +1,188 @@
+"""Serve-replica registration: TTL-leased ``serve/<id>`` registry keys.
+
+The controller's lease/heartbeat machinery (controller/controller.py),
+applied to the serving tier: every ``oim-serve`` replica publishes ONE
+registry key, ``serve/<serve-id>``, whose value is a JSON load snapshot
+(endpoint + free decode slots + queue depth from ``ServeEngine.stats()``)
+written with a lease. Because the load changes every beat, the heartbeat
+IS a re-publish — each ``SetValue`` refreshes both the snapshot and the
+lease in one RPC, so there is no separate Heartbeat bookkeeping to drift
+out of sync with the advertised load. Dead replicas vanish from
+``GetValues`` exactly like dead controllers do (the router's table is
+lease-filtered); a draining replica flips ``ready: false`` one beat
+early so routers rotate away before the listener dies.
+
+The loop inherits the controller's outage posture: jittered exponential
+backoff, registry endpoint rotation on UNAVAILABLE/FAILED_PRECONDITION
+(replicated pair), pooled channels with transport-failure eviction.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+
+import grpc
+
+from oim_tpu.common import channelpool
+from oim_tpu.common.endpoints import FAILOVER_CODES, RegistryEndpoints
+from oim_tpu.common.logging import from_context
+from oim_tpu.common.tlsutil import TLSConfig
+from oim_tpu.spec import RegistryStub, pb
+
+# Top-level registry namespace for serving replicas: serve/<serve-id> ->
+# JSON load snapshot. Component-wise prefix semantics make GetValues
+# ("serve") the router's whole topology read. (The constant itself lives
+# in common/pathutil.py so the registry's authorization rules can name
+# it without importing the serving stack.)
+from oim_tpu.common.pathutil import REGISTRY_SERVE as SERVE_PREFIX
+
+
+def serve_key(serve_id: str) -> str:
+    if not serve_id or "/" in serve_id:
+        raise ValueError(f"serve id must be a single path component, "
+                         f"got {serve_id!r}")
+    return f"{SERVE_PREFIX}/{serve_id}"
+
+
+def load_snapshot(endpoint: str, engine) -> dict:
+    """The JSON value under ``serve/<id>``: routing endpoint + the
+    engine's load counters (``ServeEngine.stats()``)."""
+    snap = {"endpoint": endpoint}
+    snap.update(engine.stats())
+    return snap
+
+
+class ServeRegistration:
+    """Publish-and-renew loop for one serve replica's registry row.
+
+    ``start()`` runs the loop in a daemon thread; ``beat_once()`` is the
+    unit the loop (and tests) drive: one SetValue of the current load
+    snapshot with ``lease_seconds``. ``announce_draining()`` re-publishes
+    immediately with ``ready: false`` (called at the top of a graceful
+    drain); ``stop(deregister=True)`` deletes the key so routers drop
+    the replica without waiting out the lease.
+    """
+
+    # Same TTL posture as the controller: one lost beat must not expire
+    # a healthy replica, two-and-a-half do.
+    LEASE_FACTOR = 2.5
+    BACKOFF_MAX = 30.0
+
+    def __init__(
+        self,
+        serve_id: str,
+        endpoint: str,
+        engine,
+        registry_address: str,
+        interval: float = 10.0,
+        lease_seconds: float = 0.0,
+        tls: TLSConfig | None = None,
+        pool: channelpool.ChannelPool | None = None,
+    ):
+        self.key = serve_key(serve_id)
+        self.serve_id = serve_id
+        self.endpoint = endpoint
+        self.engine = engine
+        self._endpoints = RegistryEndpoints(registry_address)
+        self.interval = interval
+        if lease_seconds == 0.0:
+            lease_seconds = self.LEASE_FACTOR * interval
+        self.lease_seconds = max(lease_seconds, 0.0)
+        self.tls = tls
+        self._pool = pool if pool is not None else channelpool.shared()
+        # Monotonic beat counter, stamped into every snapshot: it makes
+        # each re-publish change the row's VALUE even when the load
+        # numbers repeat, which is how the router's table tells a fresh
+        # heartbeat from the frozen row of a dead replica whose lease
+        # has not lapsed yet (table.py mark_failed).
+        self._beats = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _registry_channel(self) -> grpc.Channel:
+        return self._pool.get(
+            self._endpoints.current(), self.tls, "component.registry")
+
+    def _set(self, value: str, lease_seconds: float) -> None:
+        try:
+            RegistryStub(self._registry_channel()).SetValue(
+                pb.SetValueRequest(value=pb.Value(
+                    path=self.key, value=value,
+                    lease_seconds=lease_seconds)),
+                timeout=10.0,
+            )
+        except grpc.RpcError as err:
+            self._pool.maybe_evict(err, self._endpoints.current())
+            raise
+
+    def beat_once(self, ready: bool | None = None) -> dict:
+        """One heartbeat: publish the current load snapshot with the
+        lease. ``ready`` overrides the engine's own readiness (the
+        draining announcement). Returns the published snapshot."""
+        snap = load_snapshot(self.endpoint, self.engine)
+        if ready is not None:
+            snap["ready"] = ready
+        self._beats += 1
+        snap["beat"] = self._beats
+        self._set(json.dumps(snap, sort_keys=True), self.lease_seconds)
+        return snap
+
+    def announce_draining(self) -> None:
+        """Best-effort immediate ``ready: false`` re-publish, so routers
+        rotate away from this replica BEFORE its listener dies (resident
+        streams keep draining through the still-open connections)."""
+        try:
+            self.beat_once(ready=False)
+        except grpc.RpcError as err:
+            from_context().warning(
+                "draining announcement failed", serve=self.serve_id,
+                error=err.code().name)
+
+    def start(self) -> None:
+        def loop() -> None:
+            log = from_context().with_fields(serve=self.serve_id)
+            failures = 0
+            while not self._stop.is_set():
+                try:
+                    self.beat_once()
+                    failures = 0
+                    log.debug("serve heartbeat",
+                              registry=self._endpoints.current())
+                except grpc.RpcError as err:
+                    failures += 1
+                    if (self._endpoints.multiple
+                            and err.code() in FAILOVER_CODES):
+                        target = self._endpoints.advance()
+                        log.warning("failing over to peer registry",
+                                    target=target)
+                    base = min(1.0, self.interval)
+                    delay = min(base * 2 ** (failures - 1), self.BACKOFF_MAX)
+                    delay *= 0.5 + random.random()  # noqa: S311 - jitter
+                    log.warning(
+                        "registry unreachable; backing off",
+                        error=err.details() or str(err.code()),
+                        attempt=failures, retry_s=round(delay, 3))
+                    if self._stop.wait(delay):
+                        return
+                    continue
+                if self._stop.wait(self.interval):
+                    return
+
+        self._thread = threading.Thread(
+            target=loop, name="oim-serve-registration", daemon=True)
+        self._thread.start()
+
+    def stop(self, deregister: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if deregister:
+            try:
+                # Empty value = SetValue's delete idiom: the row vanishes
+                # now instead of lingering until the lease expires.
+                self._set("", 0.0)
+            except grpc.RpcError:
+                pass  # registry down: the lease expires the row anyway

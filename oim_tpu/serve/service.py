@@ -14,7 +14,12 @@ Token deltas coalesce: each message carries every token the engine has
 produced since the previous one, so a slow consumer reads fewer, fatter
 messages instead of stalling behind one-token writes (the engine
 never blocks on the stream either way — its per-request queue absorbs
-the gap).
+the gap). ``stream_tokens`` sets the granularity floor: the FIRST token
+always flushes immediately (first-token latency is the latency SLO),
+later deltas wait for up to ``stream_tokens`` tokens before flushing —
+every message costs a full Python-gRPC send/recv on each hop (replica,
+router, client), so chunked streaming is the difference between the
+serving path scaling with replicas and eating a replica's share of CPU.
 """
 
 from __future__ import annotations
@@ -46,8 +51,11 @@ _POLL_S = 0.5
 class ServeService(ServeServicer):
     """oim.v1.Serve over a ServeEngine."""
 
-    def __init__(self, engine: ServeEngine):
+    def __init__(self, engine: ServeEngine, stream_tokens: int = 1):
         self.engine = engine
+        # Tokens per delta after the first (1 = flush every token, the
+        # lowest-latency and chattiest setting; see module docstring).
+        self.stream_tokens = max(1, stream_tokens)
 
     def Generate(self, request, context):
         with tracing.start_span(
@@ -82,6 +90,7 @@ class ServeService(ServeServicer):
     def _deltas(self, handle, context, span):
         out = handle._req.out
         done = False
+        first_sent = False
         while not done:
             try:
                 item = out.get(timeout=_POLL_S)
@@ -97,11 +106,22 @@ class ServeService(ServeServicer):
                 done = True
             else:
                 tokens.append(item)
-                # Coalesce whatever else is already queued.
+                # Coalesce whatever else is already queued — and, once
+                # the first (latency-critical) delta is out, keep
+                # WAITING until stream_tokens have accumulated or the
+                # request finishes, so a response is a few fat messages
+                # instead of one per decode step.
+                target = self.stream_tokens if first_sent else 1
                 while True:
                     try:
-                        more = out.get_nowait()
+                        more = (out.get(timeout=_POLL_S)
+                                if len(tokens) < target else
+                                out.get_nowait())
                     except queue.Empty:
+                        if len(tokens) < target:
+                            if not context.is_active():
+                                handle.cancel()  # eviction pushes _DONE
+                            continue
                         break
                     if more is _DONE:
                         done = True
@@ -115,6 +135,7 @@ class ServeService(ServeServicer):
                     tokens=tokens, done=True, finish_reason=reason)
                 return
             yield pb.GenerateDelta(tokens=tokens)
+            first_sent = True
 
 
 def serve_capabilities(engine: ServeEngine) -> list[str]:
@@ -127,11 +148,20 @@ def serve_capabilities(engine: ServeEngine) -> list[str]:
 
 
 def serve_server(
-    endpoint: str, service: ServeService, tls: TLSConfig | None = None
+    endpoint: str, service: ServeService, tls: TLSConfig | None = None,
+    max_workers: int | None = None,
 ) -> NonBlockingGRPCServer:
     """Serve the Serve + Identity services on one endpoint (the same
-    co-serving shape as every other oim daemon, oim-driver.go:199-207)."""
+    co-serving shape as every other oim daemon, oim-driver.go:199-207).
+
+    ``max_workers`` bounds CONCURRENT STREAMS, not just in-flight unary
+    calls: a streaming Generate holds its executor thread for the whole
+    response, so it defaults to enough threads for every decode slot and
+    every queued request to stream at once — admission control belongs
+    to the engine's bounded queue, not to a starved thread pool."""
     engine = service.engine
+    if max_workers is None:
+        max_workers = max(16, engine.max_batch + engine.queue_depth + 4)
     identity = IdentityService(
         "oim-serve",
         capabilities=serve_capabilities(engine),
@@ -140,7 +170,8 @@ def serve_server(
         ready_fn=lambda: not (engine._draining or engine._stopping),
     )
     server = NonBlockingGRPCServer(
-        endpoint, tls=tls, interceptors=(LogServerInterceptor(),)
+        endpoint, tls=tls, interceptors=(LogServerInterceptor(),),
+        max_workers=max_workers,
     )
 
     def register(s):

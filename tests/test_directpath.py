@@ -582,3 +582,114 @@ class TestProxyPooling:
             channel.close()
             registry.force_stop()
             controller.force_stop()
+
+
+class TestCrossControllerPrestage:
+    """The mTLS prestage exemption (registry.py TransparentProxy
+    _may_prestage): the strict ``host.<id>`` -> ``<id>`` proxy rule
+    blocks warm-standby and serve weight fan-out, both of which
+    PrestageVolume a PEER controller — so PrestageVolume (and ONLY it)
+    is open to any live mesh member: a host whose own controller is
+    registered with an unexpired lease. Driven through the proxy's
+    ``_forward`` with a fake TLS context (same cryptography-free seam as
+    TestDirectPathAuthz)."""
+
+    class _Abort(Exception):
+        def __init__(self, code, details):
+            self.code = code
+            self.details = details
+            super().__init__(f"{code.name}: {details}")
+
+    class _Ctx:
+        def __init__(self, cn):
+            self._cn = cn
+
+        def auth_context(self):
+            return {"x509_common_name": [self._cn.encode()]} if self._cn \
+                else {}
+
+        def abort(self, code, details):
+            raise TestCrossControllerPrestage._Abort(code, details)
+
+        def time_remaining(self):
+            return 30.0
+
+    @pytest.fixture
+    def mesh(self):
+        """Registry service with FAKE tls (authz enforced) + a real
+        insecure controller B the proxy can dial; host A is a live
+        lease-holding mesh member, host C is unregistered."""
+        from oim_tpu.common.tlsutil import TLSConfig
+        from oim_tpu.registry.leases import LeaseTable
+        from oim_tpu.registry.registry import TransparentProxy
+
+        now = [1000.0]
+        db = MemRegistryDB()
+        service = RegistryService(
+            db=db, tls=TLSConfig(ca_pem=b"x", key_pem=b"x", cert_pem=b"x"),
+            leases=LeaseTable(clock=lambda: now[0]))
+        controller = controller_server(
+            "tcp://localhost:0", ControllerService(MallocBackend()))
+        db.set("B/address", controller.addr)
+        db.set("A/address", "somewhere:1")
+        service.leases.grant("A/address", 30.0)
+        proxy = TransparentProxy(
+            service, dial=lambda addr, peer: grpc.insecure_channel(addr))
+        try:
+            yield proxy, now
+        finally:
+            proxy.close()
+            controller.force_stop()
+
+    PRESTAGE = "/oim.v1.Controller/PrestageVolume"
+    READ = "/oim.v1.Controller/ReadVolume"
+
+    def _call(self, proxy, method, cn, target="B"):
+        request = pb.MapVolumeRequest(volume_id="warm").SerializeToString()
+        return list(proxy._forward(
+            method, (("controllerid", target),), iter([request]),
+            self._Ctx(cn)))
+
+    def test_live_host_may_prestage_foreign_controller(self, mesh):
+        proxy, _ = mesh
+        # host.A reaches controller B THROUGH the authz gate: the abort
+        # seen is the controller's own INVALID_ARGUMENT for the empty
+        # volume params, not the proxy's PERMISSION_DENIED.
+        with pytest.raises(self._Abort) as err:
+            self._call(proxy, self.PRESTAGE, "host.A")
+        assert err.value.code is grpc.StatusCode.INVALID_ARGUMENT
+        assert "no volume params" in err.value.details
+
+    def test_only_the_prestage_rpc_is_exempt(self, mesh):
+        proxy, _ = mesh
+        with pytest.raises(self._Abort) as err:
+            self._call(proxy, self.READ, "host.A")
+        assert err.value.code is grpc.StatusCode.PERMISSION_DENIED
+
+    def test_unregistered_host_stays_locked_out(self, mesh):
+        proxy, _ = mesh
+        with pytest.raises(self._Abort) as err:
+            self._call(proxy, self.PRESTAGE, "host.C")
+        assert err.value.code is grpc.StatusCode.PERMISSION_DENIED
+
+    def test_expired_lease_revokes_the_exemption(self, mesh):
+        proxy, now = mesh
+        now[0] += 31.0  # host A's own lease lapses: not a live member
+        with pytest.raises(self._Abort) as err:
+            self._call(proxy, self.PRESTAGE, "host.A")
+        assert err.value.code is grpc.StatusCode.PERMISSION_DENIED
+
+    def test_non_host_identities_not_exempt(self, mesh):
+        proxy, _ = mesh
+        for cn in ("component.feeder", "controller.A", None):
+            with pytest.raises(self._Abort) as err:
+                self._call(proxy, self.PRESTAGE, cn)
+            assert err.value.code is grpc.StatusCode.PERMISSION_DENIED, cn
+
+    def test_own_host_rule_untouched(self, mesh):
+        proxy, _ = mesh
+        # host.B keeps full access to its own controller (ReadVolume
+        # reaches the volume lookup -> NOT_FOUND, not PERMISSION_DENIED).
+        with pytest.raises(self._Abort) as err:
+            self._call(proxy, self.READ, "host.B")
+        assert err.value.code is grpc.StatusCode.NOT_FOUND

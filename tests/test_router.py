@@ -1,0 +1,693 @@
+"""Ring-1 tests for the request-router tier (oim_tpu/router +
+serve/registration).
+
+What the tier must hold: replica registration is a TTL-leased
+``serve/<id>`` row whose heartbeat IS a load-snapshot re-publish (dead
+replicas vanish like dead controllers); the routing table is a
+lease-filtered cached view that keeps serving through registry blips
+and overlays data-path verdicts; the pick is least-loaded with a
+power-of-two tie-break over the router's own in-flight counts; the
+retry contract moves a stream to the NEXT replica only before the first
+token frame (a sampled stream is never silently replayed); and the
+failover acceptance — kill one of two replicas mid-load — completes
+every new request on the survivor with zero client-visible errors.
+"""
+
+import json
+import threading
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+import jax
+
+from oim_tpu.common import metrics as M
+from oim_tpu.common.channelpool import ChannelPool
+from oim_tpu.models import generate as gen, llama
+from oim_tpu.registry.db import MemRegistryDB
+from oim_tpu.registry.registry import RegistryService, registry_server
+from oim_tpu.router import Replica, ReplicaTable, RouterService, router_server
+from oim_tpu.serve import (
+    ServeEngine,
+    ServeRegistration,
+    ServeService,
+    serve_key,
+)
+from oim_tpu.serve.service import serve_server
+from oim_tpu.spec import (
+    IdentityStub,
+    RegistryStub,
+    ServeServicer,
+    ServeStub,
+    add_serve_to_server,
+    pb,
+)
+from oim_tpu.common import tlsutil
+from oim_tpu.common.server import NonBlockingGRPCServer
+
+
+def wait_for(predicate, timeout=15.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama.tiny(vocab=64, dim=32, n_layers=2)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def solo_tokens(params, cfg, prompt, n_new, temperature=0.0, seed=0,
+                max_seq=64):
+    out = gen.generate(
+        params, np.asarray([prompt], np.int32), n_new, cfg,
+        temperature=temperature, rng=jax.random.PRNGKey(seed),
+        max_seq=max_seq)
+    return out[0, len(prompt):].tolist()
+
+
+@pytest.fixture
+def registry():
+    server = registry_server(
+        "tcp://localhost:0", RegistryService(db=MemRegistryDB()))
+    channel = tlsutil.dial(server.addr, None)
+    yield server, RegistryStub(channel)
+    channel.close()
+    server.force_stop()
+
+
+class FakeEngine:
+    """stats() provider for registration tests — no jax, no slots."""
+
+    def __init__(self, free_slots=3, queue_depth=1, ready=True):
+        self._stats = dict(free_slots=free_slots, active_slots=0,
+                           queue_depth=queue_depth, queue_capacity=8,
+                           max_batch=4, ready=ready)
+
+    def stats(self):
+        return dict(self._stats)
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaParse:
+    def test_good_row(self):
+        row = json.dumps({"endpoint": "h:1", "free_slots": 2,
+                          "queue_depth": 5, "max_batch": 8, "ready": True})
+        rep = Replica.parse("serve/r0", row)
+        assert rep == Replica("r0", "h:1", free_slots=2, queue_depth=5,
+                              max_batch=8, ready=True)
+
+    def test_unroutable_rows_are_none_not_crashes(self):
+        assert Replica.parse("serve/r0", "{not json") is None
+        assert Replica.parse("serve/r0", json.dumps({"free_slots": 1})) is None
+        assert Replica.parse("serve/r0/extra",
+                             json.dumps({"endpoint": "h:1"})) is None
+        assert Replica.parse("serve/r0", json.dumps(["endpoint"])) is None
+        # Non-numeric load fields must not escape parse either: a crash
+        # here would kill the table's poll thread, not just one row.
+        assert Replica.parse("serve/r0", json.dumps(
+            {"endpoint": "h:1", "free_slots": "n/a"})) is None
+        assert Replica.parse("serve/r0", json.dumps(
+            {"endpoint": "h:1", "queue_depth": [3]})) is None
+
+    def test_ready_defaults_true(self):
+        rep = Replica.parse("serve/r0", json.dumps({"endpoint": "h:1"}))
+        assert rep.ready is True
+
+
+class TestServeRegistration:
+    def test_beat_publishes_leased_load_row(self, registry):
+        _, stub = registry
+        reg = ServeRegistration(
+            "r0", "host:9002", FakeEngine(), registry[0].addr,
+            interval=0.2, lease_seconds=0.5)
+        snap = reg.beat_once()
+        assert snap["endpoint"] == "host:9002"
+        assert snap["free_slots"] == 3
+        live = stub.GetValues(pb.GetValuesRequest(path="serve"), timeout=5)
+        assert [v.path for v in live.values] == ["serve/r0"]
+        parsed = Replica.parse(live.values[0].path, live.values[0].value)
+        assert parsed.endpoint == "host:9002"
+        assert parsed.queue_depth == 1
+        # The lease expires the row exactly like a dead controller's.
+        time.sleep(0.7)
+        assert not stub.GetValues(
+            pb.GetValuesRequest(path="serve"), timeout=5).values
+        stale = stub.GetValues(
+            pb.GetValuesRequest(path="serve", include_stale=True), timeout=5)
+        assert [v.path for v in stale.values] == ["serve/r0"]
+
+    def test_heartbeat_refreshes_load_snapshot(self, registry):
+        _, stub = registry
+        engine = FakeEngine(free_slots=4)
+        reg = ServeRegistration("r0", "h:1", engine, registry[0].addr,
+                                interval=0.2)
+        reg.beat_once()
+        engine._stats["free_slots"] = 0  # load changed between beats
+        reg.beat_once()
+        row = stub.GetValues(
+            pb.GetValuesRequest(path="serve"), timeout=5).values[0]
+        assert Replica.parse(row.path, row.value).free_slots == 0
+
+    def test_announce_draining_flips_ready(self, registry):
+        _, stub = registry
+        reg = ServeRegistration("r0", "h:1", FakeEngine(), registry[0].addr)
+        reg.beat_once()
+        reg.announce_draining()
+        row = stub.GetValues(
+            pb.GetValuesRequest(path="serve"), timeout=5).values[0]
+        assert Replica.parse(row.path, row.value).ready is False
+
+    def test_stop_deregisters_immediately(self, registry):
+        _, stub = registry
+        reg = ServeRegistration("r0", "h:1", FakeEngine(), registry[0].addr)
+        reg.beat_once()
+        reg.stop(deregister=True)
+        assert not stub.GetValues(
+            pb.GetValuesRequest(path="serve", include_stale=True),
+            timeout=5).values
+
+    def test_loop_beats_on_interval(self, registry):
+        _, stub = registry
+        reg = ServeRegistration("r0", "h:1", FakeEngine(), registry[0].addr,
+                                interval=0.1, lease_seconds=0.3)
+        reg.start()
+        try:
+            assert wait_for(lambda: stub.GetValues(
+                pb.GetValuesRequest(path="serve"), timeout=5).values)
+            # Outlives several lease windows only because the loop renews.
+            time.sleep(0.8)
+            assert stub.GetValues(
+                pb.GetValuesRequest(path="serve"), timeout=5).values
+        finally:
+            reg.stop(deregister=False)
+
+    def test_serve_id_must_be_single_component(self):
+        with pytest.raises(ValueError):
+            serve_key("a/b")
+        with pytest.raises(ValueError):
+            serve_key("")
+        assert serve_key("r0") == "serve/r0"
+
+
+class TestRegistryServeAuthz:
+    """The mTLS write rule for serve/<id> rows and the reserved
+    namespace (registry.py _may_set / Heartbeat)."""
+
+    def test_host_may_set_own_serve_row_only(self):
+        may = RegistryService._may_set
+        assert may("host.h0", ["serve", "h0"])
+        assert may("host.h0", ["serve", "h0.1"])  # replica-per-host suffix
+        assert not may("host.h0", ["serve", "h1"])
+        assert not may("host.h0", ["serve", "h1.0"])
+        assert not may("host.h0", ["serve"])
+        assert not may("component.feeder", ["serve", "h0"])
+        # The controller path rule is untouched.
+        assert may("controller.h0", ["h0", "address"])
+        assert not may("controller.h0", ["h1", "address"])
+
+    def test_serve_is_not_a_controller_id(self):
+        # A controller named "serve" could write serve/address and its
+        # Heartbeat would prefix-renew EVERY replica lease.
+        may = RegistryService._may_set
+        assert not may("controller.serve", ["serve", "address"])
+
+    def test_heartbeat_rejects_reserved_namespace(self, registry):
+        _, stub = registry
+        with pytest.raises(grpc.RpcError) as err:
+            stub.Heartbeat(pb.HeartbeatRequest(controller_id="serve"),
+                           timeout=5)
+        assert err.value.code() is grpc.StatusCode.INVALID_ARGUMENT
+
+
+class TestReplicaTable:
+    def _set(self, stub, rid, lease=30.0, **snap):
+        snap.setdefault("endpoint", f"host:{rid}")
+        stub.SetValue(pb.SetValueRequest(value=pb.Value(
+            path=f"serve/{rid}", value=json.dumps(snap),
+            lease_seconds=lease)), timeout=5)
+
+    def test_refresh_is_lease_filtered_and_ready_filtered(self, registry):
+        server, stub = registry
+        self._set(stub, "a", free_slots=2)
+        self._set(stub, "b", ready=False)          # draining: not routable
+        self._set(stub, "c", lease=0.3)            # dies shortly
+        table = ReplicaTable(server.addr, interval=0.1, pool=ChannelPool())
+        table.refresh()
+        assert sorted(r.replica_id for r in table.replicas()) == ["a", "c"]
+        time.sleep(0.5)
+        table.refresh()
+        assert [r.replica_id for r in table.replicas()] == ["a"]
+
+    def test_mark_failed_until_fresh_heartbeat(self, registry):
+        server, stub = registry
+        self._set(stub, "a", beat=1)
+        self._set(stub, "b")
+        table = ReplicaTable(server.addr, interval=30.0, pool=ChannelPool())
+        table.refresh()
+        table.mark_failed("a")
+        assert [r.replica_id for r in table.replicas()] == ["b"]
+        # Re-reading the FROZEN row proves nothing (a freshly-killed
+        # replica's lease outlives it): the mark survives the poll.
+        table.refresh()
+        assert [r.replica_id for r in table.replicas()] == ["b"]
+        # A fresh heartbeat changes the row's value -> re-admitted.
+        self._set(stub, "a", beat=2)
+        table.refresh()
+        assert len(table.replicas()) == 2
+
+    def test_registry_outage_serves_cached_until_max_stale(self, registry):
+        server, stub = registry
+        self._set(stub, "a")
+        pool = ChannelPool()
+        table = ReplicaTable(server.addr, interval=30.0, max_stale=0.5,
+                             pool=pool)
+        table.refresh()
+        server.force_stop()  # registry gone
+        with pytest.raises(grpc.RpcError):
+            table.refresh()
+        # The last good snapshot keeps routing through the blip...
+        assert [r.replica_id for r in table.replicas()] == ["a"]
+        time.sleep(0.6)
+        # ...but not past max_stale: better to refuse than to route on
+        # a view whose replicas may all be gone.
+        assert table.replicas() == []
+        pool.close()
+
+    def test_background_poll_picks_up_new_replicas(self, registry):
+        server, stub = registry
+        table = ReplicaTable(server.addr, interval=0.05, pool=ChannelPool())
+        table.start()
+        try:
+            assert len(table) == 0
+            self._set(stub, "late")
+            assert wait_for(lambda: len(table) == 1, timeout=5)
+        finally:
+            table.stop()
+
+
+class _FixedTable:
+    """A routing view pinned by the test: no registry, no polling."""
+
+    def __init__(self, replicas):
+        self._replicas = list(replicas)
+        self.failed = []
+
+    def replicas(self):
+        return [r for r in self._replicas if r.replica_id not in self.failed]
+
+    def mark_failed(self, rid):
+        self.failed.append(rid)
+
+    def __len__(self):
+        return len(self.replicas())
+
+
+class TestPick:
+    def test_least_loaded_wins(self):
+        service = RouterService(_FixedTable([
+            Replica("busy", "h:1", free_slots=0, queue_depth=6),
+            Replica("idle", "h:2", free_slots=4, queue_depth=0),
+        ]))
+        assert service.pick().replica_id == "idle"
+
+    def test_router_inflight_overlays_stale_snapshot(self):
+        # Identical advertised load; the router's own live streams break
+        # the tie the snapshot cannot see.
+        service = RouterService(_FixedTable([
+            Replica("a", "h:1", free_slots=4),
+            Replica("b", "h:2", free_slots=4),
+        ]))
+        with service._lock:
+            service._inflight["a"] = 3
+        assert service.pick().replica_id == "b"
+
+    def test_exclude_and_empty(self):
+        service = RouterService(_FixedTable([Replica("a", "h:1")]))
+        assert service.pick(exclude={"a"}) is None
+        assert RouterService(_FixedTable([])).pick() is None
+
+    def test_tie_break_spreads(self):
+        service = RouterService(_FixedTable([
+            Replica(f"r{i}", f"h:{i}", free_slots=4) for i in range(4)
+        ]))
+        picked = {service.pick().replica_id for _ in range(200)}
+        assert len(picked) >= 3  # power-of-two over ties must not herd
+
+
+# ---------------------------------------------------------------------------
+# Retry contract, against scripted fake upstreams (no engines: the
+# contract is about stream lifecycles, not tokens).
+
+
+class _ScriptedServe(ServeServicer):
+    def __init__(self, script):
+        # script(request, context) -> iterator of GenerateDelta
+        self.script = script
+        self.calls = 0
+
+    def Generate(self, request, context):
+        self.calls += 1
+        yield from self.script(request, context)
+
+
+def _fake_replica(script):
+    service = _ScriptedServe(script)
+    server = NonBlockingGRPCServer("tcp://127.0.0.1:0")
+    server.start(lambda s: add_serve_to_server(service, s))
+    return server, service
+
+
+def _tokens_script(tokens):
+    def script(request, context):
+        for t in tokens[:-1]:
+            yield pb.GenerateDelta(tokens=[t])
+        yield pb.GenerateDelta(tokens=[tokens[-1]], done=True,
+                               finish_reason="length")
+    return script
+
+
+@pytest.fixture
+def fake_pair():
+    """Two scripted replicas behind a router over a fixed table."""
+    servers, services = [], []
+
+    def build(scripts):
+        replicas = []
+        for i, script in enumerate(scripts):
+            server, service = _fake_replica(script)
+            servers.append(server)
+            services.append(service)
+            replicas.append(Replica(f"f{i}", server.addr, free_slots=4))
+        table = _FixedTable(replicas)
+        pool = ChannelPool()
+        router_srv = router_server(
+            "tcp://127.0.0.1:0", RouterService(table, pool=pool))
+        servers.append(router_srv)
+        channel = tlsutil.dial(router_srv.addr, None)
+        servers_channels.append(channel)
+        return table, ServeStub(channel), services
+
+    servers_channels = []
+    yield build
+    for channel in servers_channels:
+        channel.close()
+    for server in servers:
+        server.force_stop()
+
+
+class TestRetryContract:
+    def test_resource_exhausted_retries_next_replica(self, fake_pair):
+        def full(request, context):
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, "queue full")
+            yield  # pragma: no cover
+
+        retries_before = M.ROUTER_RETRIES_TOTAL.value
+        table, stub, services = fake_pair([full, _tokens_script([7, 8])])
+        # Force the full replica to be tried first: strictly best score
+        # (free_slots 8 vs 4), so the po2 tie-break never skips it.
+        table._replicas[0] = Replica(
+            "f0", table._replicas[0].endpoint, free_slots=8)
+        got = []
+        for _ in range(4):  # whichever pick order: every request lands
+            got.append([t for d in stub.Generate(
+                pb.GenerateRequest(prompt=[1], max_new_tokens=2),
+                timeout=10) for t in d.tokens])
+        assert all(g == [7, 8] for g in got)
+        assert services[1].calls >= 4
+        assert M.ROUTER_RETRIES_TOTAL.value > retries_before
+
+    def test_unavailable_evicts_from_table(self, fake_pair):
+        table, stub, services = fake_pair([_tokens_script([5])])
+        # A second "replica" at a dead endpoint, most attractive score.
+        dead = NonBlockingGRPCServer("tcp://127.0.0.1:0")
+        dead.start(lambda s: None)
+        addr = dead.addr
+        dead.force_stop()
+        table._replicas.append(Replica("dead", addr, free_slots=64))
+        for _ in range(3):
+            toks = [t for d in stub.Generate(
+                pb.GenerateRequest(prompt=[1], max_new_tokens=1),
+                timeout=10) for t in d.tokens]
+            assert toks == [5]
+        assert "dead" in table.failed
+
+    def test_midstream_failure_surfaces_not_replayed(self, fake_pair):
+        def breaks_midstream(request, context):
+            yield pb.GenerateDelta(tokens=[1])
+            context.abort(grpc.StatusCode.INTERNAL, "decoder fell over")
+
+        table, stub, services = fake_pair(
+            [breaks_midstream, breaks_midstream])
+        with pytest.raises(grpc.RpcError) as err:
+            list(stub.Generate(
+                pb.GenerateRequest(prompt=[1], max_new_tokens=4),
+                timeout=10))
+        # Surfaced unchanged; the OTHER replica was never asked to
+        # silently re-sample the stream.
+        assert err.value.code() is grpc.StatusCode.INTERNAL
+        assert services[0].calls + services[1].calls == 1
+
+    def test_all_replicas_exhausted_surfaces_last_error(self, fake_pair):
+        def full(request, context):
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, "queue full")
+            yield  # pragma: no cover
+
+        # Retry budget spent on the second replica: its REAL error
+        # surfaces verbatim (the client sees the backpressure signal).
+        table, stub, services = fake_pair([full, full])
+        with pytest.raises(grpc.RpcError) as err:
+            list(stub.Generate(
+                pb.GenerateRequest(prompt=[1], max_new_tokens=1),
+                timeout=10))
+        assert err.value.code() is grpc.StatusCode.RESOURCE_EXHAUSTED
+        assert "queue full" in err.value.details()
+
+    def test_single_full_replica_reports_all_failed(self, fake_pair):
+        def full(request, context):
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, "queue full")
+            yield  # pragma: no cover
+
+        # One replica, one retryable failure: the retry has nowhere to
+        # go, so the abort names the exhausted rotation.
+        table, stub, services = fake_pair([full])
+        with pytest.raises(grpc.RpcError) as err:
+            list(stub.Generate(
+                pb.GenerateRequest(prompt=[1], max_new_tokens=1),
+                timeout=10))
+        assert err.value.code() is grpc.StatusCode.RESOURCE_EXHAUSTED
+        assert "all replicas failed" in err.value.details()
+
+    def test_empty_table_unavailable(self, fake_pair):
+        table, stub, _ = fake_pair([_tokens_script([1])])
+        table._replicas.clear()
+        with pytest.raises(grpc.RpcError) as err:
+            list(stub.Generate(
+                pb.GenerateRequest(prompt=[1], max_new_tokens=1),
+                timeout=10))
+        assert err.value.code() is grpc.StatusCode.UNAVAILABLE
+        assert "no ready serve replicas" in err.value.details()
+
+    def test_client_cancel_reaches_upstream(self, fake_pair):
+        upstream_cancelled = threading.Event()
+
+        def hangs(request, context):
+            yield pb.GenerateDelta(tokens=[1])
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if not context.is_active():
+                    upstream_cancelled.set()
+                    return
+                time.sleep(0.02)
+
+        table, stub, _ = fake_pair([hangs])
+        call = stub.Generate(
+            pb.GenerateRequest(prompt=[1], max_new_tokens=4), timeout=30)
+        next(call)  # first token is flowing
+        call.cancel()
+        assert upstream_cancelled.wait(5), \
+            "client cancel never propagated to the replica's stream"
+
+    def test_router_identity_ready_tracks_table(self):
+        table = _FixedTable([])
+        pool = ChannelPool()
+        router_srv = router_server(
+            "tcp://127.0.0.1:0", RouterService(table, pool=pool))
+        channel = tlsutil.dial(router_srv.addr, None)
+        try:
+            identity = IdentityStub(channel)
+            assert identity.Probe(
+                pb.ProbeRequest(), timeout=5).ready is False
+            table._replicas.append(Replica("a", "h:1"))
+            assert identity.Probe(
+                pb.ProbeRequest(), timeout=5).ready is True
+            info = identity.GetInfo(pb.GetInfoRequest(), timeout=5)
+            assert info.name == "oim-router"
+            assert "role:router" in info.capabilities
+        finally:
+            channel.close()
+            router_srv.force_stop()
+
+
+# ---------------------------------------------------------------------------
+# Failover acceptance: real engines, real registrations, kill mid-load.
+
+
+@pytest.fixture
+def live_cluster(model):
+    """Two real serve replicas (tiny engines) registered in a real
+    registry behind a router; yields mutable handles for kill tests."""
+    params, cfg = model
+    pool = ChannelPool()
+    reg_srv = registry_server(
+        "tcp://localhost:0", RegistryService(db=MemRegistryDB()))
+    replicas = []
+    for i in range(2):
+        engine = ServeEngine(params, cfg, max_batch=2, max_seq=64,
+                             queue_depth=64)
+        server = serve_server("tcp://127.0.0.1:0", ServeService(engine))
+        registration = ServeRegistration(
+            # interval 0.5 -> lease 1.25s: long enough that a killed
+            # replica's row provably OUTLIVES the kill sequence (the
+            # failover test needs the router to actually try the dead
+            # endpoint), short enough to expire within the test.
+            f"r{i}", server.addr, engine, reg_srv.addr, interval=0.5,
+            pool=pool)
+        registration.beat_once()
+        registration.start()
+        replicas.append(dict(engine=engine, server=server,
+                             registration=registration))
+    table = ReplicaTable(reg_srv.addr, interval=0.1, pool=pool)
+    table.refresh()
+    assert len(table) == 2
+    table.start()
+    router_srv = router_server(
+        "tcp://127.0.0.1:0", RouterService(table, pool=pool))
+    channel = tlsutil.dial(router_srv.addr, None)
+    yield dict(replicas=replicas, table=table, router=router_srv,
+               stub=ServeStub(channel), params=params, cfg=cfg)
+    channel.close()
+    router_srv.force_stop()
+    table.stop()
+    for rep in replicas:
+        rep["registration"].stop(deregister=False)
+        rep["server"].force_stop()
+        rep["engine"].stop(drain=False, timeout=30)
+    reg_srv.force_stop()
+    pool.close()
+
+
+class TestRouterFailover:
+    def _run(self, stub, reqs, timeout=60):
+        results = [None] * len(reqs)
+        errors = []
+
+        def worker(i):
+            prompt, n_new, temp, seed = reqs[i]
+            try:
+                toks = []
+                for delta in stub.Generate(
+                        pb.GenerateRequest(
+                            prompt=prompt, max_new_tokens=n_new,
+                            temperature=temp, seed=seed),
+                        timeout=timeout):
+                    toks.extend(delta.tokens)
+                results[i] = toks
+            except Exception as err:  # noqa: BLE001
+                errors.append(err)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(len(reqs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout)
+        return results, errors
+
+    def test_kill_one_of_two_mid_load_survivor_takes_all(self, live_cluster):
+        """SIGKILL semantics: the dead replica's row outlives it until
+        the lease expires, so the router keeps picking it — every such
+        pick must fail over to the survivor BEFORE the first token, with
+        zero client-visible errors."""
+        cluster = live_cluster
+        params, cfg = cluster["params"], cluster["cfg"]
+        reqs = [([1 + i, 2, 3], 6, 0.0 if i % 2 == 0 else 0.9, i)
+                for i in range(8)]
+        # Warm both engines + the routed path.
+        results, errors = self._run(cluster["stub"], reqs[:2])
+        assert not errors
+
+        victim = cluster["replicas"][1]
+        victim["registration"].stop(deregister=False)  # crash: no dereg
+        victim["server"].force_stop()
+        victim["engine"].stop(drain=False, timeout=30)
+
+        retries_before = M.ROUTER_RETRIES_TOTAL.value
+        results, errors = self._run(cluster["stub"], reqs)
+        assert not errors, f"client saw errors across failover: {errors[0]!r}"
+        for (prompt, n_new, temp, seed), toks in zip(reqs, results):
+            assert toks == solo_tokens(params, cfg, prompt, n_new,
+                                       temperature=temp, seed=seed)
+        # The dead replica was actually tried and rotated away from (its
+        # lease had not expired when the load started).
+        assert M.ROUTER_RETRIES_TOTAL.value > retries_before
+        assert wait_for(
+            lambda: all(r.replica_id != "r1"
+                        for r in cluster["table"].replicas()), timeout=5)
+
+    def test_draining_replica_rotates_out_without_dropping_residents(
+            self, live_cluster):
+        """SIGTERM semantics: ready=false re-publish rotates routers
+        away; a resident stream on the draining replica finishes."""
+        cluster = live_cluster
+        params, cfg = cluster["params"], cluster["cfg"]
+        # A long resident stream, deliberately on r1 (drain target):
+        # mark r0 failed for one pick so the stream lands on r1.
+        cluster["table"].mark_failed("r0")
+        long_req = ([9, 8, 7], 40, 0.0, 123)
+        stream = cluster["stub"].Generate(
+            pb.GenerateRequest(prompt=long_req[0],
+                               max_new_tokens=long_req[1],
+                               temperature=long_req[2], seed=long_req[3]),
+            timeout=120)
+        first = next(stream)  # resident on r1 now
+        assert first.tokens
+
+        # Drain announcement: ready=false beat, exactly what oim-serve
+        # does on SIGTERM before stopping the engine.
+        victim = cluster["replicas"][1]
+        victim["registration"].announce_draining()
+        assert wait_for(
+            lambda: all(r.replica_id != "r1"
+                        for r in cluster["table"].replicas()), timeout=5)
+        # r0's next heartbeat (a CHANGED row) clears its failure mark.
+        assert wait_for(
+            lambda: any(r.replica_id == "r0"
+                        for r in cluster["table"].replicas()), timeout=5)
+
+        # New requests route to r0 only (the draining row is filtered).
+        reqs = [([i + 1, 5], 4, 0.0, i) for i in range(4)]
+        results, errors = self._run(cluster["stub"], reqs)
+        assert not errors
+        active_before = cluster["replicas"][1]["engine"].stats()
+        for (prompt, n_new, temp, seed), toks in zip(reqs, results):
+            assert toks == solo_tokens(params, cfg, prompt, n_new,
+                                       temperature=temp, seed=seed)
+
+        # The resident stream was NOT dropped by the drain announcement.
+        toks = list(first.tokens)
+        for delta in stream:
+            toks.extend(delta.tokens)
+        assert toks == solo_tokens(params, cfg, long_req[0], long_req[1],
+                                   temperature=long_req[2],
+                                   seed=long_req[3])
+        assert active_before["ready"] is True  # engine itself still up

@@ -1,0 +1,22 @@
+"""Tier-1 wiring of `make router-smoke`: an in-process registry + 2
+serve replicas + oim-router, with EVERY routed output asserted
+byte-identical to its solo generate() run by bench.router_smoke()
+itself, and at least one request served by each replica (the
+least-loaded pick must actually spread, not herd)."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def test_router_smoke_spread_and_byte_identity():
+    import bench
+
+    extras = bench.router_smoke(2)  # raises AssertionError on divergence
+    assert extras["router_byte_identity"] is True
+    assert extras["serve_completed"] == extras["serve_requests"]
+    assert extras["router_replicas"] == 2
+    assert all(count >= 1
+               for count in extras["router_served_per_replica"].values())
+    assert extras["serve_qps"] > 0
