@@ -9,7 +9,7 @@
 PY ?= python
 RUFF := $(shell command -v ruff 2>/dev/null)
 
-.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke
+.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke
 
 # drift and tsan are standalone conveniences; the full pytest target
 # already runs both (SpecDrift + the TSAN stream test build in-fixture).
@@ -66,6 +66,15 @@ serve-smoke:
 # Also runs in tier-1 as tests/test_router_smoke.py.
 router-smoke:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --serve --smoke --replicas 2
+
+# Observability-plane acceptance loop (seconds): in-process registry +
+# 2 serve replicas + router; one trace_id traced from a /metrics
+# OpenMetrics exemplar through /debug/spans to the router_retry event it
+# caused in /debug/events, `oimctl --top` rendered for every TTL-leased
+# telemetry/<id> row, and the tracing+events overhead recorded as
+# obs_overhead_ratio. Also runs in tier-1 as tests/test_obs_smoke.py.
+obs-smoke:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --obs-smoke
 
 demo:
 	bash scripts/demo_cluster.sh demo

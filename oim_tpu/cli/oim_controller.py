@@ -11,6 +11,7 @@ from oim_tpu.cli.common import (
     load_tls_flags,
     setup_logging,
     start_observability,
+    start_telemetry_row,
 )
 from oim_tpu.common.meshcoord import MeshCoord
 from oim_tpu.controller import Controller, MallocBackend, TPUBackend, controller_server
@@ -121,6 +122,9 @@ def main(argv: list[str] | None = None) -> int:
     )
     server = controller_server(args.endpoint, controller.service, tls=tls)
     controller.start()
+    start_telemetry_row(
+        obs, args.telemetry_id or args.controller_id, "controller",
+        args.registry, tls=tls)
     try:
         server.wait()
     except KeyboardInterrupt:

@@ -22,6 +22,7 @@ from oim_tpu.cli.common import (
     load_tls_flags,
     setup_logging,
     start_observability,
+    start_telemetry_row,
 )
 from oim_tpu.common.logging import from_context
 from oim_tpu.feeder import Feeder, FeederDaemon, feeder_server
@@ -110,6 +111,12 @@ def main(argv: list[str] | None = None) -> int:
 
     daemon = FeederDaemon(feeder, default_timeout=args.publish_timeout)
     server = feeder_server(args.endpoint, daemon, tls=load_tls_flags(args))
+    if remote:
+        # Remote mode dials the registry as host.<controller-id>, so the
+        # dot-suffixed variant of that id is the authorized row name.
+        start_telemetry_row(
+            obs, args.telemetry_id or f"{args.controller_id}.feeder",
+            "feeder", args.registry, tls=load_tls_flags(args))
     log.info(
         "oim-feeder serving", endpoint=args.endpoint, addr=server.addr,
         mode="local" if local else "remote",

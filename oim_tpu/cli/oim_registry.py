@@ -10,6 +10,7 @@ serves ``GET /healthz`` for k8s liveness/readiness probes.
 from __future__ import annotations
 
 import argparse
+import threading
 
 from oim_tpu.cli.common import (
     add_common_flags,
@@ -22,6 +23,39 @@ from oim_tpu.registry import MemRegistryDB, RegistryService
 from oim_tpu.registry.db import FileRegistryDB
 from oim_tpu.registry.registry import registry_server
 from oim_tpu.registry.replication import HealthzServer, ReplicationManager
+
+
+def _local_telemetry_row(service, manager, telemetry_id: str,
+                         metrics_endpoint: str, interval: float = 10.0):
+    """The registry's own ``telemetry/<id>`` row, written straight into
+    its DB+lease table (same write-lock discipline as SetValue, journaled
+    to the standby when replicated) — the one daemon that must not dial
+    itself to self-describe, and a standby must not write at all (its
+    rows arrive over the replication stream). Returns a stop callable."""
+    from oim_tpu.common.telemetry import telemetry_key, telemetry_snapshot
+
+    key = telemetry_key(telemetry_id)
+    lease = 2.5 * interval
+    stop = threading.Event()
+
+    def loop():
+        beats = 0
+        while True:
+            if manager is None or manager.is_primary:
+                beats += 1
+                value = telemetry_snapshot("registry", metrics_endpoint,
+                                           beat=beats)
+                with service._write_lock:
+                    service.db.set(key, value)
+                    service.leases.grant(key, lease)
+                    if service.replication is not None:
+                        service.replication.record_kv(key, value, lease)
+            if stop.wait(interval):
+                return
+
+    threading.Thread(target=loop, name="oim-registry-telemetry",
+                     daemon=True).start()
+    return stop.set
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -94,6 +128,11 @@ def main(argv: list[str] | None = None) -> int:
         )
     server = registry_server(args.endpoint, service)
     healthz = None
+    stop_telemetry = None
+    if obs.server is not None and args.telemetry_id != "none":
+        stop_telemetry = _local_telemetry_row(
+            service, manager, args.telemetry_id or "registry",
+            f"{obs.server.host}:{obs.server.port}")
     try:
         if manager is not None:
             # After the gRPC server is up so the peer's boot probe can
@@ -113,6 +152,8 @@ def main(argv: list[str] | None = None) -> int:
         # stop the server on EVERY exit path so the traceback actually
         # terminates the daemon.
         server.stop()
+        if stop_telemetry is not None:
+            stop_telemetry()
         if healthz is not None:
             healthz.stop()
         if manager is not None:

@@ -26,6 +26,7 @@ from oim_tpu.cli.common import (
     load_tls_flags,
     setup_logging,
     start_observability,
+    start_telemetry_row,
 )
 from oim_tpu.common.logging import from_context
 from oim_tpu.router import ReplicaTable, RouterService, router_server
@@ -67,6 +68,10 @@ def main(argv: list[str] | None = None) -> int:
     table.start()
     server = router_server(args.endpoint, RouterService(table, tls=tls),
                            tls=tls)
+    # "router" works insecure; under mTLS pass --telemetry-id matching
+    # the dialing identity's own id (registry authz binds the row name).
+    start_telemetry_row(obs, args.telemetry_id or "router", "router",
+                        args.registry, tls=tls)
     log.info("oim-router serving", endpoint=args.endpoint,
              addr=server.addr, registry=args.registry,
              replicas=len(table))

@@ -42,6 +42,7 @@ from oim_tpu.cli.common import (
     load_tls_flags,
     setup_logging,
     start_observability,
+    start_telemetry_row,
 )
 from oim_tpu.common.logging import from_context
 
@@ -283,6 +284,12 @@ def main(argv: list[str] | None = None) -> int:
         registration.start()
         log.info("registered in routing table", serve_id=args.serve_id,
                  advertise=advertise, heartbeat_s=args.heartbeat)
+
+    telemetry_default = args.serve_id or (
+        f"{args.controller_id}.serve" if args.controller_id else "")
+    start_telemetry_row(
+        obs, args.telemetry_id or telemetry_default, "serve",
+        args.registry, tls=load_tls_flags(args))
 
     drained = threading.Event()
 

@@ -177,6 +177,14 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
     setup_logging(args)
     obs = start_observability(args, "oim-trainer")
+    if args.registry:
+        from oim_tpu.cli.common import start_telemetry_row
+
+        telemetry_default = (
+            f"{args.controller_id}.trainer" if args.controller_id else "")
+        start_telemetry_row(
+            obs, args.telemetry_id or telemetry_default, "trainer",
+            args.registry, tls=load_tls_flags(args))
     log = from_context()
 
     if args.platform:

@@ -133,6 +133,12 @@ def parse_prometheus_text(text: str):
         if line.startswith("#"):
             continue
         m = sample_re.match(line)
+        if not m and " # " in line:
+            # OpenMetrics exemplar suffix on a histogram bucket
+            # (`... 12 # {trace_id="..."} 0.04 171234.5`): the sample
+            # value is everything before the marker. Exemplars are read
+            # by parse_exemplars; this parser keeps the sample.
+            m = sample_re.match(line.split(" # ", 1)[0].rstrip())
         if not m:
             raise ValueError(f"unparseable metrics line: {line!r}")
         # One left-to-right pass: chained str.replace would mis-decode a
@@ -145,6 +151,23 @@ def parse_prometheus_text(text: str):
         }
         samples.append((m.group(1), labels, float(m.group(4))))
     return types, helps, samples
+
+
+def parse_exemplars(text: str) -> list[tuple[str, str]]:
+    """(metric name, trace_id) per OpenMetrics exemplar in a scrape —
+    the anchors that turn a latency bucket into a concrete request
+    (feed the trace_id to --events / /debug/spans)."""
+    import re
+
+    out: list[tuple[str, str]] = []
+    line_re = re.compile(
+        r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{.*?\})?\s+\S+'
+        r' # \{trace_id="((?:[^"\\]|\\.)*)"\}')
+    for line in text.splitlines():
+        m = line_re.match(line.strip())
+        if m:
+            out.append((m.group(1), m.group(2)))
+    return out
 
 
 def _histogram_quantile(buckets: list[tuple[float, float]], q: float) -> float:
@@ -176,8 +199,13 @@ def print_metrics(target: str) -> None:
     import urllib.request
 
     try:
-        with urllib.request.urlopen(
-                f"http://{target}/metrics", timeout=10) as r:
+        # Ask for OpenMetrics: the server then includes the trace_id
+        # exemplars (legal only in that format; the parser below strips
+        # them from sample values, parse_exemplars reads them).
+        request = urllib.request.Request(
+            f"http://{target}/metrics",
+            headers={"Accept": "application/openmetrics-text"})
+        with urllib.request.urlopen(request, timeout=10) as r:
             text = r.read().decode()
     except (urllib.error.URLError, OSError) as err:
         raise SystemExit(f"--metrics: cannot scrape http://{target}/metrics: "
@@ -227,6 +255,235 @@ def print_metrics(target: str) -> None:
                 print(f"{prefix} {value:g}")
 
 
+def _http_get(url: str, timeout: float = 10.0) -> str:
+    import urllib.error
+    import urllib.request
+
+    try:
+        # OpenMetrics Accept: /metrics then carries exemplars (legal
+        # only in that format); /debug/* endpoints ignore the header.
+        request = urllib.request.Request(
+            url, headers={"Accept": "application/openmetrics-text"})
+        with urllib.request.urlopen(request, timeout=timeout) as r:
+            return r.read().decode()
+    except (urllib.error.URLError, OSError) as err:
+        raise SystemExit(
+            f"cannot fetch {url}: {getattr(err, 'reason', err)}") from err
+
+
+def fetch_events(target: str, trace: str = "", type_: str = "",
+                 limit: int = 0) -> dict:
+    """GET /debug/events on ``host:port`` -> the flight-recorder reply
+    ({"events": [...], "dropped": n})."""
+    import json
+    import urllib.parse
+
+    params = {}
+    if trace:
+        params["trace"] = trace
+    if type_:
+        params["type"] = type_
+    if limit:
+        params["limit"] = str(limit)
+    query = f"?{urllib.parse.urlencode(params)}" if params else ""
+    return json.loads(_http_get(f"http://{target}/debug/events{query}"))
+
+
+def print_events(target: str, trace: str = "", type_: str = "") -> None:
+    """Render a daemon's flight recorder: one line per event, oldest
+    first — timestamp, type, trace_id, attributes."""
+    import datetime
+
+    doc = fetch_events(target, trace=trace, type_=type_)
+    events = doc.get("events", [])
+    if not events:
+        scope = f" for trace {trace}" if trace else ""
+        print(f"no recorded events{scope} "
+              f"({doc.get('dropped', 0)} dropped from the ring)")
+        return
+    for event in events:
+        ts = datetime.datetime.fromtimestamp(
+            event.get("ts", 0)).strftime("%H:%M:%S.%f")[:-3]
+        attrs = " ".join(
+            f"{k}={v}" for k, v in sorted(
+                (event.get("attrs") or {}).items()))
+        tid = event.get("trace_id", "") or "-"
+        print(f"{ts}\t{event.get('type', '?')}\t{tid}\t{attrs}")
+
+
+# -- oimctl --top: the live cluster table -----------------------------------
+
+
+def telemetry_rows(stub) -> list[tuple[str, str, str, str]]:
+    """(id, ALIVE|STALE, role, metrics endpoint) per ``telemetry/<id>``
+    registry row — the self-published discovery rows every daemon's
+    observability plane maintains (common/telemetry.py)."""
+    import json
+
+    from oim_tpu.common.pathutil import REGISTRY_TELEMETRY
+
+    live = {
+        v.path
+        for v in stub.GetValues(
+            pb.GetValuesRequest(path=REGISTRY_TELEMETRY), timeout=10).values
+    }
+    stale = stub.GetValues(
+        pb.GetValuesRequest(path=REGISTRY_TELEMETRY, include_stale=True),
+        timeout=10,
+    ).values
+    rows = []
+    for value in sorted(stale, key=lambda v: v.path):
+        try:
+            snap = json.loads(value.value)
+        except ValueError:
+            snap = {}
+        if not isinstance(snap, dict):
+            snap = {}
+        rows.append((
+            value.path.partition("/")[2],
+            "ALIVE" if value.path in live else "STALE",
+            str(snap.get("role", "?")),
+            str(snap.get("metrics", "")),
+        ))
+    return rows
+
+
+def _series_value(samples, name: str, labels: dict | None = None):
+    for n, lbls, v in samples:
+        if n == name and (labels is None
+                          or all(lbls.get(k) == want
+                                 for k, want in labels.items())):
+            return v
+    return None
+
+
+def _series_quantiles(samples, name: str, labels: dict,
+                      qs=(0.5, 0.99)) -> list[float]:
+    buckets = sorted(
+        (float(lbls["le"]), v)
+        for n, lbls, v in samples
+        if n == f"{name}_bucket" and "le" in lbls
+        and all(lbls.get(k) == want for k, want in labels.items())
+    )
+    return [_histogram_quantile(buckets, q) for q in qs]
+
+
+def top_row(row_id: str, status: str, role: str, target: str,
+            http_get=_http_get) -> dict:
+    """One `--top` table row: scrape ``target``'s /metrics +
+    /debug/events and distill the columns. STALE/unreachable rows
+    degrade to placeholders — a dead daemon must still show up (that it
+    is dead IS the signal), not break the table."""
+    import json
+
+    row = {"id": row_id, "status": status, "role": role, "qps": None,
+           "ft_ms": (None, None), "it_ms": (None, None), "queue": None,
+           "slots": None, "cache_hit": None, "repl_lag": None,
+           "spread": None, "events": {}}
+    if status != "ALIVE" or not target:
+        return row
+    try:
+        _, _, samples = parse_prometheus_text(
+            http_get(f"http://{target}/metrics"))
+        events_doc = json.loads(
+            http_get(f"http://{target}/debug/events?limit=512"))
+    except (SystemExit, ValueError):
+        row["status"] = "UNSCRAPEABLE"
+        return row
+    # Columns gate on role: every process declares every canonical
+    # metric (common/metrics.py DEFAULT), so a registry's scrape carries
+    # an oim_serve_qps of 0 — "-" for a column the role cannot have is
+    # signal, 0 would be a lie.
+    if role == "serve":
+        row["qps"] = _series_value(samples, "oim_serve_qps")
+        for key, kind in (("ft_ms", "first"), ("it_ms", "next")):
+            p50, p99 = _series_quantiles(
+                samples, "oim_serve_token_latency_seconds", {"kind": kind})
+            if p50 == p50 or p99 == p99:  # at least one non-NaN
+                row[key] = (p50 * 1e3, p99 * 1e3)
+        row["queue"] = _series_value(samples, "oim_serve_queue_depth")
+        row["slots"] = _series_value(
+            samples, "oim_serve_slot_occupancy")
+    hits = _series_value(samples, "oim_stage_cache_hits_total")
+    misses = _series_value(samples, "oim_stage_cache_misses_total")
+    if hits is not None and misses is not None and hits + misses > 0:
+        row["cache_hit"] = hits / (hits + misses)
+    if role == "registry":
+        row["repl_lag"] = _series_value(
+            samples, "oim_replication_lag_records")
+    if role == "router":
+        replicas = {
+            lbls["replica"]
+            for n, lbls, v in samples
+            if n == "oim_router_requests_total" and lbls.get("replica")
+            and v > 0
+        }
+        if replicas:
+            row["spread"] = len(replicas)
+    counts: dict[str, int] = {}
+    for event in events_doc.get("events", []):
+        t = event.get("type", "?")
+        counts[t] = counts.get(t, 0) + 1
+    row["events"] = counts
+    return row
+
+
+def render_top(rows: list[dict]) -> str:
+    """The cluster table, one daemon per line."""
+    def fmt(v, pattern="{:.2g}"):
+        return "-" if v is None else pattern.format(v)
+
+    def fmt_pair(pair):
+        p50, p99 = pair
+        if p50 is None or p50 != p50:
+            return "-"
+        return f"{p50:.1f}/{p99:.1f}"
+
+    headers = ("ID", "ROLE", "STATUS", "QPS", "FIRST-TOK(ms)",
+               "INTER-TOK(ms)", "QUEUE", "SLOTS", "CACHE-HIT",
+               "REPL-LAG", "SPREAD", "EVENTS")
+    table = [headers]
+    for r in rows:
+        top_events = sorted(r["events"].items(),
+                            key=lambda kv: -kv[1])[:2]
+        table.append((
+            r["id"], r["role"], r["status"], fmt(r["qps"]),
+            fmt_pair(r["ft_ms"]), fmt_pair(r["it_ms"]),
+            fmt(r["queue"], "{:g}"), fmt(r["slots"]),
+            fmt(r["cache_hit"], "{:.0%}"), fmt(r["repl_lag"], "{:g}"),
+            fmt(r["spread"], "{:g}"),
+            ",".join(f"{t}:{n}" for t, n in top_events) or "-",
+        ))
+    widths = [max(len(row[i]) for row in table)
+              for i in range(len(headers))]
+    return "\n".join(
+        "  ".join(cell.ljust(widths[i]) for i, cell in enumerate(row))
+        for row in table)
+
+
+def print_top(with_failover, watch: float = 0.0) -> None:
+    """Poll every advertised telemetry endpoint and render one cluster
+    table; ``watch`` > 0 refreshes on that period until interrupted."""
+    import time
+
+    while True:
+        rows = [top_row(*entry)
+                for entry in with_failover(telemetry_rows)]
+        if watch > 0:
+            print("\033[2J\033[H", end="")  # clear + home, like top(1)
+        if rows:
+            print(render_top(rows))
+        else:
+            print("no telemetry/<id> rows registered (daemons publish "
+                  "them when run with --metrics-port and --registry)")
+        if watch <= 0:
+            return
+        try:
+            time.sleep(watch)
+        except KeyboardInterrupt:
+            return
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser("oimctl")
     add_registry_flag(parser)
@@ -263,16 +520,62 @@ def main(argv: list[str] | None = None) -> int:
              "grouped, histograms summarized as count/mean/p50/p99); "
              "plain HTTP, no --registry needed",
     )
+    parser.add_argument(
+        "--events",
+        default=None,
+        metavar="HOST:PORT",
+        help="print a daemon's flight recorder (GET /debug/events): one "
+             "line per control-plane event, oldest first; plain HTTP, "
+             "no --registry needed",
+    )
+    parser.add_argument(
+        "--trace",
+        default="",
+        metavar="TRACE_ID",
+        help="with --events: only events stamped with this trace_id "
+             "(the id an exemplar or span named)",
+    )
+    parser.add_argument(
+        "--type",
+        default="",
+        metavar="EVENT_TYPE",
+        dest="event_type",
+        help="with --events: only events of this type "
+             "(router_retry, lease_expired, ...)",
+    )
+    parser.add_argument(
+        "--top",
+        action="store_true",
+        help="live cluster table from the TTL-leased telemetry/<id> "
+             "rows: every advertised metrics endpoint is scraped and "
+             "rendered as one row (role, qps, first/inter-token "
+             "p50/p99, queue, slot occupancy, stage-cache hit rate, "
+             "replication lag, router spread, recent event counts)",
+    )
+    parser.add_argument(
+        "--watch",
+        type=float,
+        default=0.0,
+        metavar="SECONDS",
+        help="with --top: refresh the table on this period until "
+             "interrupted (0 = render once)",
+    )
     add_common_flags(parser)
     args = parser.parse_args(argv)
     setup_logging(args)
+    requested_registry_ops = (
+        args.set is not None or args.get is not None or args.health
+        or args.promote or args.top)
     if args.metrics is not None:
         print_metrics(args.metrics)
-        if args.set is None and args.get is None and not args.health \
-                and not args.promote:
-            return 0
+    if args.events is not None:
+        print_events(args.events, trace=args.trace, type_=args.event_type)
+    if (args.metrics is not None or args.events is not None) \
+            and not requested_registry_ops:
+        return 0
     if not args.registry:
-        raise SystemExit("--registry is required (except with --metrics alone)")
+        raise SystemExit(
+            "--registry is required (except with --metrics/--events alone)")
     tls = load_tls_flags(args, peer_name="component.registry")
     endpoints = RegistryEndpoints(args.registry)
 
@@ -366,11 +669,13 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{cid}\t{status}\t{address}\t{mesh}")
         for key, status, endpoint, load in serve_rows:
             print(f"{key}\t{status}\t{endpoint}\t{load}")
-    if args.set is None and args.get is None and not args.health \
-            and not args.promote and args.metrics is None:
+    if args.top:
+        print_top(with_failover, watch=args.watch)
+    if not requested_registry_ops and args.metrics is None \
+            and args.events is None:
         raise SystemExit(
-            "nothing to do: pass --get, --set, --health, --promote "
-            "and/or --metrics")
+            "nothing to do: pass --get, --set, --health, --promote, "
+            "--top, --metrics and/or --events")
     return 0
 
 

@@ -69,7 +69,8 @@ class _CounterValue:
         with self._lock:
             return self._value
 
-    def sample_lines(self, name: str, labels: str) -> Iterable[str]:
+    def sample_lines(self, name: str, labels: str,
+                     exemplars: bool = False) -> Iterable[str]:
         # Plain float formatting ("42.0"): the pre-label wire format,
         # which scrapers and tests already depend on.
         yield f"{name}{labels} {self.value}"
@@ -87,9 +88,14 @@ class _HistogramValue:
         self._counts = [0] * len(self._buckets)
         self._sum = 0.0
         self._count = 0
+        # Last exemplar per bucket (index len(buckets) = +Inf): the
+        # OpenMetrics trace anchor — (trace_id, observed value, unix ts).
+        # Keeping only the most recent costs O(buckets) memory and is
+        # exactly the prometheus-client behavior.
+        self._exemplars: dict[int, tuple[str, float, float]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: str = "") -> None:
         import bisect
 
         # _counts[i] is the count landing in (buckets[i-1], buckets[i]];
@@ -100,6 +106,9 @@ class _HistogramValue:
             i = bisect.bisect_left(self._buckets, value)
             if i < len(self._counts):
                 self._counts[i] += 1
+            if exemplar:
+                self._exemplars[min(i, len(self._counts))] = (
+                    exemplar, value, time.time())
 
     @property
     def count(self) -> int:
@@ -111,21 +120,37 @@ class _HistogramValue:
         with self._lock:
             return self._sum
 
-    def sample_lines(self, name: str, labels: str) -> Iterable[str]:
+    @staticmethod
+    def _exemplar_suffix(ex: tuple[str, float, float] | None) -> str:
+        # OpenMetrics exemplar: `# {trace_id="..."} <value> <timestamp>`.
+        # Appended to the Prometheus text line — OpenMetrics-aware
+        # scrapers pick the trace anchor up, plain-text ones must
+        # tolerate/strip it (oimctl's parser and the test grammar do).
+        if ex is None:
+            return ""
+        trace_id, value, ts = ex
+        return (f' # {{trace_id="{escape_label_value(trace_id)}"}} '
+                f"{value:.6g} {ts:.3f}")
+
+    def sample_lines(self, name: str, labels: str,
+                     exemplars: bool = False) -> Iterable[str]:
         with self._lock:
             counts = list(self._counts)
             total, total_sum = self._count, self._sum
+            anchors = dict(self._exemplars) if exemplars else {}
         # labels arrives rendered ("{a=\"x\"}" or ""); the le label merges
         # inside the braces per the text-format grammar.
         inner = labels[1:-1] if labels else ""
         cumulative = 0
-        for bound, n in zip(self._buckets, counts):
+        for i, (bound, n) in enumerate(zip(self._buckets, counts)):
             cumulative += n
             le = f'le="{_fmt_bound(bound)}"'
             merged = "{" + (inner + "," if inner else "") + le + "}"
-            yield f"{name}_bucket{merged} {cumulative}"
+            yield (f"{name}_bucket{merged} {cumulative}"
+                   f"{self._exemplar_suffix(anchors.get(i))}")
         merged = "{" + (inner + "," if inner else "") + 'le="+Inf"' + "}"
-        yield f"{name}_bucket{merged} {total}"
+        yield (f"{name}_bucket{merged} {total}"
+               f"{self._exemplar_suffix(anchors.get(len(counts)))}")
         yield f"{name}_sum{labels} {total_sum}"
         yield f"{name}_count{labels} {total}"
 
@@ -184,14 +209,14 @@ class Counter:
     def value(self) -> float:
         return self._solo().value
 
-    def render(self) -> Iterable[str]:
+    def render(self, exemplars: bool = False) -> Iterable[str]:
         yield f"# HELP {self.name} {escape_help(self.help)}"
         yield f"# TYPE {self.name} {self.TYPE}"
         with self._family_lock:
             children = sorted(self._children.items())
         for key, child in children:
             yield from child.sample_lines(
-                self.name, _label_str(self.labelnames, key))
+                self.name, _label_str(self.labelnames, key), exemplars)
 
 
 class Gauge(Counter):
@@ -216,8 +241,8 @@ class Histogram(Counter):
     def _new_value(self):
         return _HistogramValue(self.buckets)
 
-    def observe(self, value: float) -> None:
-        self._solo().observe(value)
+    def observe(self, value: float, exemplar: str = "") -> None:
+        self._solo().observe(value, exemplar)
 
     @property
     def count(self) -> int:
@@ -276,12 +301,18 @@ class Registry:
                     f"{m.buckets}")
             return m
 
-    def render(self) -> str:
+    def render(self, exemplars: bool = False) -> str:
+        """Prometheus text format; ``exemplars=True`` adds the
+        OpenMetrics ``# {trace_id="…"}`` suffixes on histogram bucket
+        lines. Exemplars are ONLY legal in the OpenMetrics exposition
+        format — the metrics server content-negotiates on the scrape's
+        Accept header, so a legacy Prometheus text parser never sees
+        them (one suffix would poison its whole scrape)."""
         with self._lock:
             metrics = list(self._metrics.values())
         lines: list[str] = []
         for m in metrics:
-            lines.extend(m.render())
+            lines.extend(m.render(exemplars))
         return "\n".join(lines) + "\n"
 
 
@@ -411,8 +442,11 @@ SERVE_TOKENS_TOTAL = DEFAULT.counter(
     "oim_serve_tokens_total", "tokens emitted by the serving engine")
 SERVE_TOKEN_LATENCY = DEFAULT.histogram(
     "oim_serve_token_latency_seconds",
-    "latency of each emitted token: submit-to-first-token for the "
-    "prefill token, inter-token gap for decode tokens",
+    "latency of each emitted token, by kind: first = submit-to-first-"
+    "token (queue wait + prefill, the latency SLO), next = inter-token "
+    "decode gap — split so `oimctl --top` reads both percentiles off "
+    "one scrape; buckets carry OpenMetrics trace_id exemplars",
+    labelnames=("kind",),
     buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
              1.0, 2.5))
 # Request router (oim_tpu/router: least-loaded LB over serve replicas).
@@ -430,6 +464,15 @@ ROUTER_RETRIES_TOTAL = DEFAULT.counter(
 ROUTER_REPLICAS = DEFAULT.gauge(
     "oim_router_replicas",
     "ready serve replicas in the router's lease-filtered routing table")
+# Flight recorder (common/events.py): typed control-plane events with
+# trace_id stamps; the counter survives ring wrap, the ring itself is
+# served at /debug/events.
+EVENTS_TOTAL = DEFAULT.counter(
+    "oim_events_total",
+    "flight-recorder events emitted, by type (lease_expired, "
+    "feeder_failover, registry_promotion, router_retry, replica_drain, "
+    "stage_cache_eviction, slot_evicted, ...)",
+    labelnames=("type",))
 # Labeled RPC telemetry (common/tracing.py interceptors — the
 # go-grpc-prometheus analog; recorded by client and server vantage alike).
 RPC_LATENCY = DEFAULT.histogram(
@@ -444,8 +487,10 @@ RPC_TOTAL = DEFAULT.counter(
 
 
 class MetricsServer:
-    """Serves ``registry.render()`` on ``GET /metrics`` and the tracing
-    ring buffer on ``GET /debug/spans`` in a daemon thread.
+    """Serves ``registry.render()`` on ``GET /metrics``, the tracing
+    ring buffer on ``GET /debug/spans``, and the flight recorder on
+    ``GET /debug/events`` (``?trace=<id>``, ``?type=<t>``, ``?limit=<n>``
+    filters) in a daemon thread.
 
     ``host`` defaults to loopback (the safe standalone default); daemons
     that Prometheus scrapes from another pod bind ``--metrics-host
@@ -465,11 +510,30 @@ class MetricsServer:
                 self.wfile.write(body)
 
             def do_GET(self):  # noqa: N802 - http.server API
-                if self.path == "/metrics":
+                import urllib.parse
+
+                parsed = urllib.parse.urlsplit(self.path)
+                if parsed.path == "/metrics":
+                    # Content negotiation: exemplars are only legal in
+                    # the OpenMetrics exposition format (which also
+                    # requires the # EOF trailer). A scraper that asks
+                    # for it (Prometheus does by default) gets the
+                    # trace anchors; a legacy text-format scraper gets
+                    # the 0.0.4 wire format untouched — one exemplar
+                    # suffix would fail its entire scrape.
+                    accept = self.headers.get("Accept", "")
+                    if "application/openmetrics-text" in accept:
+                        body = registry_ref.render(exemplars=True) \
+                            + "# EOF\n"
+                        self._reply(
+                            body.encode(),
+                            "application/openmetrics-text; "
+                            "version=1.0.0; charset=utf-8")
+                        return
                     self._reply(registry_ref.render().encode(),
                                 "text/plain; version=0.0.4")
                     return
-                if self.path == "/debug/spans":
+                if parsed.path == "/debug/spans":
                     # Complete Chrome-trace JSON of the span ring: save the
                     # body to a file and open it in Perfetto directly.
                     import json
@@ -478,6 +542,27 @@ class MetricsServer:
 
                     body = json.dumps(
                         {"traceEvents": tracing.recorder().to_events()})
+                    self._reply(body.encode(), "application/json")
+                    return
+                if parsed.path == "/debug/events":
+                    # The flight recorder, filterable: ?trace=<trace_id>
+                    # answers "what happened to THIS request", ?type=
+                    # narrows to one incident class, ?limit= bounds the
+                    # reply to the newest n.
+                    from oim_tpu.common import events
+
+                    query = urllib.parse.parse_qs(parsed.query)
+
+                    def q(name: str) -> str:
+                        vals = query.get(name)
+                        return vals[-1] if vals else ""
+
+                    try:
+                        limit = int(q("limit") or 0)
+                    except ValueError:
+                        limit = 0
+                    body = events.recorder().to_json(
+                        trace_id=q("trace"), type_=q("type"), limit=limit)
                     self._reply(body.encode(), "application/json")
                     return
                 self.send_error(404)

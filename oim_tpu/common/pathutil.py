@@ -16,6 +16,12 @@ REGISTRY_MESH = "mesh"
 # the serve package, because the registry's authorization rules need the
 # constant without importing the jax-heavy serving stack.
 REGISTRY_SERVE = "serve"
+# Top-level namespace for the observability plane: ``telemetry/<id>`` ->
+# JSON {"metrics": "host:port", "role": ...} rows every daemon
+# self-publishes with a lease (common/telemetry.py), so `oimctl --top`
+# discovers every live metrics endpoint from one registry read. Reserved
+# exactly like ``serve``: no controller may register under this id.
+REGISTRY_TELEMETRY = "telemetry"
 
 
 def split_registry_path(path: str) -> list[str]:

@@ -1,0 +1,218 @@
+"""TTL-leased registry row publishing — the shared publish-and-renew
+loop, and the ``telemetry/<id>`` self-registration every daemon uses.
+
+The serving tier invented the pattern (serve/registration.py): one
+registry key whose VALUE is a live JSON snapshot, re-published every
+beat, so the heartbeat IS the refresh — no separate bookkeeping to
+drift. ``RegistryRowPublisher`` is that loop factored out: jittered
+exponential backoff through registry outages, endpoint rotation on
+UNAVAILABLE/FAILED_PRECONDITION (replicated pair), pooled channels with
+transport-failure eviction, a monotonic ``beat`` counter stamped into
+every snapshot (consumers tell a fresh heartbeat from the frozen row of
+a dead publisher), and delete-on-stop. ``ServeRegistration`` subclasses
+it for ``serve/<id>`` load rows; ``TelemetryRegistration`` (here) for
+the observability plane.
+
+Telemetry rows make the cluster self-describing for ``oimctl --top``:
+every daemon publishes ``telemetry/<id>`` -> ``{"metrics":
+"host:port", "role": "...", "pid": ...}`` with a lease, so one registry
+read yields every live metrics endpoint — dead daemons vanish when the
+lease lapses, exactly like dead controllers. The registry's authz
+extends the ``serve/`` reservation pattern to this namespace
+(registry.py ``_may_set``): an identity may write only its OWN
+``telemetry/<own-id>`` row (or a dot-suffixed variant for co-located
+processes), and no controller may claim the bare id ``telemetry``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+
+import grpc
+
+from oim_tpu.common import channelpool
+from oim_tpu.common.endpoints import FAILOVER_CODES, RegistryEndpoints
+from oim_tpu.common.logging import from_context
+from oim_tpu.common.pathutil import REGISTRY_TELEMETRY
+from oim_tpu.common.tlsutil import TLSConfig
+from oim_tpu.spec import RegistryStub, pb
+
+
+def telemetry_key(telemetry_id: str) -> str:
+    if not telemetry_id or "/" in telemetry_id:
+        raise ValueError(f"telemetry id must be a single path component, "
+                         f"got {telemetry_id!r}")
+    return f"{REGISTRY_TELEMETRY}/{telemetry_id}"
+
+
+class RegistryRowPublisher:
+    """Publish-and-renew loop for one TTL-leased registry row.
+
+    ``start()`` runs the loop in a daemon thread; ``beat_once()`` is the
+    unit the loop (and tests) drive: one SetValue of ``snapshot()`` with
+    ``lease_seconds``. ``stop(deregister=True)`` deletes the key so
+    consumers drop the row without waiting out the lease. Subclasses
+    implement ``snapshot() -> dict``.
+    """
+
+    # Same TTL posture as the controller heartbeat: one lost beat must
+    # not expire a healthy publisher, two-and-a-half do.
+    LEASE_FACTOR = 2.5
+    BACKOFF_MAX = 30.0
+    THREAD_NAME = "oim-row-publisher"
+
+    def __init__(
+        self,
+        key: str,
+        registry_address: str,
+        interval: float = 10.0,
+        lease_seconds: float = 0.0,
+        tls: TLSConfig | None = None,
+        pool: channelpool.ChannelPool | None = None,
+    ):
+        self.key = key
+        self._endpoints = RegistryEndpoints(registry_address)
+        self.interval = interval
+        if lease_seconds == 0.0:
+            lease_seconds = self.LEASE_FACTOR * interval
+        self.lease_seconds = max(lease_seconds, 0.0)
+        self.tls = tls
+        self._pool = pool if pool is not None else channelpool.shared()
+        # Monotonic beat counter, stamped into every snapshot: it makes
+        # each re-publish change the row's VALUE even when the snapshot
+        # repeats, which is how consumers (router table mark_failed)
+        # tell a fresh heartbeat from the frozen row of a dead
+        # publisher whose lease has not lapsed yet.
+        self._beats = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def snapshot(self) -> dict:
+        """The JSON value published under ``self.key`` each beat."""
+        raise NotImplementedError
+
+    def _registry_channel(self) -> grpc.Channel:
+        return self._pool.get(
+            self._endpoints.current(), self.tls, "component.registry")
+
+    def _set(self, value: str, lease_seconds: float) -> None:
+        try:
+            RegistryStub(self._registry_channel()).SetValue(
+                pb.SetValueRequest(value=pb.Value(
+                    path=self.key, value=value,
+                    lease_seconds=lease_seconds)),
+                timeout=10.0,
+            )
+        except grpc.RpcError as err:
+            self._pool.maybe_evict(err, self._endpoints.current())
+            raise
+
+    def beat_once(self, **overrides) -> dict:
+        """One heartbeat: publish the current snapshot (plus
+        ``overrides``) with the lease. Returns the published snapshot."""
+        snap = self.snapshot()
+        snap.update(overrides)
+        self._beats += 1
+        snap["beat"] = self._beats
+        self._set(json.dumps(snap, sort_keys=True), self.lease_seconds)
+        return snap
+
+    def start(self) -> None:
+        def loop() -> None:
+            log = from_context().with_fields(row=self.key)
+            failures = 0
+            while not self._stop.is_set():
+                try:
+                    self.beat_once()
+                    failures = 0
+                    log.debug("row heartbeat",
+                              registry=self._endpoints.current())
+                except grpc.RpcError as err:
+                    failures += 1
+                    if (self._endpoints.multiple
+                            and err.code() in FAILOVER_CODES):
+                        target = self._endpoints.advance()
+                        log.warning("failing over to peer registry",
+                                    target=target)
+                    base = min(1.0, self.interval)
+                    delay = min(base * 2 ** (failures - 1), self.BACKOFF_MAX)
+                    delay *= 0.5 + random.random()  # noqa: S311 - jitter
+                    log.warning(
+                        "registry unreachable; backing off",
+                        error=err.details() or str(err.code()),
+                        attempt=failures, retry_s=round(delay, 3))
+                    if self._stop.wait(delay):
+                        return
+                    continue
+                if self._stop.wait(self.interval):
+                    return
+
+        self._thread = threading.Thread(
+            target=loop, name=self.THREAD_NAME, daemon=True)
+        self._thread.start()
+
+    def stop(self, deregister: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if deregister:
+            try:
+                # Empty value = SetValue's delete idiom: the row vanishes
+                # now instead of lingering until the lease expires.
+                self._set("", 0.0)
+            except grpc.RpcError:
+                pass  # registry down: the lease expires the row anyway
+
+
+class TelemetryRegistration(RegistryRowPublisher):
+    """One daemon's ``telemetry/<id>`` row: metrics endpoint + role.
+
+    ``oimctl --top`` reads the lease-filtered ``telemetry`` prefix and
+    scrapes every advertised endpoint — the cluster view needs no static
+    target list, and dead daemons fall out with their lease."""
+
+    THREAD_NAME = "oim-telemetry"
+
+    def __init__(
+        self,
+        telemetry_id: str,
+        role: str,
+        metrics_endpoint: str,
+        registry_address: str,
+        interval: float = 10.0,
+        lease_seconds: float = 0.0,
+        tls: TLSConfig | None = None,
+        pool: channelpool.ChannelPool | None = None,
+    ):
+        super().__init__(
+            telemetry_key(telemetry_id), registry_address,
+            interval=interval, lease_seconds=lease_seconds,
+            tls=tls, pool=pool)
+        self.telemetry_id = telemetry_id
+        self.role = role
+        self.metrics_endpoint = metrics_endpoint
+
+    def snapshot(self) -> dict:
+        return {
+            "metrics": self.metrics_endpoint,
+            "role": self.role,
+            "pid": os.getpid(),
+        }
+
+
+def telemetry_snapshot(role: str, metrics_endpoint: str,
+                       beat: int = 0) -> str:
+    """The serialized telemetry row value, for publishers that write the
+    registry DB directly instead of dialing (the registry daemon's own
+    row — it must not depend on its own gRPC liveness, and a standby
+    must not dial itself just to be told FAILED_PRECONDITION)."""
+    return json.dumps({
+        "beat": beat,
+        "metrics": metrics_endpoint,
+        "pid": os.getpid(),
+        "role": role,
+    }, sort_keys=True)

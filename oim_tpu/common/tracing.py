@@ -39,6 +39,7 @@ from typing import Any, Iterator, NamedTuple, Sequence
 import grpc
 
 from oim_tpu.common import metrics as M
+from oim_tpu.common.interceptors import redact_text
 from oim_tpu.common.logging import from_context, with_logger
 
 # Request-metadata key carrying the trace context (traceparent-style).
@@ -102,12 +103,16 @@ class Span:
         self.duration = time.monotonic() - self._t0
 
     def to_event(self, pid: int) -> dict[str, Any]:
-        """Chrome trace-event ("X" complete event, microsecond clock)."""
+        """Chrome trace-event ("X" complete event, microsecond clock).
+        String attribute values pass through the secret-redaction helper:
+        endpoint strings and registry values recorded on spans must not
+        leak credentials into trace files or /debug/spans."""
         args = {"trace_id": self.trace_id, "span_id": self.span_id}
         if self.parent_id:
             args["parent_id"] = self.parent_id
         for k, v in self.attrs.items():
-            args[k] = v if isinstance(v, (int, float, bool)) else str(v)
+            args[k] = (v if isinstance(v, (int, float, bool))
+                       else redact_text(str(v)))
         return {
             "name": self.name,
             "cat": "oim",
@@ -136,18 +141,42 @@ class SpanRecorder:
     Perfetto/chrome://tracing parsers accept a truncated array, which makes
     the file valid even when the daemon is SIGKILLed mid-run (the same
     crash-only stance as the registry journal).
+
+    Tail sampling bounds the FILE under serving load (the ring is bounded
+    by construction): a span is exported when it errored (non-OK status
+    code), ran slower than its per-name latency threshold, or survives a
+    probabilistic keep — decided by a hash of its trace_id, so one kept
+    trace exports ALL its spans and a dropped one exports none (a sampled
+    trace file with holes in the middle of a request is worse than none).
+    ``sample=1.0`` (the default) keeps everything — the pre-sampling
+    behavior.
     """
 
     # Streamed events are flushed at most this often: flush-per-span would
     # gate every RPC handler thread on a write syscall; a bounded tail
     # (one interval) is all a SIGKILL can lose.
     FLUSH_INTERVAL = 0.2
+    # Per-name latency threshold default: spans slower than this always
+    # export regardless of the sampling probability ("tail" sampling —
+    # the slow outliers are the spans worth keeping).
+    SLOW_THRESHOLD_S = 0.1
 
     def __init__(self, service: str = "oim", trace_dir: str = "",
-                 capacity: int = 4096):
+                 capacity: int = 4096, sample: float = 1.0,
+                 slow_threshold_s: float | None = None,
+                 slow_thresholds: dict[str, float] | None = None):
         self.service = service
         self.trace_dir = trace_dir
-        self.capacity = capacity
+        # capacity == 0 disables ring recording entirely (the
+        # observability-overhead bench's "off" configuration).
+        self.capacity = max(0, capacity)
+        self.sample = sample
+        self.slow_threshold_s = (self.SLOW_THRESHOLD_S
+                                 if slow_threshold_s is None
+                                 else slow_threshold_s)
+        # Span-name -> latency threshold overrides (e.g. a decode step is
+        # "slow" at 50ms where a staging pass is slow at 10s).
+        self.slow_thresholds = dict(slow_thresholds or {})
         self.pid = os.getpid()
         self._spans: list[Span] = []
         self._next = 0  # ring cursor
@@ -158,18 +187,48 @@ class SpanRecorder:
         self._file = None
         self._last_flush = 0.0
         self._dropped = 0
+        self._sampled_out = 0
+
+    # -- tail-sampling policy ---------------------------------------------
+
+    def keep_for_export(self, span: Span) -> bool:
+        """The tail-sampling verdict for the streamed file. Always keep
+        errors and slow spans; otherwise a deterministic trace_id-hash
+        coin flip at ``sample`` probability (trace-coherent: every
+        recorder in the fleet keeps or drops a trace's spans together,
+        because they hash the same trace_id)."""
+        if self.sample >= 1.0:
+            return True
+        code = span.attrs.get("code")
+        if code not in (None, "", "OK"):
+            return True
+        threshold = self.slow_thresholds.get(
+            span.name, self.slow_threshold_s)
+        if threshold > 0 and span.duration >= threshold:
+            return True
+        if self.sample <= 0.0:
+            return False
+        try:
+            bucket = int(span.trace_id[:8], 16) / 0xFFFFFFFF
+        except ValueError:  # non-hex test ids: keep
+            return True
+        return bucket < self.sample
 
     # -- recording --------------------------------------------------------
 
     def record(self, span: Span) -> None:
-        with self._lock:
-            if len(self._spans) < self.capacity:
-                self._spans.append(span)
-            else:
-                self._spans[self._next] = span
-                self._next = (self._next + 1) % self.capacity
-                self._dropped += 1
+        if self.capacity > 0:
+            with self._lock:
+                if len(self._spans) < self.capacity:
+                    self._spans.append(span)
+                else:
+                    self._spans[self._next] = span
+                    self._next = (self._next + 1) % self.capacity
+                    self._dropped += 1
         if self.trace_dir:
+            if not self.keep_for_export(span):
+                self._sampled_out += 1
+                return
             with self._file_lock:
                 self._write_event(span.to_event(self.pid))
 
@@ -227,12 +286,18 @@ _current: contextvars.ContextVar[Span | None] = contextvars.ContextVar(
 
 
 def configure(service: str, trace_dir: str = "",
-              capacity: int = 4096) -> SpanRecorder:
+              capacity: int = 4096, sample: float = 1.0,
+              slow_threshold_s: float | None = None,
+              slow_thresholds: dict[str, float] | None = None) -> SpanRecorder:
     """Install the process-global recorder (one per daemon; the service
-    name becomes the Perfetto process label). Returns it."""
+    name becomes the Perfetto process label). ``capacity`` sizes the
+    span ring (``--trace-ring``); ``sample``/``slow_threshold_s`` set
+    the file-export tail-sampling policy (``--trace-sample`` /
+    ``--trace-slow-ms``). Returns it."""
     global _recorder
     _recorder.close()
-    _recorder = SpanRecorder(service, trace_dir, capacity)
+    _recorder = SpanRecorder(service, trace_dir, capacity, sample,
+                             slow_threshold_s, slow_thresholds)
     return _recorder
 
 
@@ -318,8 +383,13 @@ def method_label(method: str) -> str:
     return method.lstrip("/")
 
 
-def _observe(method: str, code: str, seconds: float) -> None:
-    M.RPC_LATENCY.labels(method=method, code=code).observe(seconds)
+def _observe(method: str, code: str, seconds: float,
+             trace_id: str = "") -> None:
+    # trace_id rides the latency bucket as an OpenMetrics exemplar: a
+    # slow p99 bucket then NAMES a request to pull from /debug/spans
+    # and /debug/events instead of pointing at an anonymous aggregate.
+    M.RPC_LATENCY.labels(method=method, code=code).observe(
+        seconds, exemplar=trace_id)
     M.RPC_TOTAL.labels(method=method, code=code).inc()
 
 
@@ -361,11 +431,13 @@ class TelemetryServerInterceptor(grpc.ServerInterceptor):
                         except Exception:
                             code = _context_code(context, "UNKNOWN")
                             span.attrs["code"] = code
-                            _observe(method, code, time.monotonic() - t0)
+                            _observe(method, code, time.monotonic() - t0,
+                                     span.trace_id)
                             raise
                         code = _context_code(context, "OK")
                         span.attrs["code"] = code
-                        _observe(method, code, time.monotonic() - t0)
+                        _observe(method, code, time.monotonic() - t0,
+                                 span.trace_id)
                         return reply
             return wrapped
 
@@ -389,16 +461,19 @@ class TelemetryServerInterceptor(grpc.ServerInterceptor):
                         except GeneratorExit:
                             code = _context_code(context, "CANCELLED")
                             span.attrs["code"] = code
-                            _observe(method, code, time.monotonic() - t0)
+                            _observe(method, code, time.monotonic() - t0,
+                                     span.trace_id)
                             raise
                         except Exception:
                             code = _context_code(context, "UNKNOWN")
                             span.attrs["code"] = code
-                            _observe(method, code, time.monotonic() - t0)
+                            _observe(method, code, time.monotonic() - t0,
+                                     span.trace_id)
                             raise
                         code = _context_code(context, "OK")
                         span.attrs["code"] = code
-                        _observe(method, code, time.monotonic() - t0)
+                        _observe(method, code, time.monotonic() - t0,
+                                 span.trace_id)
             return wrapped
 
         if handler.unary_unary:
@@ -477,7 +552,8 @@ class TelemetryClientInterceptor(
             span.attrs["code"] = code_name
             span.finish()
             _recorder.record(span)
-            _observe(method, code_name, time.monotonic() - t0)
+            _observe(method, code_name, time.monotonic() - t0,
+                     span.trace_id)
 
         return details, finish
 
@@ -509,17 +585,38 @@ class TelemetryClientInterceptor(
 
 
 def load_trace_file(path: str) -> list[dict[str, Any]]:
-    """Parse one streamed trace file, tolerating the unterminated array a
-    killed daemon leaves behind."""
+    """Parse one streamed trace file, tolerating what a killed daemon
+    leaves behind: an unterminated array (the by-design steady state),
+    AND a final record truncated mid-write (SIGKILL between the write
+    syscalls of one event). The writer emits one event per line, so a
+    torn tail is recovered by dropping trailing lines until the array
+    parses — the same torn-tail stance as the registry journal replay."""
     text = open(path).read().strip()
     if not text:
         return []
-    if not text.endswith("]"):
-        text = text.rstrip(",") + "]"
-    events = json.loads(text)
-    if isinstance(events, dict):  # a complete {"traceEvents": ...} export
-        events = events.get("traceEvents", [])
-    return events
+
+    def parse(candidate: str):
+        if not candidate.endswith("]"):
+            candidate = candidate.rstrip().rstrip(",") + "]"
+        events = json.loads(candidate)
+        if isinstance(events, dict):  # a complete {"traceEvents": ...} export
+            events = events.get("traceEvents", [])
+        return events
+
+    try:
+        return parse(text)
+    except json.JSONDecodeError:
+        pass
+    lines = text.splitlines()
+    while lines:
+        lines.pop()
+        if not lines:
+            break
+        try:
+            return parse("\n".join(lines))
+        except json.JSONDecodeError:
+            continue
+    return []
 
 
 def merge_trace_dir(trace_dir: str, out_path: str = "") -> list[dict[str, Any]]:

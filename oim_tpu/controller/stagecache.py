@@ -28,7 +28,7 @@ import threading
 from collections import OrderedDict
 from typing import Any
 
-from oim_tpu.common import metrics as M
+from oim_tpu.common import events, metrics as M
 
 # Extent kinds whose content identity is cheaply verifiable. Anything else
 # (test-registered reader kinds, mutable host buffers) is uncacheable.
@@ -210,6 +210,10 @@ class StageCache:
         entry = self._entries.pop(key)
         self._bytes -= entry.nbytes
         M.STAGE_CACHE_EVICTIONS.inc()
+        # Flight recorder: an eviction explains why a later publish that
+        # "should" have been an O(1) hit restaged from source instead.
+        events.emit(events.STAGE_CACHE_EVICTION, key=key,
+                    bytes=entry.nbytes, still_pinned=entry.pins > 0)
         M.STAGE_CACHE_BYTES.set(self._bytes)
         M.STAGE_CACHE_ENTRIES.set(len(self._entries))
         if entry.pins == 0:

@@ -28,7 +28,13 @@ from typing import Any, Mapping
 
 import grpc
 
-from oim_tpu.common import channelpool, faultinject, metrics as M, tracing
+from oim_tpu.common import (
+    channelpool,
+    events,
+    faultinject,
+    metrics as M,
+    tracing,
+)
 from oim_tpu.common.endpoints import RegistryEndpoints
 from oim_tpu.common.keymutex import KeyMutex
 from oim_tpu.common.logging import from_context
@@ -271,6 +277,8 @@ class Feeder:
             reason=reason,
         )
         M.FEEDER_FAILOVERS.inc()
+        events.emit(events.FEEDER_FAILOVER, volume=volume_id,
+                    dead=self.controller_id, target=target, reason=reason)
         self.controller_id = target
         # The direct-endpoint cache is per PINNED controller: it points
         # at the dead one's address now — and so does any armed direct
@@ -626,6 +634,8 @@ class Feeder:
                             "healed volume after controller restart",
                             volume=volume_id,
                         )
+                        events.emit(events.VOLUME_HEALED, volume=volume_id,
+                                    controller=self.controller_id)
                         continue  # retry the window immediately
                     except (PublishError, grpc.RpcError):
                         # Registry may itself be down mid-heal (raw
@@ -767,7 +777,9 @@ class Feeder:
     def _record_window(self, path: str, nbytes: int, seconds: float) -> None:
         M.WINDOW_PATH_TOTAL.labels(path=path).inc()
         if seconds > 0:
-            M.WINDOW_GBPS.observe(nbytes / seconds / 1e9)
+            # Exemplar: a slow-throughput bucket names the window's trace.
+            M.WINDOW_GBPS.observe(nbytes / seconds / 1e9,
+                                  exemplar=tracing.trace_id())
         span = tracing.current()
         if span is not None:
             span.attrs["path"] = path

@@ -102,9 +102,16 @@ class LeaseTable:
                 return None
             if not lease.expiry_counted:
                 lease.expiry_counted = True
-                from oim_tpu.common import metrics as M
+                from oim_tpu.common import events, metrics as M
 
                 M.LEASE_EXPIRIES.inc()
+                # Flight recorder: the live->expired transition is THE
+                # control-plane incident behind proxy fast-fails, feeder
+                # failovers, and routers dropping a replica — stamped
+                # with whatever trace first observed it stale.
+                events.emit(events.LEASE_EXPIRED, path=path,
+                            overdue_s=round(overdue, 3),
+                            ttl_s=round(lease.ttl, 3))
             return overdue
 
     def remaining(self, path: str) -> float | None:

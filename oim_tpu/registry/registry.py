@@ -33,6 +33,7 @@ from oim_tpu.common.pathutil import (
     REGISTRY_ADDRESS,
     REGISTRY_MESH,
     REGISTRY_SERVE,
+    REGISTRY_TELEMETRY,
     path_has_prefix,
     split_registry_path,
 )
@@ -101,19 +102,36 @@ class RegistryService(RegistryServicer):
 
     @staticmethod
     def _may_set(peer: str, path_parts: list[str]) -> bool:
-        """Reference registry.go:100-109, extended with the mesh key and
-        the serving tier's ``serve/<id>`` load rows."""
+        """Reference registry.go:100-109, extended with the mesh key, the
+        serving tier's ``serve/<id>`` load rows, and the observability
+        plane's ``telemetry/<id>`` rows."""
         if peer == "user.admin":
             return True
+        if len(path_parts) == 2 and path_parts[0] == REGISTRY_TELEMETRY:
+            # The serve/ reservation pattern, extended: ANY authenticated
+            # identity may publish a telemetry row, but only under its
+            # OWN id (or a dot-suffixed variant, for several processes on
+            # one host: telemetry/host-0.feeder) — no daemon can overwrite
+            # another's row and redirect `oimctl --top` scrapes.
+            owner = next(
+                (peer[len(prefix):]
+                 for prefix in ("controller.", "host.", "component.")
+                 if peer.startswith(prefix)),
+                "")
+            row_id = path_parts[1]
+            return bool(owner) and (
+                row_id == owner or row_id.startswith(owner + "."))
         if peer.startswith("controller."):
             controller_id = peer[len("controller."):]
             return (
                 len(path_parts) == 2
                 and path_parts[0] == controller_id
-                # "serve" is reserved for replica rows: a controller named
-                # serve could otherwise write serve/address — and its
-                # Heartbeat would prefix-renew EVERY replica's lease.
-                and controller_id != REGISTRY_SERVE
+                # "serve" and "telemetry" are reserved namespaces: a
+                # controller named serve could otherwise write
+                # serve/address — and its Heartbeat would prefix-renew
+                # EVERY replica's lease (same hole for telemetry rows).
+                and controller_id not in (REGISTRY_SERVE,
+                                          REGISTRY_TELEMETRY)
                 and path_parts[1] in (REGISTRY_ADDRESS, REGISTRY_MESH)
             )
         if peer.startswith("host.") and len(path_parts) == 2 \
@@ -253,14 +271,15 @@ class RegistryService(RegistryServicer):
                 grpc.StatusCode.INVALID_ARGUMENT,
                 f"controller_id {request.controller_id!r} is a path, not an id",
             )
-        if request.controller_id == REGISTRY_SERVE:
-            # Renewal is prefix-scoped: a "serve" heartbeat would renew
-            # EVERY replica row's lease at once. Replica rows renew by
-            # re-publishing their load snapshot (serve/registration.py).
+        if request.controller_id in (REGISTRY_SERVE, REGISTRY_TELEMETRY):
+            # Renewal is prefix-scoped: a "serve"/"telemetry" heartbeat
+            # would renew EVERY row's lease in that namespace at once.
+            # Those rows renew by re-publishing their snapshot
+            # (common/telemetry.py RegistryRowPublisher).
             context.abort(
                 grpc.StatusCode.INVALID_ARGUMENT,
-                f"{REGISTRY_SERVE!r} is a reserved namespace, not a "
-                "controller id",
+                f"{request.controller_id!r} is a reserved namespace, not "
+                "a controller id",
             )
         if not (peer == "user.admin"
                 or peer == f"controller.{request.controller_id}"):

@@ -300,6 +300,10 @@ class ReplicationManager:
                     self.leases.grant(path, self.boot_grace_seconds)
         M.REGISTRY_PROMOTIONS.inc()
         M.REGISTRY_ROLE.set(1.0)
+        from oim_tpu.common import events
+
+        events.emit(events.REGISTRY_PROMOTION, epoch=epoch,
+                    reason=reason or "admin")
         # The outage-sized lag that triggered the promotion must not keep
         # exporting from the new primary (it would alert forever).
         M.REPL_LAG_RECORDS.set(0.0)
@@ -331,6 +335,10 @@ class ReplicationManager:
         self._wake.set()  # follow the new primary NOW, not a sleep later
         if was_primary:
             M.REGISTRY_ROLE.set(0.0)
+            from oim_tpu.common import events
+
+            events.emit(events.REGISTRY_DEMOTION, epoch=self.epoch,
+                        reason=reason or f"peer epoch {peer_epoch}")
             from_context().warning(
                 "demoted to STANDBY", epoch=self.epoch,
                 reason=reason or f"peer epoch {peer_epoch}")

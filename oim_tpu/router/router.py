@@ -45,7 +45,7 @@ import threading
 
 import grpc
 
-from oim_tpu.common import channelpool, metrics as M, tracing
+from oim_tpu.common import channelpool, events, metrics as M, tracing
 from oim_tpu.common.identity import IdentityService
 from oim_tpu.common.interceptors import LogServerInterceptor
 from oim_tpu.common.logging import from_context
@@ -223,6 +223,14 @@ class RouterService:
                         M.ROUTER_RETRIES_TOTAL.inc()
                         M.ROUTER_REQUESTS_TOTAL.labels(
                             replica=rid, outcome="retried").inc()
+                        # Flight recorder: THE event behind "why was this
+                        # request's first token slow" — stamped with the
+                        # request's trace_id (the hop span's), so
+                        # /debug/events?trace=<id> surfaces it.
+                        events.emit(events.ROUTER_RETRY,
+                                    trace_id=span.trace_id, replica=rid,
+                                    code=err.code().name,
+                                    attempt=attempt + 1)
                         log.warning(
                             "retrying on next replica", replica=rid,
                             code=err.code().name)

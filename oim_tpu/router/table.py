@@ -30,7 +30,7 @@ import time
 
 import grpc
 
-from oim_tpu.common import channelpool, metrics as M
+from oim_tpu.common import channelpool, events, metrics as M
 from oim_tpu.common.endpoints import FAILOVER_CODES, RegistryEndpoints
 from oim_tpu.common.logging import from_context
 from oim_tpu.common.tlsutil import TLSConfig
@@ -190,6 +190,8 @@ class ReplicaTable:
             count = sum(1 for r in self._replicas.values()
                         if r.replica_id not in self._failed)
         M.ROUTER_REPLICAS.set(count)
+        events.emit(events.ROUTER_MARK_FAILED, replica=replica_id,
+                    routable=count)
 
     def __len__(self) -> int:
         return len(self.replicas())

@@ -39,7 +39,7 @@ from typing import Any
 
 import numpy as np
 
-from oim_tpu.common import metrics as M, tracing
+from oim_tpu.common import events, metrics as M, tracing
 from oim_tpu.common.logging import from_context
 from oim_tpu.models.llama import Config
 
@@ -263,7 +263,11 @@ class ServeEngine:
             self._draining = True
             if not drain:
                 self._stopping = True
+            active = sum(s is not None for s in self._slots)
+            queued = len(self._pending)
             self._work.notify()
+        events.emit(events.REPLICA_DRAIN, graceful=drain,
+                    active_slots=active, queued=queued)
         self._thread.join(timeout=timeout)
 
     @property
@@ -335,6 +339,9 @@ class ServeEngine:
         for i, req in enumerate(self._slots):
             if req is not None:
                 self._slots[i] = None
+                events.emit(events.SLOT_EVICTED,
+                            trace_id=self._trace_id(req), slot=i,
+                            reason=reason, tokens=req.emitted)
                 self._finish(req, reason)
         self._occupancy()
 
@@ -356,10 +363,19 @@ class ServeEngine:
         M.SERVE_QPS.set(
             len(self._completions) / max(span, self.QPS_WINDOW_S / 2))
 
+    @staticmethod
+    def _trace_id(req: _Request) -> str:
+        return req.trace_ctx.trace_id if req.trace_ctx is not None else ""
+
     def _emit(self, req: _Request, token: int) -> None:
         now = time.monotonic()
         base = req.last_emit_at or req.submitted_at
-        M.SERVE_TOKEN_LATENCY.observe(now - base)
+        # kind splits the SLO (submit->first token) from decode cadence;
+        # the request's trace_id rides the bucket as an OpenMetrics
+        # exemplar, so a slow p99 bucket names a concrete request.
+        kind = "first" if req.emitted == 0 else "next"
+        M.SERVE_TOKEN_LATENCY.labels(kind=kind).observe(
+            now - base, self._trace_id(req))
         M.SERVE_TOKENS_TOTAL.inc()
         req.last_emit_at = now
         req.emitted += 1
@@ -433,6 +449,12 @@ class ServeEngine:
             return False
         with self._lock:
             self._slots[slot] = None
+        if reason == "cancelled":
+            # Normal retirement (eos/length) is the steady state, not an
+            # incident; an eviction by client cancel/deadline is what the
+            # flight recorder exists to explain.
+            events.emit(events.SLOT_EVICTED, trace_id=self._trace_id(req),
+                        slot=slot, reason=reason, tokens=req.emitted)
         self._occupancy()
         self._finish(req, reason)
         return True
@@ -466,6 +488,9 @@ class ServeEngine:
             if req.cancelled.is_set():
                 with self._lock:
                     self._slots[i] = None
+                events.emit(events.SLOT_EVICTED,
+                            trace_id=self._trace_id(req), slot=i,
+                            reason="cancelled", tokens=req.emitted)
                 self._occupancy()
                 self._finish(req, "cancelled")
                 continue

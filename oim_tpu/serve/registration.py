@@ -12,24 +12,21 @@ out of sync with the advertised load. Dead replicas vanish from
 lease-filtered); a draining replica flips ``ready: false`` one beat
 early so routers rotate away before the listener dies.
 
-The loop inherits the controller's outage posture: jittered exponential
-backoff, registry endpoint rotation on UNAVAILABLE/FAILED_PRECONDITION
-(replicated pair), pooled channels with transport-failure eviction.
+The publish-and-renew loop itself — jittered backoff, registry endpoint
+rotation, pooled channels, the monotonic ``beat`` stamp, delete-on-stop
+— is the shared ``common/telemetry.py RegistryRowPublisher`` (this
+module invented it; the observability plane's ``telemetry/<id>`` rows
+ride the same base).
 """
 
 from __future__ import annotations
 
-import json
-import random
-import threading
-
 import grpc
 
 from oim_tpu.common import channelpool
-from oim_tpu.common.endpoints import FAILOVER_CODES, RegistryEndpoints
 from oim_tpu.common.logging import from_context
+from oim_tpu.common.telemetry import RegistryRowPublisher
 from oim_tpu.common.tlsutil import TLSConfig
-from oim_tpu.spec import RegistryStub, pb
 
 # Top-level registry namespace for serving replicas: serve/<serve-id> ->
 # JSON load snapshot. Component-wise prefix semantics make GetValues
@@ -54,7 +51,7 @@ def load_snapshot(endpoint: str, engine) -> dict:
     return snap
 
 
-class ServeRegistration:
+class ServeRegistration(RegistryRowPublisher):
     """Publish-and-renew loop for one serve replica's registry row.
 
     ``start()`` runs the loop in a daemon thread; ``beat_once()`` is the
@@ -65,10 +62,7 @@ class ServeRegistration:
     the replica without waiting out the lease.
     """
 
-    # Same TTL posture as the controller: one lost beat must not expire
-    # a healthy replica, two-and-a-half do.
-    LEASE_FACTOR = 2.5
-    BACKOFF_MAX = 30.0
+    THREAD_NAME = "oim-serve-registration"
 
     def __init__(
         self,
@@ -81,53 +75,23 @@ class ServeRegistration:
         tls: TLSConfig | None = None,
         pool: channelpool.ChannelPool | None = None,
     ):
-        self.key = serve_key(serve_id)
+        super().__init__(
+            serve_key(serve_id), registry_address,
+            interval=interval, lease_seconds=lease_seconds,
+            tls=tls, pool=pool)
         self.serve_id = serve_id
         self.endpoint = endpoint
         self.engine = engine
-        self._endpoints = RegistryEndpoints(registry_address)
-        self.interval = interval
-        if lease_seconds == 0.0:
-            lease_seconds = self.LEASE_FACTOR * interval
-        self.lease_seconds = max(lease_seconds, 0.0)
-        self.tls = tls
-        self._pool = pool if pool is not None else channelpool.shared()
-        # Monotonic beat counter, stamped into every snapshot: it makes
-        # each re-publish change the row's VALUE even when the load
-        # numbers repeat, which is how the router's table tells a fresh
-        # heartbeat from the frozen row of a dead replica whose lease
-        # has not lapsed yet (table.py mark_failed).
-        self._beats = 0
-        self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
 
-    def _registry_channel(self) -> grpc.Channel:
-        return self._pool.get(
-            self._endpoints.current(), self.tls, "component.registry")
-
-    def _set(self, value: str, lease_seconds: float) -> None:
-        try:
-            RegistryStub(self._registry_channel()).SetValue(
-                pb.SetValueRequest(value=pb.Value(
-                    path=self.key, value=value,
-                    lease_seconds=lease_seconds)),
-                timeout=10.0,
-            )
-        except grpc.RpcError as err:
-            self._pool.maybe_evict(err, self._endpoints.current())
-            raise
+    def snapshot(self) -> dict:
+        return load_snapshot(self.endpoint, self.engine)
 
     def beat_once(self, ready: bool | None = None) -> dict:
         """One heartbeat: publish the current load snapshot with the
         lease. ``ready`` overrides the engine's own readiness (the
         draining announcement). Returns the published snapshot."""
-        snap = load_snapshot(self.endpoint, self.engine)
-        if ready is not None:
-            snap["ready"] = ready
-        self._beats += 1
-        snap["beat"] = self._beats
-        self._set(json.dumps(snap, sort_keys=True), self.lease_seconds)
-        return snap
+        overrides = {} if ready is None else {"ready": ready}
+        return super().beat_once(**overrides)
 
     def announce_draining(self) -> None:
         """Best-effort immediate ``ready: false`` re-publish, so routers
@@ -139,50 +103,3 @@ class ServeRegistration:
             from_context().warning(
                 "draining announcement failed", serve=self.serve_id,
                 error=err.code().name)
-
-    def start(self) -> None:
-        def loop() -> None:
-            log = from_context().with_fields(serve=self.serve_id)
-            failures = 0
-            while not self._stop.is_set():
-                try:
-                    self.beat_once()
-                    failures = 0
-                    log.debug("serve heartbeat",
-                              registry=self._endpoints.current())
-                except grpc.RpcError as err:
-                    failures += 1
-                    if (self._endpoints.multiple
-                            and err.code() in FAILOVER_CODES):
-                        target = self._endpoints.advance()
-                        log.warning("failing over to peer registry",
-                                    target=target)
-                    base = min(1.0, self.interval)
-                    delay = min(base * 2 ** (failures - 1), self.BACKOFF_MAX)
-                    delay *= 0.5 + random.random()  # noqa: S311 - jitter
-                    log.warning(
-                        "registry unreachable; backing off",
-                        error=err.details() or str(err.code()),
-                        attempt=failures, retry_s=round(delay, 3))
-                    if self._stop.wait(delay):
-                        return
-                    continue
-                if self._stop.wait(self.interval):
-                    return
-
-        self._thread = threading.Thread(
-            target=loop, name="oim-serve-registration", daemon=True)
-        self._thread.start()
-
-    def stop(self, deregister: bool = True) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
-        if deregister:
-            try:
-                # Empty value = SetValue's delete idiom: the row vanishes
-                # now instead of lingering until the lease expires.
-                self._set("", 0.0)
-            except grpc.RpcError:
-                pass  # registry down: the lease expires the row anyway

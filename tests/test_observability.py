@@ -33,12 +33,15 @@ from oim_tpu.spec import (
 )
 
 # A light Prometheus text-format grammar: every non-comment line must be
-# `name{labels} value` with quoted, escaped label values.
+# `name{labels} value`, optionally followed by an OpenMetrics exemplar
+# (` # {trace_id="..."} value timestamp`) on histogram bucket lines.
 _SAMPLE_RE = re.compile(
     r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
     r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\["\\n])*"'
     r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\["\\n])*")*\})?'
-    r' -?[0-9.eE+\-]+$')
+    r' -?[0-9.eE+\-]+'
+    r'( # \{trace_id="(?:[^"\\\n]|\\["\\n])*"\}'
+    r' -?[0-9.eE+\-]+ [0-9.]+)?$')
 
 
 def assert_valid_prometheus(text: str) -> None:
