@@ -9,7 +9,7 @@
 PY ?= python
 RUFF := $(shell command -v ruff 2>/dev/null)
 
-.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke
+.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke prefix-smoke
 
 # drift and tsan are standalone conveniences; the full pytest target
 # already runs both (SpecDrift + the TSAN stream test build in-fixture).
@@ -66,6 +66,16 @@ serve-smoke:
 # Also runs in tier-1 as tests/test_router_smoke.py.
 router-smoke:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --serve --smoke --replicas 2
+
+# Prefix-cache acceptance loop (seconds): the serve smoke with half the
+# requests opening on one shared system prompt — hit_rate > 0, cached-
+# prefill tokens saved > 0, every output (hit and miss, greedy and
+# sampled) byte-identical to solo generate(); then 2 replicas behind a
+# router, with same-prefix requests herded to the replica holding the
+# prefix (oim_router_affinity_picks_total observed). Also runs in
+# tier-1 as tests/test_prefix_smoke.py.
+prefix-smoke:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --serve --smoke --prefix-share 0.5
 
 # Observability-plane acceptance loop (seconds): in-process registry +
 # 2 serve replicas + router; one trace_id traced from a /metrics

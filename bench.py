@@ -75,6 +75,13 @@ def main(argv=None) -> int:
                              "engines in-process instead of one pinned "
                              "subprocess per replica (the default is "
                              "the deployment shape)")
+    parser.add_argument("--prefix-share", type=float, default=0.0,
+                        help="with --serve: fraction of requests opening "
+                             "with one shared system-prompt prefix; adds "
+                             "prefix_hit_rate, prefill_tokens_saved and "
+                             "hit/miss first-token percentiles to the "
+                             "report (with --smoke: the asserting prefix-"
+                             "cache + affinity-routing smoke)")
     parser.add_argument("--obs-smoke", action="store_true",
                         help="observability-plane acceptance run: one "
                              "trace_id traced from a /metrics exemplar "
@@ -107,8 +114,11 @@ def main(argv=None) -> int:
                       else router_bench(
                           args.replicas,
                           replica_procs=not args.in_process_replicas))
+        elif args.smoke:
+            extras = (prefix_smoke(args.prefix_share)
+                      if args.prefix_share > 0 else serve_smoke())
         else:
-            extras = serve_smoke() if args.smoke else serve_bench()
+            extras = serve_bench(prefix_share=args.prefix_share)
         print(json.dumps({
             "metric": "serve_qps",
             "value": extras["serve_qps"],
@@ -639,7 +649,8 @@ def bench_llama(chain_short: int, chain_long: int, profile_dir: str = "") -> dic
 
 def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
                 max_batch: int = 8, max_new: int = 16,
-                verify_all: bool = False) -> dict:
+                verify_all: bool = False, prefix_share: float = 0.0,
+                prefix_block: int = 16) -> dict:
     """Serving-plane bench: a synthetic OPEN-LOOP load (requests arrive
     on a fixed clock whether or not earlier ones finished — the arrival
     process of real traffic, not a closed feedback loop) against an
@@ -657,7 +668,15 @@ def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
     between consecutive deltas of a stream (decode cadence; deltas
     coalesce bursts, so one sample per delta). A slice of outputs is
     verified byte-identical to solo generate() runs (every output with
-    ``verify_all`` — the serve-smoke configuration)."""
+    ``verify_all`` — the serve-smoke configuration).
+
+    ``prefix_share`` opens that fraction of requests with one shared
+    system-prompt prefix (2 full prefix-cache blocks + 1 token) — the
+    production traffic shape the engine's prefix KV cache exists for.
+    The cache is pre-warmed so every shared request is a HIT, and the
+    report gains ``prefix_hit_rate``, ``prefill_tokens_saved`` (prompt
+    tokens whose K/V came from the cache instead of the model), and
+    first-token p50/p99 split by hit vs miss."""
     import threading
 
     import jax
@@ -705,7 +724,8 @@ def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
 
         # ---- open-loop load over gRPC ----------------------------------
         engine = ServeEngine(tree, cfg, max_batch=max_batch,
-                             max_seq=max_seq, queue_depth=n_requests)
+                             max_seq=max_seq, queue_depth=n_requests,
+                             prefix_block=prefix_block)
         server = serve_server("tcp://127.0.0.1:0", ServeService(engine))
         # Warmup: compile the prefill bucket + decode program outside the
         # measured window, so first-token latency is queue+prefill time,
@@ -713,17 +733,42 @@ def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
         engine.submit([1, 2, 3], max_new=2).result(timeout=300)
 
         rng = np.random.RandomState(42)
+        # The shared system prompt: 2 full prefix-cache blocks + 1 token
+        # (the +1 keeps a block boundary strictly inside the prompt, so
+        # the reusable prefix is exactly 2 blocks).
+        system = rng.randint(1, cfg.vocab,
+                             size=2 * prefix_block + 1).tolist()
+        shared_flags = [i < round(prefix_share * n_requests)
+                        for i in range(n_requests)]
+        rng.shuffle(shared_flags)
         reqs = [
             (
-                rng.randint(1, cfg.vocab, size=rng.randint(2, 9)).tolist(),
+                (system if shared_flags[i] else [])
+                + rng.randint(1, cfg.vocab,
+                              size=rng.randint(2, 9)).tolist(),
                 int(rng.randint(4, max_new + 1)),
                 0.0 if i % 2 == 0 else 0.8,
                 i,
             )
             for i in range(n_requests)
         ]
+        if any(shared_flags):
+            # Pre-warm the prefix cache: the first system-prefix request
+            # retains its blocks at retirement, the second compiles the
+            # tail-resume prefill program — so every measured shared
+            # request is a jit-free HIT (what a steady-state replica
+            # serves), not a compile.
+            engine.submit(system + [1], max_new=2).result(timeout=300)
+            engine.submit(system + [2], max_new=2).result(timeout=300)
+        from oim_tpu.common import metrics as M2
+
+        prefix_before = (
+            M2.SERVE_PREFIX_HITS.value, M2.SERVE_PREFIX_MISSES.value,
+            M2.SERVE_PREFILL_TOKENS.labels(source="cache").value)
         results: list[list[int] | None] = [None] * n_requests
         first_token_s: list[float] = []
+        first_hit_s: list[float] = []
+        first_miss_s: list[float] = []
         token_gap_s: list[float] = []
         finished_at: list[float] = []
         rejected = [0]
@@ -754,6 +799,8 @@ def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
                 with lock:
                     results[i] = toks
                     first_token_s.append(first)
+                    (first_hit_s if shared_flags[i]
+                     else first_miss_s).append(first)
                     token_gap_s.extend(gaps)
                     finished_at.append(last)
             except Exception as err:  # noqa: BLE001 - tallied below
@@ -814,7 +861,11 @@ def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
 
         pct = lambda xs, q: (  # noqa: E731
             round(float(np.percentile(xs, q)) * 1e3, 3) if xs else None)
-        return {
+        hits = M2.SERVE_PREFIX_HITS.value - prefix_before[0]
+        misses = M2.SERVE_PREFIX_MISSES.value - prefix_before[1]
+        saved = (M2.SERVE_PREFILL_TOKENS.labels(source="cache").value
+                 - prefix_before[2])
+        extras = {
             "serve_qps": round(serve_qps, 2),
             "serve_requests": n_requests,
             "serve_completed": len(completed),
@@ -831,6 +882,17 @@ def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
             "weights_cache_hit": weights_cache_hit,
             "weights_cache_hit_s": round(weights_cache_hit_s, 4),
         }
+        if prefix_share > 0:
+            extras.update({
+                "prefix_share": prefix_share,
+                "prefix_hit_rate": round(hits / max(hits + misses, 1), 4),
+                "prefill_tokens_saved": int(saved),
+                "first_token_hit_p50_ms": pct(first_hit_s, 50),
+                "first_token_hit_p99_ms": pct(first_hit_s, 99),
+                "first_token_miss_p50_ms": pct(first_miss_s, 50),
+                "first_token_miss_p99_ms": pct(first_miss_s, 99),
+            })
+        return extras
     finally:
         if server is not None:
             server.force_stop()
@@ -849,6 +911,91 @@ def serve_smoke() -> dict:
     if extras["serve_completed"] != extras["serve_requests"]:
         raise AssertionError(
             f"serve smoke dropped requests: {extras}")
+    return extras
+
+
+def prefix_smoke(prefix_share: float = 0.5) -> dict:
+    """The prefix-cache acceptance run (seconds, in-process), two halves:
+
+    1. engine — the serve smoke workload with ``prefix_share`` of the
+       requests opening on one shared system prompt: every output (hit
+       and miss, greedy and sampled) byte-identical to its solo
+       generate() run, ``prefix_hit_rate`` > 0, and cached-prefill
+       tokens actually saved (``prefill_tokens_saved`` > 0);
+    2. router — 2 replicas behind an oim-router: same-prefix requests
+       HERD to the replica that retained the prefix
+       (``oim_router_affinity_picks_total`` moves, the prefix store
+       populates on exactly one replica), still byte-identical.
+
+    The tier-1 guard wired in as tests/test_prefix_smoke.py and
+    `make prefix-smoke`."""
+    import jax
+
+    from oim_tpu.common import metrics as M
+    from oim_tpu.common import tlsutil
+    from oim_tpu.models import generate as gen, llama
+    from oim_tpu.spec import ServeStub, pb
+
+    extras = serve_bench(n_requests=12, offered_rps=24.0, max_batch=4,
+                         max_new=8, verify_all=True,
+                         prefix_share=prefix_share)
+    if extras["serve_completed"] != extras["serve_requests"]:
+        raise AssertionError(f"prefix smoke dropped requests: {extras}")
+    if not extras["prefix_hit_rate"] > 0:
+        raise AssertionError(
+            f"prefix smoke saw no cache hits: {extras}")
+    if not extras["prefill_tokens_saved"] > 0:
+        raise AssertionError(
+            f"prefix smoke saved no prefill tokens: {extras}")
+
+    # ---- router half: affinity herds same-prefix requests --------------
+    cfg = llama.tiny(vocab=64, dim=32, n_layers=2)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    shared = np.random.RandomState(11).randint(1, 64, size=20).tolist()
+    affinity_before = M.ROUTER_AFFINITY_PICKS.value
+    outs = []
+    with router_cluster(params, cfg, replicas=2, max_batch=2, max_seq=64,
+                        queue_depth=16, heartbeat_s=0.3) as (
+            router_srv, engines, regs, _pool):
+        for engine in engines:
+            engine.submit([1, 2, 3], max_new=2).result(timeout=300)
+        with tlsutil.dial(router_srv.addr, None) as channel:
+            stub = ServeStub(channel)
+            for i in range(6):
+                prompt = shared + [10 + i]
+                toks = []
+                for delta in stub.Generate(
+                        pb.GenerateRequest(prompt=prompt,
+                                           max_new_tokens=4, seed=i,
+                                           temperature=0.0 if i % 2
+                                           else 0.6),
+                        timeout=60):
+                    toks.extend(delta.tokens)
+                outs.append((prompt, 0.0 if i % 2 else 0.6, i, toks))
+                # One beat + table refresh interval lets the retained
+                # prefix reach the routing table before the next pick.
+                for reg in regs:
+                    reg.beat_once()
+                time.sleep(0.45)
+        stores = [e.prefix_stats()["entries"] for e in engines]
+    affinity_picks = M.ROUTER_AFFINITY_PICKS.value - affinity_before
+    if affinity_picks < 1:
+        raise AssertionError(
+            f"router never took an affinity pick (stores: {stores})")
+    for prompt, temp, seed, toks in outs:
+        solo = gen.generate(
+            params, np.asarray([prompt], np.int32), 4, cfg,
+            temperature=temp, rng=jax.random.PRNGKey(seed),
+            max_seq=64)[0, len(prompt):].tolist()
+        if toks != solo:
+            raise AssertionError(
+                f"routed prefix-affinity tokens diverge from solo: "
+                f"{toks} != {solo}")
+    extras.update({
+        "router_affinity_picks": int(affinity_picks),
+        "router_prefix_entries": stores,
+        "router_affinity_byte_identity": True,
+    })
     return extras
 
 

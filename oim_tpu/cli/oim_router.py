@@ -51,6 +51,19 @@ def main(argv: list[str] | None = None) -> int:
              "through a registry outage before the router answers "
              "UNAVAILABLE",
     )
+    parser.add_argument(
+        "--no-affinity", action="store_true",
+        help="disable prefix-affinity routing: ignore the hot-prefix "
+             "hashes replicas advertise and pick purely least-loaded "
+             "(affinity is otherwise a tie-break within the load guard)",
+    )
+    parser.add_argument(
+        "--affinity-guard", type=int, default=None,
+        help="how many requests of extra backlog a prefix-holding "
+             "replica may carry and still win the pick over the "
+             "least-loaded one (default 2; 0 = affinity only among "
+             "equally-loaded replicas)",
+    )
     add_common_flags(parser)
     add_observability_flags(parser)
     args = parser.parse_args(argv)
@@ -66,8 +79,11 @@ def main(argv: list[str] | None = None) -> int:
         tls=tls,
     )
     table.start()
-    server = router_server(args.endpoint, RouterService(table, tls=tls),
-                           tls=tls)
+    server = router_server(
+        args.endpoint,
+        RouterService(table, tls=tls, affinity=not args.no_affinity,
+                      affinity_guard=args.affinity_guard),
+        tls=tls)
     # "router" works insecure; under mTLS pass --telemetry-id matching
     # the dialing identity's own id (registry authz binds the row name).
     start_telemetry_row(obs, args.telemetry_id or "router", "router",

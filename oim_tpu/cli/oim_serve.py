@@ -204,6 +204,19 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--queue-depth", type=int, default=64,
                         help="bounded admission queue; full = new requests "
                              "answer RESOURCE_EXHAUSTED")
+    parser.add_argument(
+        "--prefix-cache-bytes", type=int, default=64 << 20,
+        help="byte budget for the prompt-prefix KV cache (LRU; retired "
+             "requests donate their prompt K/V, admissions with a "
+             "cached prefix prefill only the tail). 0 disables prefix "
+             "reuse")
+    parser.add_argument(
+        "--prefix-block", type=int, default=16,
+        help="tokens per prefix-cache block: prefixes are shared at "
+             "this granularity (smaller = finer reuse, more entries "
+             "and more compiled prefill programs); routers and this "
+             "replica hash identically, so the value is advertised in "
+             "the serve/<id> row")
     parser.add_argument("--stream-tokens", type=int, default=1,
                         help="token-stream granularity: the first token "
                              "flushes immediately, later deltas batch up "
@@ -253,6 +266,8 @@ def main(argv: list[str] | None = None) -> int:
         max_seq=args.max_seq,
         queue_depth=args.queue_depth,
         default_max_new=args.default_max_new,
+        prefix_cache_bytes=args.prefix_cache_bytes,
+        prefix_block=args.prefix_block,
     )
     server = serve_server(
         args.endpoint,

@@ -378,8 +378,8 @@ def top_row(row_id: str, status: str, role: str, target: str,
 
     row = {"id": row_id, "status": status, "role": role, "qps": None,
            "ft_ms": (None, None), "it_ms": (None, None), "queue": None,
-           "slots": None, "cache_hit": None, "repl_lag": None,
-           "spread": None, "events": {}}
+           "slots": None, "cache_hit": None, "prefix_hit": None,
+           "repl_lag": None, "spread": None, "events": {}}
     if status != "ALIVE" or not target:
         return row
     try:
@@ -404,6 +404,14 @@ def top_row(row_id: str, status: str, role: str, target: str,
         row["queue"] = _series_value(samples, "oim_serve_queue_depth")
         row["slots"] = _series_value(
             samples, "oim_serve_slot_occupancy")
+        # Prompt-prefix KV cache hit rate; "-" until the replica has
+        # admitted anything — and for pre-prefix-cache replicas, whose
+        # scrapes simply lack the series (UNSCRAPEABLE-safe like every
+        # other column).
+        phits = _series_value(samples, "oim_serve_prefix_hits_total")
+        pmiss = _series_value(samples, "oim_serve_prefix_misses_total")
+        if phits is not None and pmiss is not None and phits + pmiss > 0:
+            row["prefix_hit"] = phits / (phits + pmiss)
     hits = _series_value(samples, "oim_stage_cache_hits_total")
     misses = _series_value(samples, "oim_stage_cache_misses_total")
     if hits is not None and misses is not None and hits + misses > 0:
@@ -441,7 +449,7 @@ def render_top(rows: list[dict]) -> str:
 
     headers = ("ID", "ROLE", "STATUS", "QPS", "FIRST-TOK(ms)",
                "INTER-TOK(ms)", "QUEUE", "SLOTS", "CACHE-HIT",
-               "REPL-LAG", "SPREAD", "EVENTS")
+               "PREFIX-HIT", "REPL-LAG", "SPREAD", "EVENTS")
     table = [headers]
     for r in rows:
         top_events = sorted(r["events"].items(),
@@ -450,7 +458,9 @@ def render_top(rows: list[dict]) -> str:
             r["id"], r["role"], r["status"], fmt(r["qps"]),
             fmt_pair(r["ft_ms"]), fmt_pair(r["it_ms"]),
             fmt(r["queue"], "{:g}"), fmt(r["slots"]),
-            fmt(r["cache_hit"], "{:.0%}"), fmt(r["repl_lag"], "{:g}"),
+            fmt(r["cache_hit"], "{:.0%}"),
+            fmt(r.get("prefix_hit"), "{:.0%}"),
+            fmt(r["repl_lag"], "{:g}"),
             fmt(r["spread"], "{:g}"),
             ",".join(f"{t}:{n}" for t, n in top_events) or "-",
         ))

@@ -22,3 +22,15 @@ from oim_tpu.common.pathutil import (  # noqa: F401
 )
 from oim_tpu.common.server import NonBlockingGRPCServer, parse_endpoint  # noqa: F401
 from oim_tpu.common.keymutex import KeyMutex  # noqa: F401
+
+
+def looks_oom(exc: Exception) -> bool:
+    """Whether an exception smells like device memory pressure — THE
+    heuristic every allocation valve keys on (the stage cache's
+    evict-idle-and-retry, the prefix cache's evict-all-and-retry). One
+    definition, because a message recognized by one valve but not
+    another turns a graceful degrade into a dead daemon: XLA surfaces
+    allocator failures as RESOURCE_EXHAUSTED or "out of memory" text."""
+    text = str(exc)
+    return "RESOURCE_EXHAUSTED" in text or "Out of memory" in text \
+        or "out of memory" in text

@@ -449,6 +449,40 @@ SERVE_TOKEN_LATENCY = DEFAULT.histogram(
     labelnames=("kind",),
     buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
              1.0, 2.5))
+SERVE_QUEUE_WAIT = DEFAULT.histogram(
+    "oim_serve_queue_wait_seconds",
+    "time a request spent in the admission queue before its prefill "
+    "started (the backpressure half of first-token latency; buckets "
+    "carry OpenMetrics trace_id exemplars)",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 10.0))
+# Prefix KV cache (serve/prefixcache.py): block-hashed prompt-prefix
+# reuse across requests, plus the router's affinity pick over it.
+SERVE_PREFIX_HITS = DEFAULT.counter(
+    "oim_serve_prefix_hits_total",
+    "admissions that copied a cached prompt-prefix K/V into the slot "
+    "and prefilled only the uncached tail")
+SERVE_PREFIX_MISSES = DEFAULT.counter(
+    "oim_serve_prefix_misses_total",
+    "admissions that prefilled the whole prompt (no cached prefix "
+    "block matched)")
+SERVE_PREFIX_CACHE_BYTES = DEFAULT.gauge(
+    "oim_serve_prefix_cache_bytes",
+    "K/V bytes resident in the prefix cache")
+SERVE_PREFILL_TOKENS = DEFAULT.counter(
+    "oim_serve_prefill_tokens_total",
+    "prompt tokens admitted, by how their K/V materialized: cache = "
+    "copied from the prefix store (prefill skipped), compute = forwarded "
+    "through the model",
+    labelnames=("source",))
+SERVE_FIRST_TOKEN = DEFAULT.histogram(
+    "oim_serve_first_token_seconds",
+    "submit-to-first-token latency split by prefix-cache outcome "
+    "(prefix=hit|miss), so the cache's latency win is one scrape away; "
+    "buckets carry OpenMetrics trace_id exemplars",
+    labelnames=("prefix",),
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5))
 # Request router (oim_tpu/router: least-loaded LB over serve replicas).
 ROUTER_REQUESTS_TOTAL = DEFAULT.counter(
     "oim_router_requests_total",
@@ -464,6 +498,11 @@ ROUTER_RETRIES_TOTAL = DEFAULT.counter(
 ROUTER_REPLICAS = DEFAULT.gauge(
     "oim_router_replicas",
     "ready serve replicas in the router's lease-filtered routing table")
+ROUTER_AFFINITY_PICKS = DEFAULT.counter(
+    "oim_router_affinity_picks_total",
+    "picks herded to a replica advertising the request's prompt-prefix "
+    "hash instead of the plain least-loaded choice (only taken when the "
+    "holder's backlog is within the affinity load guard)")
 # Flight recorder (common/events.py): typed control-plane events with
 # trace_id stamps; the counter survives ring wrap, the ring itself is
 # served at /debug/events.

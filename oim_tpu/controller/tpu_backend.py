@@ -102,9 +102,9 @@ class TPUBackend(MallocBackend):
 
     @staticmethod
     def _looks_oom(exc: Exception) -> bool:
-        text = str(exc)
-        return "RESOURCE_EXHAUSTED" in text or "Out of memory" in text \
-            or "out of memory" in text
+        from oim_tpu.common import looks_oom
+
+        return looks_oom(exc)
 
     def stage(self, volume: StagedVolume, params_kind: str, params: Any) -> None:
         def work_plane(src, keyinfo) -> None:

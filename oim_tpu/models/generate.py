@@ -134,7 +134,8 @@ def cached_forward(params, tokens, cache, pos, cfg: Config):
 # cached-forward implementation to keep correct.
 
 
-def prefill_into_slot(params, tokens, n_tokens, cache, slot, cfg: Config):
+def prefill_into_slot(params, tokens, n_tokens, cache, slot, cfg: Config,
+                      prefix=None, prefix_len=None):
     """Prefill ``tokens`` [1, T] (first ``n_tokens`` real, rest pad — the
     engine buckets prompt lengths so one compiled program serves many) into
     batch row ``slot`` of the shared cache.
@@ -146,11 +147,40 @@ def prefill_into_slot(params, tokens, n_tokens, cache, slot, cfg: Config):
     K/V zeroed before the slot is written back: the causal mask keeps them
     out of the prefill's own logits, but later decode steps WOULD attend
     to them (pad positions fall below the advancing decode position).
+
+    ``prefix`` is the resume path (the serve engine's prefix KV cache):
+    ``{"k","v"}`` of [L, P_pad, kv_heads, head_dim] — K/V already
+    computed for the request's first ``prefix_len`` prompt tokens
+    (``prefix_len`` defaults to the array length; the engine pads the
+    operand to a power-of-two bucket and passes the real length as a
+    traced scalar, so ONE compiled program serves every prefix depth in
+    the bucket instead of one per depth). The cached rows are copied
+    into the fresh slot cache verbatim and ``tokens`` then holds only
+    the UNCACHED TAIL, forwarded from start position ``prefix_len``
+    (pad rows beyond it are overwritten by the tail / zeroed by the
+    keep mask). K/V at a prompt position is a pure function of the
+    tokens at and before it (causal attention, absolute-position RoPE
+    from 0), so reused prefix bytes are exactly what a full prefill
+    would have recomputed — the byte-identity invariant survives the
+    skip. The engine relies on the same shape-independence the bucketed
+    full prefill already pins: forwarding the tail at its own bucket
+    length produces the same bytes per real position as one pass over
+    the whole prompt.
     """
     S = cache["k"].shape[2]
     sub = init_cache(cfg, 1, S)
-    logits, sub = cached_forward(params, tokens, sub, 0, cfg)
-    keep = (jnp.arange(S) < n_tokens)[None, None, :, None, None]
+    start = 0
+    if prefix is not None:
+        start = prefix["k"].shape[1] if prefix_len is None else prefix_len
+        # Verbatim copy into positions [0, P_pad) of the fresh slot
+        # cache — no arithmetic touches the cached bytes.
+        sub = {
+            name: lax.dynamic_update_slice_in_dim(
+                sub[name], prefix[name][:, None], 0, axis=2)
+            for name in ("k", "v")
+        }
+    logits, sub = cached_forward(params, tokens, sub, start, cfg)
+    keep = (jnp.arange(S) < start + n_tokens)[None, None, :, None, None]
     cache = {
         name: lax.dynamic_update_slice_in_dim(
             cache[name], jnp.where(keep, sub[name], 0), slot, axis=1)

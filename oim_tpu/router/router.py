@@ -10,6 +10,20 @@ candidates are sampled and the one with fewer router-local in-flight
 streams wins — the classic balls-into-bins result, which keeps a fleet
 of routers from herding onto one replica between heartbeats.
 
+Prefix affinity — a TIE-BREAK on top of that, never a hotspot
+generator: each replica's heartbeat row advertises its hot prefix-cache
+chain hashes (serve/registration.py); the router hashes the request's
+prompt the same way (common/prefixhash.py — both sides MUST agree) and
+prefers the replica holding the LONGEST advertised prefix of it, but
+only while that holder's score is within ``affinity_guard`` of the
+least-loaded pick. Beyond the guard — or when the holder is drained
+(ready:false), lease-lapsed, or marked failed — the pick falls back to
+plain least-loaded: a popular system prompt must not stack every
+request on one replica, and the pre-first-token retry contract is
+unchanged (a retry excludes the tried holder and re-picks). Replicas
+that advertise nothing (prefix cache off, pre-upgrade build) stay fully
+routable; they just never attract affinity.
+
 Retry contract — before the first token delta ONLY: a replica answering
 ``RESOURCE_EXHAUSTED`` (admission queue full) or ``UNAVAILABLE``
 (dead/draining) is retried once on the NEXT replica by score, and
@@ -45,7 +59,13 @@ import threading
 
 import grpc
 
-from oim_tpu.common import channelpool, events, metrics as M, tracing
+from oim_tpu.common import (
+    channelpool,
+    events,
+    metrics as M,
+    prefixhash,
+    tracing,
+)
 from oim_tpu.common.identity import IdentityService
 from oim_tpu.common.interceptors import LogServerInterceptor
 from oim_tpu.common.logging import from_context
@@ -74,15 +94,26 @@ class RouterService:
         grpc.StatusCode.UNAVAILABLE,
     )
 
+    # A prefix holder wins the pick only while its score (advertised
+    # backlog + router-local in-flight) is within this many requests of
+    # the least-loaded candidate's — the line between "reuse the cache"
+    # and "pile onto the replica everyone's system prompt lives on".
+    AFFINITY_GUARD = 2
+
     def __init__(
         self,
         table: ReplicaTable,
         tls: TLSConfig | None = None,
         pool: channelpool.ChannelPool | None = None,
         upstream_lanes: int = 4,
+        affinity: bool = True,
+        affinity_guard: int | None = None,
     ):
         self.table = table
         self.tls = tls
+        self.affinity = affinity
+        self.affinity_guard = (self.AFFINITY_GUARD if affinity_guard is None
+                               else affinity_guard)
         self._pool = pool if pool is not None else channelpool.shared()
         # A replica hosts max_batch concurrent streams from this router;
         # laid on ONE HTTP/2 connection they serialize on its single
@@ -102,25 +133,86 @@ class RouterService:
     def _score(self, replica: Replica, inflight: int) -> int:
         return replica.queue_depth - replica.free_slots + inflight
 
-    def pick(self, exclude: frozenset | set = frozenset()) -> Replica | None:
+    def pick(self, exclude: frozenset | set = frozenset(),
+             prompt=None, prefix_len: int = 0) -> Replica | None:
         """The least-loaded routable replica (power-of-two-choices among
-        ties), or None when nothing is routable."""
+        ties), or None when nothing is routable. With a ``prompt`` (and
+        affinity enabled), a replica advertising the longest cached
+        prefix of it wins instead — if its score is within the load
+        guard of the least-loaded pick."""
+        replica, _ = self._pick(exclude, prompt, prefix_len)
+        return replica
+
+    @staticmethod
+    def _request_hashes(candidates, prompt, prefix_len: int,
+                        cache: dict) -> dict:
+        """Fill ``cache`` with the request's chain hashes, one list per
+        advertised block size (usable_hashes mirrors the engine's
+        admission lookup: full blocks, >= 1 token left to prefill;
+        ``prefix_len`` caps the hashed prefix to the part the client
+        declared shared). Computed BEFORE the pick lock — sha256 over a
+        long prompt is CPU work no other request's pick should
+        serialize behind — and the caller keeps the cache for the whole
+        request, so a pre-first-token retry's re-pick never re-hashes."""
+        for r in candidates:
+            if r.prefix_block < 1 or not r.prefix_hashes \
+                    or r.prefix_block in cache:
+                continue
+            hashes = prefixhash.usable_hashes(prompt, r.prefix_block)
+            if prefix_len > 0:
+                hashes = hashes[:prefix_len // r.prefix_block]
+            cache[r.prefix_block] = hashes
+        return cache
+
+    @staticmethod
+    def _match_blocks(replica: Replica, hash_cache: dict) -> int:
+        """How many leading blocks of the request's prompt this replica
+        advertises (0 = no affinity)."""
+        hashes = hash_cache.get(replica.prefix_block, ())
+        for i in range(len(hashes) - 1, -1, -1):
+            if hashes[i] in replica.prefix_hashes:
+                return i + 1
+        return 0
+
+    def _pick(self, exclude: frozenset | set = frozenset(),
+              prompt=None, prefix_len: int = 0,
+              hash_cache: dict | None = None
+              ) -> tuple[Replica | None, bool]:
+        """(replica, was_affinity_pick); the one pick implementation.
+        ``hash_cache`` is the per-request hash memo (block size ->
+        chain hashes) — _route passes one dict across retry attempts."""
         candidates = [r for r in self.table.replicas()
                       if r.replica_id not in exclude]
         if not candidates:
-            return None
+            return None, False
+        affine = self.affinity and bool(prompt)
+        hash_cache = hash_cache if hash_cache is not None else {}
+        if affine:
+            self._request_hashes(candidates, prompt, prefix_len,
+                                 hash_cache)
         with self._lock:
             scored = [(self._score(r, self._inflight[r.replica_id]), r)
                       for r in candidates]
             best = min(score for score, _ in scored)
+            if affine and hash_cache:
+                # Longest advertised prefix wins; ties on match length
+                # go to the lower score, so two holders of one hot
+                # prefix still balance between themselves.
+                neg_blocks, score, i = min(
+                    (-self._match_blocks(r, hash_cache), score, i)
+                    for i, (score, r) in enumerate(scored)
+                )
+                if neg_blocks < 0 and score <= best + self.affinity_guard:
+                    M.ROUTER_AFFINITY_PICKS.inc()
+                    return scored[i][1], True
             ties = [r for score, r in scored if score == best]
             if len(ties) == 1:
-                return ties[0]
+                return ties[0], False
             two = random.sample(ties, 2)  # noqa: S311 - load balancing
             counts = [self._inflight[r.replica_id] for r in two]
         if counts[0] != counts[1]:
-            return two[0] if counts[0] < counts[1] else two[1]
-        return random.choice(two)  # noqa: S311 - load balancing
+            return (two[0] if counts[0] < counts[1] else two[1]), False
+        return random.choice(two), False  # noqa: S311 - load balancing
 
     # -- the streaming pass-through ---------------------------------------
 
@@ -132,14 +224,19 @@ class RouterService:
         # cannot rely on the server interceptor's ambient contextvar
         # (same stance as the registry's transparent proxy).
         parent = tracing.extract(context.invocation_metadata())
+        prompt, prefix_len = None, 0
         try:
-            prompt_tokens = len(pb.GenerateRequest.FromString(request).prompt)
+            parsed = pb.GenerateRequest.FromString(request)
+            prompt = list(parsed.prompt)
+            prefix_len = parsed.prefix_len
+            prompt_tokens = len(prompt)
         except Exception:  # noqa: BLE001 - malformed request: let the
             prompt_tokens = -1  # replica answer with the real parse error
         with tracing.start_span(
                 "router.generate", parent=parent,
                 prompt_tokens=prompt_tokens) as span:
-            yield from self._route(request, context, span)
+            yield from self._route(request, context, span,
+                                   prompt, prefix_len)
 
     def _one_attempt(self, replica, request, context, span):
         """Open the upstream stream and yield ('delta', bytes) items;
@@ -177,17 +274,27 @@ class RouterService:
         except grpc.RpcError as err:
             yield ("err", err)
 
-    def _route(self, request, context, span):
+    def _route(self, request, context, span, prompt=None,
+               prefix_len: int = 0):
         log = from_context()
         tried: set[str] = set()
         last_err: grpc.RpcError | None = None
+        hash_cache: dict = {}  # one hashing of the prompt per request
         for attempt in range(self.MAX_ATTEMPTS):
-            replica = self.pick(exclude=tried)
+            replica, affine = self._pick(tried, prompt, prefix_len,
+                                         hash_cache)
             if replica is None:
                 break
             tried.add(replica.replica_id)
             rid = replica.replica_id
             span.attrs["replica"] = rid
+            if affine:
+                span.attrs["affinity"] = True
+            elif "affinity" in span.attrs:
+                # A retry after an affinity pick re-picked plain
+                # least-loaded: the span must not credit the final
+                # replica with an affinity herd it didn't get.
+                span.attrs["affinity"] = False
             with self._lock:
                 self._inflight[rid] += 1
             streamed = 0  # frames forwarded (a frame = >=1 token delta)

@@ -51,13 +51,21 @@ class Replica:
     queue_depth: int = 0
     max_batch: int = 0
     ready: bool = True
+    # Prefix-cache advertisement (serve/registration.py): the replica's
+    # hot chain hashes and the block size they were hashed at. Empty /
+    # 0 for replicas that predate the prefix cache (or run with it
+    # disabled) — they stay routable, just never attract affinity.
+    prefix_block: int = 0
+    prefix_hashes: frozenset = frozenset()
 
     @classmethod
     def parse(cls, path: str, value: str) -> "Replica | None":
         """A ``serve/<id>`` row -> Replica; None for rows that cannot
         route (malformed JSON, missing endpoint, non-numeric load
         fields) — a bad registration must not crash the table (or the
-        poll thread above it), just not receive traffic."""
+        poll thread above it), just not receive traffic. A malformed
+        prefix advertisement only disables affinity for the replica
+        (the load fields still route it)."""
         parts = path.split("/")
         if len(parts) != 2:
             return None
@@ -68,6 +76,14 @@ class Replica:
         if not isinstance(snap, dict) or not snap.get("endpoint"):
             return None
         try:
+            block = int(snap.get("prefix_block", 0))
+            hashes = snap.get("prefix_hashes", ())
+            if block < 1 or not isinstance(hashes, (list, tuple)) \
+                    or not all(isinstance(h, str) for h in hashes):
+                block, hashes = 0, ()
+        except (TypeError, ValueError):
+            block, hashes = 0, ()
+        try:
             return cls(
                 replica_id=parts[1],
                 endpoint=str(snap["endpoint"]),
@@ -75,6 +91,8 @@ class Replica:
                 queue_depth=int(snap.get("queue_depth", 0)),
                 max_batch=int(snap.get("max_batch", 0)),
                 ready=bool(snap.get("ready", True)),
+                prefix_block=block,
+                prefix_hashes=frozenset(hashes),
             )
         except (TypeError, ValueError):
             return None

@@ -15,6 +15,15 @@ admit-if-free-slot, one device step, emit — no locks are held across the
 device dispatch, and token streams drain through per-request queues so a
 slow consumer never stalls the batch.
 
+Prompt-prefix KV reuse (serve/prefixcache.py): a retiring slot donates
+its prompt's full-block K/V to a content-addressed prefix store (chain
+hashes at ``prefix_block`` granularity, LRU under ``prefix_cache_bytes``
+with the stage cache's OOM valve); an admission copies the longest
+cached prefix into the fresh slot and prefills only the uncached tail —
+shared system prompts stop being re-prefilled per request, without
+changing a single output token (prefix K/V is a pure function of the
+prefix token chain).
+
 Invariants the tests pin (tests/test_serve.py):
 * outputs are byte-identical to a solo ``generate()`` run per request —
   admission order, batch-mates, and slot reuse must not change a single
@@ -39,9 +48,10 @@ from typing import Any
 
 import numpy as np
 
-from oim_tpu.common import events, metrics as M, tracing
+from oim_tpu.common import events, looks_oom, metrics as M, prefixhash, tracing
 from oim_tpu.common.logging import from_context
 from oim_tpu.models.llama import Config
+from oim_tpu.serve.prefixcache import PrefixStore
 
 
 class QueueFull(Exception):
@@ -74,6 +84,9 @@ class _Request:
     emitted: int = 0
     last_emit_at: float = 0.0
     trace_ctx: Any = None
+    # Prompt tokens whose K/V came from the prefix cache (0 = the whole
+    # prompt was prefilled): the per-request hit record.
+    prefix_tokens: int = 0
 
 
 class GenHandle:
@@ -113,6 +126,7 @@ class GenHandle:
             if r.admitted_at else 0.0,
             "tokens": r.emitted,
             "finish_reason": r.finish_reason,
+            "prefix_tokens": r.prefix_tokens,
         }
 
 
@@ -124,6 +138,10 @@ class ServeEngine:
     # prompt length (the pad tail's K/V is zeroed by prefill_into_slot).
     MIN_PREFILL_BUCKET = 8
 
+    # How many hot chain hashes a replica advertises in its heartbeat
+    # row for the router's prefix-affinity pick (serve/registration.py).
+    ADVERTISE_PREFIXES = 16
+
     def __init__(
         self,
         params,
@@ -132,6 +150,8 @@ class ServeEngine:
         max_seq: int = 256,
         queue_depth: int = 64,
         default_max_new: int = 64,
+        prefix_cache_bytes: int = 64 << 20,
+        prefix_block: int = 16,
     ):
         import jax
         import jax.numpy as jnp
@@ -147,6 +167,15 @@ class ServeEngine:
         self.max_seq = max_seq
         self.queue_depth = queue_depth
         self.default_max_new = default_max_new
+        # Prompt-prefix KV reuse (serve/prefixcache.py): retired slots
+        # donate their prompt's full-block K/V, admissions copy the
+        # longest cached prefix and prefill only the tail. 0 bytes (or
+        # block < 1) disables it.
+        self.prefix_block = max(1, int(prefix_block))
+        self._prefix = (
+            PrefixStore(prefix_cache_bytes, self.prefix_block)
+            if prefix_cache_bytes > 0 and int(prefix_block) >= 1
+            else None)
         self.params = jax.tree.map(jnp.asarray, params)
         self._cache = gen.init_cache(cfg, max_batch, max_seq)
 
@@ -192,6 +221,28 @@ class ServeEngine:
         # static); buckets are powers of two, so log2(max_seq) programs
         # cover every admissible prompt.
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
+
+        def prefill_resume(params, cache, tokens, n_tokens, slot, key,
+                           temp, pk, pv, prefix_len):
+            last, cache = gen.prefill_into_slot(
+                params, tokens, n_tokens, cache, slot, cfg,
+                prefix={"k": pk, "v": pv}, prefix_len=prefix_len)
+            carry, sub = jax.random.split(key)
+            safe = jnp.where(temp > 0, temp, 1.0)
+            sampled = jax.random.categorical(sub, (last / safe)[None, :])[0]
+            tok = jnp.where(
+                temp > 0, sampled, jnp.argmax(last)).astype(jnp.int32)
+            return tok, cache, carry
+
+        # The prefix-cache-hit admission: ``tokens`` is only the UNCACHED
+        # TAIL (bucketed like the full path), pk/pv the cached prefix K/V
+        # copied in verbatim — PADDED to a power-of-two bucket, with the
+        # real prefix depth a traced scalar, so the program count is
+        # (tail buckets x prefix buckets), log x log, not one compile
+        # per distinct prefix depth stalling the admission path. The
+        # RNG chain is untouched: one split after prefill, exactly as
+        # the full path and solo generate() do.
+        self._prefill_resume = jax.jit(prefill_resume, donate_argnums=(1,))
 
         # Per-slot host state (the scheduler's view; device state is the
         # cache + whatever the last step returned).
@@ -295,6 +346,22 @@ class ServeEngine:
                 "ready": not (self._draining or self._stopping),
             }
 
+    def hot_prefixes(self, n: int | None = None) -> list[str]:
+        """The hottest cached chain hashes (MRU first) — what the
+        heartbeat re-publish advertises so the router can herd
+        same-prefix requests here. Empty when the cache is disabled."""
+        if self._prefix is None:
+            return []
+        return self._prefix.hot(self.ADVERTISE_PREFIXES if n is None
+                                else n)
+
+    def prefix_stats(self) -> dict:
+        """Prefix-store census (tests, debugging); zeros when disabled."""
+        if self._prefix is None:
+            return {"entries": 0, "bytes": 0, "capacity_bytes": 0,
+                    "block": self.prefix_block}
+        return self._prefix.stats()
+
     # -- engine loop --------------------------------------------------------
 
     def _run(self) -> None:
@@ -376,6 +443,13 @@ class ServeEngine:
         kind = "first" if req.emitted == 0 else "next"
         M.SERVE_TOKEN_LATENCY.labels(kind=kind).observe(
             now - base, self._trace_id(req))
+        if kind == "first":
+            # The prefix cache's latency win, one scrape away: the same
+            # SLO latency split by whether this request's prefill
+            # skipped a cached prefix.
+            M.SERVE_FIRST_TOKEN.labels(
+                prefix="hit" if req.prefix_tokens else "miss").observe(
+                now - base, self._trace_id(req))
         M.SERVE_TOKENS_TOTAL.inc()
         req.last_emit_at = now
         req.emitted += 1
@@ -402,7 +476,6 @@ class ServeEngine:
     def _admit(self) -> None:
         """Insert queued requests into free slots (prefill between decode
         steps: new work overlaps residents' decoding at step granularity)."""
-        jnp = self._jnp
         while True:
             with self._lock:
                 free = next(
@@ -415,18 +488,26 @@ class ServeEngine:
                 self._finish(req, "cancelled")
                 continue
             req.admitted_at = time.monotonic()
+            # Admission backpressure, made visible: how long the bounded
+            # queue held this request before its prefill started (the
+            # request's trace_id rides the bucket as an exemplar).
+            M.SERVE_QUEUE_WAIT.observe(
+                req.admitted_at - req.submitted_at, self._trace_id(req))
             n = len(req.prompt)
-            padded = np.zeros((1, self._bucket(n)), np.int32)
-            padded[0, :n] = req.prompt
-            with tracing.start_span(
-                    "serve.prefill", parent=req.trace_ctx,
-                    slot=free, prompt_tokens=n):
-                tok, self._cache, key = self._prefill(
-                    self.params, self._cache, jnp.asarray(padded),
-                    jnp.int32(n), jnp.int32(free),
-                    self._jax.random.PRNGKey(req.seed),
-                    jnp.float32(req.temperature))
-                tok = int(tok)
+            chain, m = [], 0
+            if self._prefix is not None:
+                chain = prefixhash.usable_hashes(
+                    req.prompt, self.prefix_block)
+                m = self._prefix.match(chain)
+                # The bucketed tail write must stay inside the slot
+                # cache: dynamic_update_slice CLAMPS an out-of-range
+                # start, which would land the tail at the wrong
+                # positions — shorten the reused prefix instead.
+                while m and (m * self.prefix_block
+                             + self._bucket(n - m * self.prefix_block)
+                             > self.max_seq):
+                    m -= 1
+            tok, key = self._insert_slot(req, free, n, chain, m)
             self._sync_host()  # merge device state before writing the row
             self._keys[free] = np.asarray(key)
             self._tokens[free] = tok
@@ -438,6 +519,116 @@ class ServeEngine:
             self._emit(req, tok)
             self._retire_if_done(free, req, tok)
 
+    def _insert_slot(self, req: _Request, free: int, n: int,
+                     chain: list, m: int):
+        """One request's prefill into slot ``free``: the prefix-resume
+        path when ``m`` chain blocks are cached (copy their K/V, forward
+        only the tail), the full path otherwise. Device OOM while
+        MATERIALIZING the prefix operand evicts the store and falls back
+        to the full prefill (the valve fires before the donating jit
+        dispatch — past dispatch the old cache is consumed and there is
+        nothing to fall back onto, so an OOM inside the compiled prefill
+        itself is the same engine-fatal class as one in the full path).
+        Returns (first token, RNG carry)."""
+        jnp = self._jnp
+        if m:
+            inserted = self._prefill_cached(req, free, n, chain, m)
+            if inserted is not None:
+                return inserted
+        if self._prefix is not None:
+            M.SERVE_PREFIX_MISSES.inc()
+        M.SERVE_PREFILL_TOKENS.labels(source="compute").inc(n)
+        padded = np.zeros((1, self._bucket(n)), np.int32)
+        padded[0, :n] = req.prompt
+        with tracing.start_span(
+                "serve.prefill", parent=req.trace_ctx,
+                slot=free, prompt_tokens=n):
+            tok, self._cache, key = self._prefill(
+                self.params, self._cache, jnp.asarray(padded),
+                jnp.int32(n), jnp.int32(free),
+                self._jax.random.PRNGKey(req.seed),
+                jnp.float32(req.temperature))
+            return int(tok), key
+
+    def _prefill_cached(self, req: _Request, free: int, n: int,
+                        chain: list, m: int):
+        """The resume half of _insert_slot: longest-cached-prefix copy +
+        tail-only prefill. Returns None when the resume path cannot run
+        — a chain link evicted between match and gather, or device OOM
+        while assembling the prefix operand (valve: evict the store and
+        let the caller run the full prefill; the slot cache is untouched
+        at that point, so the fallback is always safe)."""
+        jnp = self._jnp
+        entries = self._prefix.gather(chain[:m])
+        if entries is None:
+            return None
+        P = m * self.prefix_block
+        try:
+            # Pad the prefix operand to its power-of-two bucket (zeros
+            # beyond P are overwritten by the tail / zeroed by the keep
+            # mask), so every prefix depth in the bucket reuses ONE
+            # compiled resume program. block_until_ready forces the
+            # assembly HERE, while falling back is still possible —
+            # past the donating jit dispatch below the old cache is
+            # consumed and an OOM is no longer recoverable.
+            pad = self._bucket(P) - P
+            blocks_k = [e.k for e in entries]
+            blocks_v = [e.v for e in entries]
+            if pad:
+                zeros = jnp.zeros(
+                    blocks_k[0].shape[:1] + (pad,)
+                    + blocks_k[0].shape[2:], blocks_k[0].dtype)
+                blocks_k.append(zeros)
+                blocks_v.append(zeros)
+            pk = jnp.concatenate(blocks_k, axis=1)
+            pv = jnp.concatenate(blocks_v, axis=1)
+            self._jax.block_until_ready((pk, pv))
+        except Exception as exc:  # noqa: BLE001 - OOM valve
+            if not looks_oom(exc):
+                raise
+            self._prefix.evict_all()
+            return None
+        tail = req.prompt[P:]
+        padded = np.zeros((1, self._bucket(len(tail))), np.int32)
+        padded[0, :len(tail)] = tail
+        with tracing.start_span(
+                "serve.prefill", parent=req.trace_ctx, slot=free,
+                prompt_tokens=n, prefix_tokens=P):
+            tok, self._cache, key = self._prefill_resume(
+                self.params, self._cache, jnp.asarray(padded),
+                jnp.int32(len(tail)), jnp.int32(free),
+                self._jax.random.PRNGKey(req.seed),
+                jnp.float32(req.temperature), pk, pv, jnp.int32(P))
+            tok = int(tok)
+        req.prefix_tokens = P
+        M.SERVE_PREFIX_HITS.inc()
+        M.SERVE_PREFILL_TOKENS.labels(source="cache").inc(P)
+        M.SERVE_PREFILL_TOKENS.labels(source="compute").inc(n - P)
+        return tok, key
+
+    def _retain_prefix(self, slot: int, req: _Request) -> None:
+        """Donate a retiring request's prompt K/V to the prefix store:
+        every FULL block of the prompt, keyed by its chain hash (blocks
+        already resident just get an LRU touch). The slot's prompt
+        region still holds exactly what prefill wrote — decode only
+        appends at positions >= len(prompt) — so the retained bytes are
+        a pure function of the prompt's token chain."""
+        if self._prefix is None:
+            return
+        hashes = prefixhash.chain_hashes(req.prompt, self.prefix_block)
+        if not hashes:
+            return
+        block = self.prefix_block
+        ck, cv = self._cache["k"], self._cache["v"]
+
+        def materialize(i):
+            # Slices are independent device buffers: they outlive the
+            # parent cache's donation to the next step.
+            return (ck[:, slot, i * block:(i + 1) * block],
+                    cv[:, slot, i * block:(i + 1) * block])
+
+        self._prefix.retain(hashes, materialize)
+
     def _retire_if_done(self, slot: int, req: _Request, token: int) -> bool:
         if req.cancelled.is_set():
             reason = "cancelled"
@@ -447,6 +638,7 @@ class ServeEngine:
             reason = "length"
         else:
             return False
+        self._retain_prefix(slot, req)
         with self._lock:
             self._slots[slot] = None
         if reason == "cancelled":
@@ -486,6 +678,7 @@ class ServeEngine:
             live = [(i, r) for i, r in enumerate(self._slots) if r is not None]
         for i, req in live:
             if req.cancelled.is_set():
+                self._retain_prefix(i, req)
                 with self._lock:
                     self._slots[i] = None
                 events.emit(events.SLOT_EVICTED,

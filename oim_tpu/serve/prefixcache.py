@@ -1,0 +1,203 @@
+"""Content-addressed prefix KV store: the serving tier's answer to the
+stage cache.
+
+Production prompt traffic is dominated by shared prefixes — system
+prompts, few-shot templates, multi-turn history — and the engine used to
+pay a full prefill for every one of them. This store retains, at slot
+retirement, the K/V a request computed for its prompt's FULL blocks
+(common/prefixhash.py chain hashing), keyed by the chain hash so ``a``
+and ``a+b`` share the ``a`` blocks; the next admission walks its own
+chain, copies the longest cached prefix into the fresh slot, and
+prefills only the uncached tail (models/generate.py ``prefill_into_slot``
+``prefix=`` resume path).
+
+Retention follows the stage cache's discipline (controller/stagecache.py):
+an LRU bounded by ``capacity_bytes`` of resident K/V, plus the
+device-OOM valve — an allocation failure while materializing blocks
+evicts every entry and retries once, so a prefix cache under HBM
+pressure degrades to a plain miss instead of killing the engine.
+
+K/V at a prompt position is a pure function of the tokens at and before
+it (causal attention, absolute-position RoPE from 0), so the retained
+bytes are exactly what a fresh prefill of the same token chain would
+recompute — reuse preserves the engine's byte-identity-to-solo pin.
+
+Visibility: oim_serve_prefix_{hits,misses}_total,
+oim_serve_prefix_cache_bytes, oim_serve_prefill_tokens_total{source}.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Sequence
+
+from oim_tpu.common import looks_oom as _looks_oom, metrics as M
+
+
+class PrefixEntry:
+    """One block of cached K/V: ``k``/``v`` are [L, block, kv_heads,
+    head_dim] device arrays covering prompt positions
+    [i*block, (i+1)*block) of the chain the key names."""
+
+    __slots__ = ("key", "k", "v", "nbytes")
+
+    def __init__(self, key: str, k: Any, v: Any):
+        self.key = key
+        self.k = k
+        self.v = v
+        self.nbytes = int(k.nbytes) + int(v.nbytes)
+
+
+class PrefixStore:
+    """Thread-safe LRU of PrefixEntry, bounded by ``capacity_bytes`` of
+    resident K/V. ``capacity_bytes=0`` disables the store (every match
+    is 0, retains are dropped) — the ``--prefix-cache-bytes 0`` off
+    switch costs nothing on the admission path."""
+
+    def __init__(self, capacity_bytes: int, block: int):
+        if block < 1:
+            raise ValueError(f"prefix block must be >= 1, got {block}")
+        self.capacity_bytes = capacity_bytes
+        self.block = block
+        self._entries: OrderedDict[str, PrefixEntry] = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+
+    # -- lookup ------------------------------------------------------------
+
+    def match(self, hashes: Sequence[str]) -> int:
+        """How many LEADING chain hashes are resident (the longest
+        cached prefix, in blocks). Touches every matched entry —
+        DEEPEST FIRST, so the chain's ROOT ends most-recently-used:
+        eviction then takes the deepest (least shared) blocks first,
+        and a root block (which every chain lookup needs) is the last
+        to go. Root-first touching would invert that and strand
+        unmatchable deep blocks behind an evicted root."""
+        with self._lock:
+            m = 0
+            for h in hashes:
+                if h not in self._entries:
+                    break
+                m += 1
+            for h in reversed(hashes[:m]):
+                self._entries.move_to_end(h)
+            return m
+
+    def gather(self, hashes: Sequence[str]) -> list[PrefixEntry] | None:
+        """The entries for a matched chain, in order; None if any link
+        was evicted since ``match`` (the caller falls back to a full
+        prefill — never a partial, misaligned copy)."""
+        with self._lock:
+            out = []
+            for h in hashes:
+                entry = self._entries.get(h)
+                if entry is None:
+                    return None
+                out.append(entry)
+            return out
+
+    # -- retention ---------------------------------------------------------
+
+    def retain(self, hashes: Sequence[str],
+               materialize: Callable[[int], tuple[Any, Any]]) -> int:
+        """Insert the missing blocks of a retiring request's chain.
+        ``materialize(i)`` produces block i's (k, v) device arrays —
+        called only for absent blocks, inside the OOM valve: an
+        allocation failure evicts the whole store and retries once, and
+        a second failure (or nothing left to evict) DROPS the retain —
+        never raises OOM to the caller, because the caller is the
+        engine loop and a prefix cache must shed load under memory
+        pressure, not kill the replica. Non-OOM errors surface.
+        Returns blocks added."""
+        added = 0
+        for i, h in enumerate(hashes):
+            with self._lock:
+                if h in self._entries:
+                    continue
+            try:
+                k, v = materialize(i)
+            except Exception as exc:  # noqa: BLE001 - OOM valve
+                if not _looks_oom(exc):
+                    raise
+                freed = self.evict_all()
+                if i > 0 or freed == 0:
+                    # Nothing to shed, or the valve just wiped this
+                    # chain's own earlier blocks: STOP — inserting the
+                    # deeper blocks alone would leave a rootless chain
+                    # match() can never hit, dead capacity until LRU
+                    # churn clears it.
+                    return 0 if i > 0 else added
+                try:
+                    k, v = materialize(i)
+                except Exception as exc2:  # noqa: BLE001 - still OOM
+                    if not _looks_oom(exc2):
+                        raise
+                    return added  # valve fired and lost: drop it
+            self._insert(PrefixEntry(h, k, v))
+            added += 1
+        # Leave the whole chain root-MRU (same stance as match): a
+        # freshly retained chain must not offer its own root as the
+        # next LRU victim.
+        with self._lock:
+            for h in reversed(hashes):
+                if h in self._entries:
+                    self._entries.move_to_end(h)
+        return added
+
+    def _insert(self, entry: PrefixEntry) -> None:
+        with self._lock:
+            if self.capacity_bytes == 0 or entry.key in self._entries:
+                return
+            if entry.nbytes > self.capacity_bytes:
+                return  # one block larger than the whole budget
+            while self._bytes + entry.nbytes > self.capacity_bytes \
+                    and self._entries:
+                self._evict_lru_locked()
+            self._entries[entry.key] = entry
+            self._bytes += entry.nbytes
+            M.SERVE_PREFIX_CACHE_BYTES.set(self._bytes)
+
+    # -- eviction ----------------------------------------------------------
+
+    def _evict_lru_locked(self) -> None:
+        _, entry = self._entries.popitem(last=False)
+        self._bytes -= entry.nbytes
+        entry.k = entry.v = None  # drop the device references now
+        M.SERVE_PREFIX_CACHE_BYTES.set(self._bytes)
+
+    def evict_all(self) -> int:
+        """Free every entry NOW (the OOM pressure valve). Returns bytes
+        freed."""
+        with self._lock:
+            freed = self._bytes
+            while self._entries:
+                self._evict_lru_locked()
+            return freed
+
+    # -- introspection -----------------------------------------------------
+
+    def hot(self, n: int) -> list[str]:
+        """The ``n`` most-recently-used chain hashes, hottest first —
+        what a replica advertises in its heartbeat row for the router's
+        prefix-affinity pick."""
+        with self._lock:
+            keys = list(self._entries.keys())
+        return keys[::-1][:n]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "capacity_bytes": self.capacity_bytes,
+                "block": self.block,
+            }
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, key: str) -> bool:
+        with self._lock:
+            return key in self._entries

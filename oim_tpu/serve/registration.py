@@ -45,9 +45,22 @@ def serve_key(serve_id: str) -> str:
 
 def load_snapshot(endpoint: str, engine) -> dict:
     """The JSON value under ``serve/<id>``: routing endpoint + the
-    engine's load counters (``ServeEngine.stats()``)."""
+    engine's load counters (``ServeEngine.stats()``) + the hot
+    prefix-cache advertisement the router's affinity pick matches
+    against. The advertisement rides the EXISTING heartbeat re-publish —
+    the row value already carries the live load snapshot, so what a
+    replica holds and how loaded it is can never drift apart, and a
+    pre-prefix-cache engine (no ``hot_prefixes``) simply publishes no
+    advertisement: routers treat it as holding nothing and route it on
+    load alone (mixed-version safe)."""
     snap = {"endpoint": endpoint}
     snap.update(engine.stats())
+    hot = getattr(engine, "hot_prefixes", None)
+    if callable(hot):
+        hashes = hot()
+        if hashes:
+            snap["prefix_block"] = engine.prefix_block
+            snap["prefix_hashes"] = list(hashes)
     return snap
 
 

@@ -131,6 +131,10 @@ class ServeService(ServeServicer):
                 reason = handle.finish_reason
                 span.attrs["outcome"] = reason
                 span.attrs["tokens"] = handle.stats["tokens"]
+                # How much prefill the prefix cache skipped (0 = miss):
+                # the span-level record behind a fast/slow first token.
+                span.attrs["prefix_tokens"] = \
+                    handle.stats["prefix_tokens"]
                 yield pb.GenerateDelta(
                     tokens=tokens, done=True, finish_reason=reason)
                 return
@@ -139,12 +143,15 @@ class ServeService(ServeServicer):
 
 
 def serve_capabilities(engine: ServeEngine) -> list[str]:
-    return [
+    caps = [
         f"max_batch:{engine.max_batch}",
         f"max_seq:{engine.max_seq}",
         f"queue_depth:{engine.queue_depth}",
         f"vocab:{engine.cfg.vocab}",
     ]
+    if engine._prefix is not None:
+        caps.append(f"prefix_block:{engine.prefix_block}")
+    return caps
 
 
 def serve_server(

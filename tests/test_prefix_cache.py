@@ -1,0 +1,576 @@
+"""Ring-1 tests for prompt-prefix KV reuse + prefix-affinity routing.
+
+The invariants this PR must hold: prefix reuse never changes a single
+output token vs a solo ``generate()`` run (greedy AND sampled, including
+a reused slot after the cached chain was evicted); the chain hash is
+block-granular and shared between ``a`` and ``a+b``; the store is an LRU
+under a byte budget with the stage cache's OOM valve; the router's
+affinity pick is a TIE-BREAK within a load guard on top of least-loaded
+(never a hotspot generator), and a replica that advertises no prefixes —
+a pre-upgrade build — stays fully routable.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from oim_tpu.common import metrics as M, prefixhash
+from oim_tpu.models import generate as gen, llama
+from oim_tpu.router.router import RouterService
+from oim_tpu.router.table import Replica
+from oim_tpu.serve import ServeEngine, load_snapshot
+from oim_tpu.serve.prefixcache import PrefixStore
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama.tiny(vocab=64, dim=32, n_layers=2)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def solo_tokens(params, cfg, prompt, n_new, temperature=0.0, seed=0,
+                max_seq=64):
+    out = gen.generate(
+        params, np.asarray([prompt], np.int32), n_new, cfg,
+        temperature=temperature, rng=jax.random.PRNGKey(seed),
+        max_seq=max_seq)
+    return out[0, len(prompt):].tolist()
+
+
+# ---------------------------------------------------------------------------
+# Chain hashing (common/prefixhash.py) — jax-free, shared by engine and
+# router, so its semantics ARE the affinity protocol.
+
+
+class TestChainHashes:
+    def test_full_blocks_only(self):
+        assert prefixhash.chain_hashes([1, 2, 3], 4) == []
+        assert len(prefixhash.chain_hashes([1, 2, 3, 4], 4)) == 1
+        assert len(prefixhash.chain_hashes([1, 2, 3, 4, 5, 6, 7], 4)) == 1
+        assert len(prefixhash.chain_hashes(list(range(12)), 4)) == 3
+
+    def test_shared_prefix_shares_hashes(self):
+        a = [5, 6, 7, 8, 1, 2, 3, 4]
+        ab = a + [9, 9, 9, 9]
+        ha, hab = (prefixhash.chain_hashes(t, 4) for t in (a, ab))
+        assert hab[:2] == ha  # `a` and `a+b` share the `a` entries
+        # ...and a different first block changes EVERY later hash (the
+        # chain covers the whole prefix, not just its own block).
+        other = [9] + a[1:] + [9, 9, 9, 9]
+        assert all(x != y for x, y in
+                   zip(prefixhash.chain_hashes(other, 4), hab))
+
+    def test_block_granularity_is_part_of_the_hash_domain(self):
+        t = list(range(8))
+        assert prefixhash.chain_hashes(t, 4)[0] != \
+            prefixhash.chain_hashes(t, 8)[0]
+
+    def test_usable_leaves_one_token_to_prefill(self):
+        # 8 tokens, block 4: both blocks are full, but using both would
+        # leave prefill nothing to forward — only the first is usable.
+        assert len(prefixhash.usable_hashes(list(range(8)), 4)) == 1
+        assert len(prefixhash.usable_hashes(list(range(9)), 4)) == 2
+        assert prefixhash.usable_hashes([1, 2, 3, 4], 4) == []
+
+    def test_block_must_be_positive(self):
+        with pytest.raises(ValueError):
+            prefixhash.chain_hashes([1], 0)
+
+
+# ---------------------------------------------------------------------------
+# The store (serve/prefixcache.py) — numpy stands in for device arrays
+# (the store only needs .nbytes).
+
+
+def _blocks(n, nbytes=1024):
+    return [(np.zeros(nbytes // 2, np.uint8), np.zeros(nbytes // 2, np.uint8))
+            for _ in range(n)]
+
+
+class TestPrefixStore:
+    def test_match_and_gather_longest_chain(self):
+        store = PrefixStore(1 << 20, block=4)
+        blocks = _blocks(3)
+        store.retain(["h0", "h1", "h2"], lambda i: blocks[i])
+        assert store.match(["h0", "h1", "h2", "h3"]) == 3
+        assert store.match(["h0", "hX", "h2"]) == 1  # chain breaks at hX
+        assert store.match(["hX"]) == 0
+        chain = store.gather(["h0", "h1"])
+        assert [e.key for e in chain] == ["h0", "h1"]
+
+    def test_retain_skips_resident_blocks(self):
+        store = PrefixStore(1 << 20, block=4)
+        calls = []
+
+        def mat(i):
+            calls.append(i)
+            return _blocks(1)[0]
+
+        assert store.retain(["h0", "h1"], mat) == 2
+        assert store.retain(["h0", "h1", "h2"], mat) == 1
+        assert calls == [0, 1, 2]  # resident blocks never re-materialize
+
+    def test_lru_eviction_under_byte_budget(self):
+        # Budget fits exactly 2 blocks; inserting a third evicts the
+        # least-recently-USED (h0 was re-touched by match, so h1 goes).
+        store = PrefixStore(2048, block=4)
+        store.retain(["h0", "h1"], lambda i: _blocks(1, 1024)[0])
+        assert store.match(["h0"]) == 1  # touch h0
+        store.retain(["h2"], lambda i: _blocks(1, 1024)[0])
+        assert "h1" not in store and "h0" in store and "h2" in store
+        assert store.stats()["bytes"] == 2048
+
+    def test_gather_returns_none_on_broken_chain(self):
+        store = PrefixStore(2048, block=4)
+        store.retain(["h0", "h1"], lambda i: _blocks(1, 1024)[0])
+        store.retain(["h2"], lambda i: _blocks(1, 1024)[0])  # evicts h0
+        assert store.gather(["h0", "h1"]) is None
+
+    def test_oom_valve_evicts_all_and_retries_once(self):
+        store = PrefixStore(1 << 20, block=4)
+        store.retain(["h0"], lambda i: _blocks(1)[0])
+        attempts = []
+
+        def pressured(i):
+            attempts.append(i)
+            if len(attempts) == 1:
+                raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+            return _blocks(1)[0]
+
+        assert store.retain(["h1"], pressured) == 1
+        assert len(attempts) == 2  # failed, valve fired, retried
+        assert "h0" not in store  # the valve evicted everything idle
+        assert "h1" in store
+
+    def test_mid_chain_oom_never_leaves_a_rootless_chain(self):
+        """OOM while materializing a DEEP block fires the valve — which
+        wipes the chain's own just-inserted roots — so the retain must
+        STOP there: inserting the deeper blocks alone would strand
+        unmatchable entries that occupy capacity until LRU churn."""
+        store = PrefixStore(1 << 20, block=4)
+        calls = []
+
+        def pressured(i):
+            calls.append(i)
+            if i == 1:
+                raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+            return _blocks(1)[0]
+
+        assert store.retain(["h0", "h1", "h2"], pressured) == 0
+        assert len(store) == 0  # no rootless h2; nothing resident
+        assert calls == [0, 1]  # never went past the failed block
+
+    def test_oom_never_escapes_retain(self):
+        """The caller is the engine loop: OOM must DROP the retain (with
+        nothing left to evict, or when the post-evict retry fails too),
+        never propagate and kill the replica."""
+        store = PrefixStore(1 << 20, block=4)
+
+        def hopeless(i):
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+        assert store.retain(["h0"], hopeless) == 0  # empty store: drop
+        store.retain(["h0"], lambda i: _blocks(1)[0])
+        assert store.retain(["h1"], hopeless) == 0  # retry fails: drop
+        assert len(store) == 0  # the valve did evict before giving up
+
+    def test_non_oom_errors_surface_unretried(self):
+        store = PrefixStore(1 << 20, block=4)
+        store.retain(["h0"], lambda i: _blocks(1)[0])
+        calls = []
+
+        def broken(i):
+            calls.append(i)
+            raise ValueError("not a memory problem")
+
+        with pytest.raises(ValueError):
+            store.retain(["h1"], broken)
+        assert calls == [0]
+        assert "h0" in store  # the valve did NOT fire
+
+    def test_capacity_zero_disables(self):
+        store = PrefixStore(0, block=4)
+        store.retain(["h0"], lambda i: _blocks(1)[0])
+        assert store.match(["h0"]) == 0 and len(store) == 0
+
+    def test_hot_advertises_roots_first_and_deep_evicts_first(self):
+        # A retained chain leaves its ROOT most-recently-used: hot()
+        # (the router advertisement) leads with the shared end of the
+        # chain, and byte-budget pressure evicts the deepest (least
+        # shared) block first — never the root every lookup needs.
+        store = PrefixStore(3 * 1024, block=4)
+        store.retain(["h0", "h1", "h2"], lambda i: _blocks(1, 1024)[0])
+        assert store.hot(2) == ["h0", "h1"]
+        store.retain(["g0"], lambda i: _blocks(1, 1024)[0])
+        assert "h2" not in store  # deepest went, root survived
+        assert "h0" in store and "h1" in store
+
+    def test_prefix_cache_bytes_gauge_tracks(self):
+        store = PrefixStore(1 << 20, block=4)
+        store.retain(["g0"], lambda i: _blocks(1, 2048)[0])
+        assert M.SERVE_PREFIX_CACHE_BYTES.value == store.stats()["bytes"]
+        store.evict_all()
+        assert M.SERVE_PREFIX_CACHE_BYTES.value == 0
+
+
+# ---------------------------------------------------------------------------
+# Engine-level reuse: the byte-identity pin, at block 4 so tiny prompts
+# exercise multi-block chains.
+
+
+class TestEnginePrefixReuse:
+    def _engine(self, model, **kw):
+        params, cfg = model
+        kw.setdefault("max_batch", 2)
+        kw.setdefault("max_seq", 64)
+        kw.setdefault("queue_depth", 16)
+        kw.setdefault("prefix_block", 4)
+        return ServeEngine(params, cfg, **kw)
+
+    def test_reuse_is_byte_identical_greedy_and_sampled(self, model):
+        params, cfg = model
+        eng = self._engine(model)
+        shared = np.random.RandomState(2).randint(1, 64, 13).tolist()
+        reqs = [
+            (shared + [7, 8], 6, 0.0, 0),   # miss: retains 3 blocks
+            (shared + [9], 6, 0.7, 1),      # hit, sampled
+            (shared + [10, 11], 5, 0.0, 2),  # hit, greedy
+            (shared + [7, 8], 6, 1.1, 3),   # same prompt as req 0, sampled
+            ([1, 2, 3], 4, 0.9, 4),         # unrelated: miss
+        ]
+        try:
+            outs = []
+            for p, n, t, s in reqs:
+                h = eng.submit(p, max_new=n, temperature=t, seed=s)
+                outs.append((h.result(timeout=120), h.stats))
+        finally:
+            eng.stop(timeout=30)
+        for (p, n, t, s), (out, stats) in zip(reqs, outs):
+            assert out == solo_tokens(params, cfg, p, n, t, s), (p, t, s)
+        # The first shared-prefix request retained; the rest reused 12
+        # tokens (3 blocks of the 13-token shared prefix).
+        assert [st["prefix_tokens"] for _, st in outs] == [0, 12, 12, 12, 0]
+
+    def test_longest_prefix_match_is_block_granular(self, model):
+        eng = self._engine(model)
+        a = [11, 12, 13, 14, 21, 22, 23, 24]  # exactly 2 blocks
+        try:
+            eng.submit(a + [1], max_new=2).result(timeout=120)
+            # A request sharing only the FIRST block matches 4 tokens...
+            h1 = eng.submit(a[:4] + [9, 9, 9], max_new=2)
+            h1.result(timeout=120)
+            # ...a longer one matches both blocks, 8 tokens...
+            h2 = eng.submit(a + [5, 6], max_new=2)
+            h2.result(timeout=120)
+            # ...and an identical prompt caps at n-1: with n=9 only the
+            # 8-token chain fits, with n=8 only the first block does.
+            h3 = eng.submit(a, max_new=2)
+            h3.result(timeout=120)
+        finally:
+            eng.stop(timeout=30)
+        assert h1.stats["prefix_tokens"] == 4
+        assert h2.stats["prefix_tokens"] == 8
+        assert h3.stats["prefix_tokens"] == 4
+
+    def test_reused_slot_after_eviction_stays_identical(self, model):
+        """max_batch=1 forces every request through THE slot; evicting
+        the chain between two identical requests must flip hit -> miss
+        without changing a token (the fresh-sub-cache invariant)."""
+        params, cfg = model
+        eng = self._engine(model, max_batch=1)
+        p = np.random.RandomState(7).randint(1, 64, 10).tolist()
+        try:
+            first = eng.submit(p, max_new=5, temperature=0.6, seed=9)
+            out_first = first.result(timeout=120)
+            hit = eng.submit(p, max_new=5, temperature=0.6, seed=9)
+            out_hit = hit.result(timeout=120)
+            eng._prefix.evict_all()
+            miss = eng.submit(p, max_new=5, temperature=0.6, seed=9)
+            out_miss = miss.result(timeout=120)
+        finally:
+            eng.stop(timeout=30)
+        want = solo_tokens(params, cfg, p, 5, 0.6, 9)
+        assert out_first == out_hit == out_miss == want
+        assert hit.stats["prefix_tokens"] == 8
+        assert miss.stats["prefix_tokens"] == 0
+
+    def test_disabled_cache_never_hits(self, model):
+        eng = self._engine(model, prefix_cache_bytes=0)
+        p = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+        try:
+            eng.submit(p, max_new=2).result(timeout=120)
+            h = eng.submit(p, max_new=2)
+            h.result(timeout=120)
+        finally:
+            eng.stop(timeout=30)
+        assert h.stats["prefix_tokens"] == 0
+        assert eng.prefix_stats()["entries"] == 0
+
+    def test_queue_wait_histogram_records_admissions(self, model):
+        before = M.SERVE_QUEUE_WAIT.count
+        eng = self._engine(model)
+        try:
+            eng.submit([1, 2, 3], max_new=2).result(timeout=120)
+        finally:
+            eng.stop(timeout=30)
+        assert M.SERVE_QUEUE_WAIT.count == before + 1
+
+    def test_first_token_histogram_splits_hit_miss(self, model):
+        miss_before = M.SERVE_FIRST_TOKEN.labels(prefix="miss").count
+        hit_before = M.SERVE_FIRST_TOKEN.labels(prefix="hit").count
+        eng = self._engine(model)
+        p = [4, 4, 4, 4, 8, 8, 8, 8, 2]
+        try:
+            eng.submit(p, max_new=2).result(timeout=120)
+            eng.submit(p, max_new=2).result(timeout=120)
+        finally:
+            eng.stop(timeout=30)
+        assert M.SERVE_FIRST_TOKEN.labels(prefix="miss").count \
+            == miss_before + 1
+        assert M.SERVE_FIRST_TOKEN.labels(prefix="hit").count \
+            == hit_before + 1
+
+    def test_hot_prefixes_advertises_mru(self, model):
+        eng = self._engine(model)
+        p = [3, 3, 3, 3, 5, 5, 5, 5, 1]
+        try:
+            eng.submit(p, max_new=2).result(timeout=120)
+        finally:
+            eng.stop(timeout=30)
+        hot = eng.hot_prefixes()
+        assert hot and set(hot) == \
+            set(prefixhash.chain_hashes(p, 4))
+
+
+# ---------------------------------------------------------------------------
+# Registration advertisement (serve/registration.py load_snapshot).
+
+
+class _FakePrefixEngine:
+    prefix_block = 4
+
+    def __init__(self, hot):
+        self._hot = hot
+
+    def stats(self):
+        return {"free_slots": 3, "queue_depth": 0, "max_batch": 4,
+                "ready": True}
+
+    def hot_prefixes(self, n=None):
+        return list(self._hot)
+
+
+class _LegacyEngine:
+    """A pre-prefix-cache engine: no hot_prefixes attribute at all."""
+
+    def stats(self):
+        return {"free_slots": 3, "queue_depth": 0, "max_batch": 4,
+                "ready": True}
+
+
+class TestAdvertisement:
+    def test_snapshot_carries_hot_hashes_and_block(self):
+        snap = load_snapshot("h:1", _FakePrefixEngine(["aa", "bb"]))
+        assert snap["prefix_hashes"] == ["aa", "bb"]
+        assert snap["prefix_block"] == 4
+
+    def test_empty_cache_advertises_nothing(self):
+        snap = load_snapshot("h:1", _FakePrefixEngine([]))
+        assert "prefix_hashes" not in snap and "prefix_block" not in snap
+
+    def test_legacy_engine_advertises_nothing(self):
+        snap = load_snapshot("h:1", _LegacyEngine())
+        assert "prefix_hashes" not in snap
+
+    def test_replica_parse_roundtrip(self):
+        import json
+
+        snap = load_snapshot("h:1", _FakePrefixEngine(["aa", "bb"]))
+        r = Replica.parse("serve/r0", json.dumps(snap))
+        assert r.prefix_block == 4
+        assert r.prefix_hashes == frozenset({"aa", "bb"})
+
+    def test_replica_parse_mixed_version_and_malformed(self):
+        import json
+
+        # Pre-upgrade row: no prefix fields — routable, no affinity.
+        old = Replica.parse("serve/r0", json.dumps(
+            {"endpoint": "h:1", "free_slots": 2, "ready": True}))
+        assert old is not None and old.prefix_block == 0 \
+            and old.prefix_hashes == frozenset()
+        # Malformed advertisement: affinity off, row still routes.
+        bad = Replica.parse("serve/r0", json.dumps(
+            {"endpoint": "h:1", "prefix_block": "nope",
+             "prefix_hashes": {"not": "a list"}}))
+        assert bad is not None and bad.prefix_block == 0
+        worse = Replica.parse("serve/r0", json.dumps(
+            {"endpoint": "h:1", "prefix_block": 4,
+             "prefix_hashes": [1, 2]}))
+        assert worse is not None and worse.prefix_hashes == frozenset()
+
+
+# ---------------------------------------------------------------------------
+# The affinity pick: a tie-break within the load guard, never a hotspot
+# generator (no jax, no registry — _FixedTable style like test_router).
+
+
+class _FixedTable:
+    def __init__(self, replicas):
+        self._replicas = list(replicas)
+
+    def replicas(self):
+        return list(self._replicas)
+
+    def __len__(self):
+        return len(self._replicas)
+
+
+def _holder(rid, prompt, block=4, n_hashes=None, **kw):
+    hashes = prefixhash.usable_hashes(prompt, block)
+    if n_hashes is not None:
+        hashes = hashes[:n_hashes]
+    return Replica(rid, f"h:{rid}", prefix_block=block,
+                   prefix_hashes=frozenset(hashes), **kw)
+
+
+class TestAffinityPick:
+    PROMPT = [1, 2, 3, 4, 5, 6, 7, 8, 9]  # 2 usable blocks at block=4
+
+    def test_holder_wins_among_equals(self):
+        service = RouterService(_FixedTable([
+            Replica("plain", "h:0", free_slots=4),
+            _holder("holder", self.PROMPT, free_slots=4),
+        ]))
+        before = M.ROUTER_AFFINITY_PICKS.value
+        for _ in range(20):
+            assert service.pick(prompt=self.PROMPT).replica_id == "holder"
+        assert M.ROUTER_AFFINITY_PICKS.value == before + 20
+
+    def test_longest_match_wins(self):
+        service = RouterService(_FixedTable([
+            _holder("one-block", self.PROMPT, n_hashes=1, free_slots=4),
+            _holder("two-blocks", self.PROMPT, free_slots=4),
+        ]))
+        assert service.pick(prompt=self.PROMPT).replica_id == "two-blocks"
+
+    def test_loaded_holder_beyond_guard_falls_back(self):
+        service = RouterService(_FixedTable([
+            Replica("idle", "h:0", free_slots=4),
+            _holder("busy", self.PROMPT, free_slots=0, queue_depth=4),
+        ]))
+        # busy scores 4, idle -4: 8 over — way past the default guard.
+        before = M.ROUTER_AFFINITY_PICKS.value
+        assert service.pick(prompt=self.PROMPT).replica_id == "idle"
+        assert M.ROUTER_AFFINITY_PICKS.value == before
+
+    def test_holder_within_guard_still_wins(self):
+        service = RouterService(_FixedTable([
+            Replica("idle", "h:0", free_slots=4),
+            _holder("warm", self.PROMPT, free_slots=3),
+        ]))  # warm is 1 request behind: inside the default guard of 2
+        assert service.pick(prompt=self.PROMPT).replica_id == "warm"
+
+    def test_guard_zero_means_equal_load_only(self):
+        service = RouterService(_FixedTable([
+            Replica("idle", "h:0", free_slots=4),
+            _holder("warm", self.PROMPT, free_slots=3),
+        ]), affinity_guard=0)
+        assert service.pick(prompt=self.PROMPT).replica_id == "idle"
+
+    def test_affinity_disabled_ignores_advertisements(self):
+        service = RouterService(_FixedTable([
+            Replica("idle", "h:0", free_slots=4),
+            _holder("warm", self.PROMPT, free_slots=3),
+        ]), affinity=False)
+        before = M.ROUTER_AFFINITY_PICKS.value
+        assert service.pick(prompt=self.PROMPT).replica_id == "idle"
+        assert M.ROUTER_AFFINITY_PICKS.value == before
+
+    def test_excluded_holder_falls_back(self):
+        # The retry path: the holder was tried and failed pre-first-token.
+        service = RouterService(_FixedTable([
+            Replica("plain", "h:0", free_slots=4),
+            _holder("holder", self.PROMPT, free_slots=4),
+        ]))
+        picked = service.pick(exclude={"holder"}, prompt=self.PROMPT)
+        assert picked.replica_id == "plain"
+
+    def test_prefix_len_hint_caps_the_match(self):
+        # The client declares only the first 4 tokens shared: a replica
+        # holding the 2-block chain matches 1 block, one holding an
+        # unrelated deep chain matches nothing.
+        service = RouterService(_FixedTable([
+            Replica("plain", "h:0", free_slots=4),
+            _holder("holder", self.PROMPT, free_slots=4),
+        ]))
+        before = M.ROUTER_AFFINITY_PICKS.value
+        assert service.pick(prompt=self.PROMPT,
+                            prefix_len=4).replica_id == "holder"
+        assert M.ROUTER_AFFINITY_PICKS.value == before + 1
+        # prefix_len below one block: nothing to match, plain pick.
+        service.pick(prompt=self.PROMPT, prefix_len=2)
+        assert M.ROUTER_AFFINITY_PICKS.value == before + 1
+
+    def test_no_prompt_is_plain_least_loaded(self):
+        service = RouterService(_FixedTable([
+            Replica("busy", "h:0", free_slots=0, queue_depth=6),
+            _holder("idle", self.PROMPT, free_slots=4),
+        ]))
+        assert service.pick().replica_id == "idle"
+
+    def test_mismatched_block_size_cannot_false_match(self):
+        # A replica hashing at block 8 advertises different hashes for
+        # the same tokens; a block-4 router request must not match them.
+        r8 = Replica("r8", "h:8", prefix_block=8, free_slots=4,
+                     prefix_hashes=frozenset(
+                         prefixhash.chain_hashes(self.PROMPT, 4)))
+        service = RouterService(_FixedTable([
+            Replica("plain", "h:0", free_slots=4), r8,
+        ]))
+        before = M.ROUTER_AFFINITY_PICKS.value
+        service.pick(prompt=self.PROMPT)
+        assert M.ROUTER_AFFINITY_PICKS.value == before
+
+
+# ---------------------------------------------------------------------------
+# oimctl --top: the PREFIX-HIT column degrades to "-" for scrapes that
+# predate the prefix metrics (mixed-version safety at the tooling layer).
+
+
+class TestTopPrefixColumn:
+    def _scrape(self, with_prefix):
+        import json as json_mod
+
+        from oim_tpu.common.metrics import Registry
+
+        reg = Registry()
+        reg.gauge("oim_serve_qps").set(1.0)
+        if with_prefix:
+            reg.counter("oim_serve_prefix_hits_total").inc(3)
+            reg.counter("oim_serve_prefix_misses_total").inc(1)
+        text = reg.render()
+        ev = json_mod.dumps({"events": [], "dropped": 0})
+
+        def http_get(url, timeout=10.0):
+            return ev if "/debug/events" in url else text
+
+        return http_get
+
+    def test_hit_rate_rendered(self):
+        from oim_tpu.cli.oimctl import render_top, top_row
+
+        row = top_row("r0", "ALIVE", "serve", "127.0.0.1:1",
+                      http_get=self._scrape(True))
+        assert row["prefix_hit"] == pytest.approx(0.75)
+        assert "75%" in render_top([row])
+
+    def test_pre_upgrade_scrape_degrades_to_dash(self):
+        from oim_tpu.cli.oimctl import render_top, top_row
+
+        row = top_row("r0", "ALIVE", "serve", "127.0.0.1:1",
+                      http_get=self._scrape(False))
+        assert row["prefix_hit"] is None
+        rendered = render_top([row])
+        assert "PREFIX-HIT" in rendered

@@ -1,0 +1,28 @@
+"""Tier-1 wiring of `make prefix-smoke`: the serve smoke with half the
+requests opening on one shared system-prompt prefix, plus the routed
+affinity half — bench.prefix_smoke() itself raises unless the prefix
+cache actually hit (hit_rate > 0), actually skipped prefill work
+(prefill_tokens_saved > 0), every output (hit and miss, greedy and
+sampled) stayed byte-identical to its solo generate() run, and the
+router herded same-prefix requests to the replica holding the prefix
+(oim_router_affinity_picks_total observed)."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def test_prefix_smoke_hits_savings_and_affinity():
+    import bench
+
+    extras = bench.prefix_smoke(0.5)  # raises AssertionError on any break
+    assert extras["serve_completed"] == extras["serve_requests"]
+    assert extras["prefix_hit_rate"] > 0
+    assert extras["prefill_tokens_saved"] > 0
+    assert extras["router_affinity_picks"] >= 1
+    assert extras["router_affinity_byte_identity"] is True
+    # At least one replica retained the prefix to herd onto (usually
+    # exactly one, but a pick that raced the first table refresh may
+    # legitimately seed the second).
+    assert max(extras["router_prefix_entries"]) >= 1
