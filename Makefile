@@ -9,7 +9,7 @@
 PY ?= python
 RUFF := $(shell command -v ruff 2>/dev/null)
 
-.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke prefix-smoke
+.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke prefix-smoke paged-smoke
 
 # drift and tsan are standalone conveniences; the full pytest target
 # already runs both (SpecDrift + the TSAN stream test build in-fixture).
@@ -76,6 +76,17 @@ router-smoke:
 # tier-1 as tests/test_prefix_smoke.py.
 prefix-smoke:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --serve --smoke --prefix-share 0.5
+
+# Paged-KV-cache acceptance loop (seconds): the serve smoke under a
+# bimodal short/long prompt mix with the page pool sized at HALF the
+# dense max_batch x max_seq reservation — every output byte-identical
+# to solo generate(), zero dropped requests (pool exhaustion
+# backpressures through the queue, never OOMs) — plus a deterministic
+# packing phase proving MORE live slots than dense slots of equal HBM
+# (a reverted max_seq-per-slot reservation fails the gate). Also runs
+# in tier-1 as tests/test_paged_smoke.py.
+paged-smoke:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --serve --smoke --prompt-mix
 
 # Observability-plane acceptance loop (seconds): in-process registry +
 # 2 serve replicas + router; one trace_id traced from a /metrics

@@ -82,6 +82,14 @@ def main(argv=None) -> int:
                              "hit/miss first-token percentiles to the "
                              "report (with --smoke: the asserting prefix-"
                              "cache + affinity-routing smoke)")
+    parser.add_argument("--prompt-mix", action="store_true",
+                        help="with --serve: bimodal short/long prompt "
+                             "lengths over a page pool sized at HALF "
+                             "the dense max_batch x max_seq HBM — "
+                             "reports slot occupancy, serve_qps at the "
+                             "same p99 columns, and peak pool pages vs "
+                             "the dense reservation (with --smoke: the "
+                             "asserting paged-KV smoke)")
     parser.add_argument("--obs-smoke", action="store_true",
                         help="observability-plane acceptance run: one "
                              "trace_id traced from a /metrics exemplar "
@@ -115,10 +123,15 @@ def main(argv=None) -> int:
                           args.replicas,
                           replica_procs=not args.in_process_replicas))
         elif args.smoke:
-            extras = (prefix_smoke(args.prefix_share)
-                      if args.prefix_share > 0 else serve_smoke())
+            if args.prompt_mix:
+                extras = paged_smoke()
+            elif args.prefix_share > 0:
+                extras = prefix_smoke(args.prefix_share)
+            else:
+                extras = serve_smoke()
         else:
-            extras = serve_bench(prefix_share=args.prefix_share)
+            extras = serve_bench(prefix_share=args.prefix_share,
+                                 prompt_mix=args.prompt_mix)
         print(json.dumps({
             "metric": "serve_qps",
             "value": extras["serve_qps"],
@@ -650,7 +663,7 @@ def bench_llama(chain_short: int, chain_long: int, profile_dir: str = "") -> dic
 def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
                 max_batch: int = 8, max_new: int = 16,
                 verify_all: bool = False, prefix_share: float = 0.0,
-                prefix_block: int = 16) -> dict:
+                prefix_block: int = 16, prompt_mix: bool = False) -> dict:
     """Serving-plane bench: a synthetic OPEN-LOOP load (requests arrive
     on a fixed clock whether or not earlier ones finished — the arrival
     process of real traffic, not a closed feedback loop) against an
@@ -676,7 +689,18 @@ def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
     The cache is pre-warmed so every shared request is a HIT, and the
     report gains ``prefix_hit_rate``, ``prefill_tokens_saved`` (prompt
     tokens whose K/V came from the cache instead of the model), and
-    first-token p50/p99 split by hit vs miss."""
+    first-token p50/p99 split by hit vs miss.
+
+    ``prompt_mix`` is the paged-KV acceptance workload (ROADMAP item 1):
+    bimodal short/long prompt lengths over a page pool sized at HALF
+    what a dense ``max_batch x max_seq`` cache would reserve. Admission
+    reserves pages per request's real footprint, so the short half of
+    the mix packs slots a dense layout would have wasted on empty tail;
+    the report gains ``slot_occupancy_mean``/``_max`` (sampled through
+    the load window) and the ``kv_pages_*`` columns, with
+    ``kv_pages_peak`` < ``kv_pages_dense_equiv`` as the HBM-saving
+    proof (serve_qps and the p99 columns are the fixed-SLO half of the
+    acceptance metric)."""
     import threading
 
     import jax
@@ -723,14 +747,29 @@ def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
         tree = restore_weights(feeder, "bench-weights")
 
         # ---- open-loop load over gRPC ----------------------------------
+        # The prompt-mix run halves the pool vs the dense reservation:
+        # the whole point is admitting more real requests than
+        # max_batch/2 dense slots of the same HBM could hold.
+        pool_tokens = (max_batch * max_seq // 2) if prompt_mix else 0
         engine = ServeEngine(tree, cfg, max_batch=max_batch,
                              max_seq=max_seq, queue_depth=n_requests,
-                             prefix_block=prefix_block)
+                             prefix_block=prefix_block,
+                             kv_pool_tokens=pool_tokens)
         server = serve_server("tcp://127.0.0.1:0", ServeService(engine))
         # Warmup: compile the prefill bucket + decode program outside the
         # measured window, so first-token latency is queue+prefill time,
         # not jit time.
         engine.submit([1, 2, 3], max_new=2).result(timeout=300)
+        if prompt_mix:
+            # The long half of the mix lands in bigger prefill buckets;
+            # compile those outside the window too (a steady-state
+            # replica has every bucket warm). Distinct token values per
+            # warm prompt: a prefix-cache hit would shrink the tail
+            # into an already-compiled bucket and skip the compile.
+            for fill, warm_len in enumerate(
+                    (max_seq // 2, max_seq - max_new - 1), start=2):
+                engine.submit([fill] * warm_len, max_new=2).result(
+                    timeout=300)
 
         rng = np.random.RandomState(42)
         # The shared system prompt: 2 full prefix-cache blocks + 1 token
@@ -741,11 +780,24 @@ def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
         shared_flags = [i < round(prefix_share * n_requests)
                         for i in range(n_requests)]
         rng.shuffle(shared_flags)
+        # The bimodal mix: half the (non-shared) requests carry a LONG
+        # prompt near the max_seq budget, half stay short — the traffic
+        # shape where dense per-slot reservation wastes the most HBM.
+        long_flags = [False] * n_requests
+        if prompt_mix:
+            long_flags = [i % 2 == 1 for i in range(n_requests)]
+            rng.shuffle(long_flags)
+
+        def prompt_len(i):
+            if long_flags[i] and not shared_flags[i]:
+                return int(rng.randint(max_seq // 2, max_seq - max_new))
+            return int(rng.randint(2, 9))
+
         reqs = [
             (
                 (system if shared_flags[i] else [])
                 + rng.randint(1, cfg.vocab,
-                              size=rng.randint(2, 9)).tolist(),
+                              size=prompt_len(i)).tolist(),
                 int(rng.randint(4, max_new + 1)),
                 0.0 if i % 2 == 0 else 0.8,
                 i,
@@ -817,6 +869,23 @@ def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
                     with lock:
                         errors.append(err)
 
+        # Slot occupancy through the load window: the paged-cache
+        # acceptance metric is how FULL the continuous batch runs when
+        # admission reserves real footprints instead of max_seq slots.
+        occupancy_samples: list[int] = []
+        stop_sampling = threading.Event()
+
+        def sample_occupancy():
+            while not stop_sampling.is_set():
+                occupancy_samples.append(engine.active_slots)
+                time.sleep(0.005)
+
+        sampler = None
+        if prompt_mix:
+            sampler = threading.Thread(target=sample_occupancy,
+                                       daemon=True)
+            sampler.start()
+
         interval = 1.0 / offered_rps
         threads = []
         load_t0 = time.monotonic()
@@ -831,6 +900,9 @@ def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
                 time.sleep(delay)
         for t in threads:
             t.join(timeout=300)
+        if sampler is not None:
+            stop_sampling.set()
+            sampler.join(timeout=5)
         if errors:
             raise AssertionError(
                 f"{len(errors)} serve requests failed; first: {errors[0]!r}")
@@ -892,6 +964,23 @@ def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
                 "first_token_miss_p50_ms": pct(first_miss_s, 50),
                 "first_token_miss_p99_ms": pct(first_miss_s, 99),
             })
+        if prompt_mix:
+            pstats = engine.pool_stats()
+            extras.update({
+                "prompt_mix": True,
+                "slot_occupancy_mean": (
+                    round(float(np.mean(occupancy_samples)) / max_batch, 4)
+                    if occupancy_samples else None),
+                "slot_occupancy_max": int(max(occupancy_samples))
+                if occupancy_samples else 0,
+                "kv_page_tokens": engine.page_tokens,
+                "kv_pages_total": pstats["total_pages"],
+                "kv_pages_peak": pstats["peak_used_pages"],
+                "kv_pages_shared_now": pstats["shared_pages"],
+                # What the dense layout would have reserved up front,
+                # in the same page units — the HBM-saving comparison.
+                "kv_pages_dense_equiv": pstats["dense_equiv_pages"],
+            })
         return extras
     finally:
         if server is not None:
@@ -911,6 +1000,82 @@ def serve_smoke() -> dict:
     if extras["serve_completed"] != extras["serve_requests"]:
         raise AssertionError(
             f"serve smoke dropped requests: {extras}")
+    return extras
+
+
+def paged_smoke() -> dict:
+    """The paged-KV-cache acceptance run (seconds, in-process): the
+    serve smoke under the bimodal ``--prompt-mix`` workload with the
+    page pool sized at HALF the dense ``max_batch x max_seq``
+    reservation. Every output (short and long, greedy and sampled) must
+    stay byte-identical to its solo generate() run, no request may
+    drop (pool exhaustion must BACKPRESSURE through the queue, not
+    fail), and peak pool usage must come in below what the dense
+    layout would have reserved — the HBM-saving claim, pinned. The
+    tier-1 guard wired in as tests/test_paged_smoke.py and
+    `make paged-smoke`."""
+    extras = serve_bench(n_requests=12, offered_rps=24.0, max_batch=4,
+                         max_new=8, verify_all=True, prompt_mix=True)
+    if extras["serve_completed"] != extras["serve_requests"]:
+        raise AssertionError(f"paged smoke dropped requests: {extras}")
+    if extras["kv_pages_peak"] > extras["kv_pages_total"]:
+        raise AssertionError(
+            f"paged smoke overflowed its own pool: {extras}")
+    if extras["slot_occupancy_max"] < 1:
+        raise AssertionError(
+            f"paged smoke never observed an occupied slot: {extras}")
+
+    # ---- deterministic packing phase: the falsifiable HBM gate --------
+    # The open-loop half above proves the mix survives a half-sized
+    # pool; this half pins the claim a reverted per-slot max_seq
+    # reservation would break: FOUR slots live at once on the HBM of
+    # TWO dense slots (pool 128 tokens vs dense 4 x 64). If admission
+    # ever reserves max_seq again, request 3 blocks on pages and
+    # occupancy never reaches 4.
+    import jax
+
+    from oim_tpu.models import generate as gen, llama
+    from oim_tpu.serve import ServeEngine
+
+    cfg = llama.tiny(vocab=64, dim=32, n_layers=2)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    eng = ServeEngine(params, cfg, max_batch=4, max_seq=64,
+                      queue_depth=8, prefix_cache_bytes=0,
+                      kv_pool_tokens=128)
+    dense_slots = 128 // 64
+    try:
+        reqs = [([3 + i, 4, 5], 30, 0.0 if i % 2 else 0.9, i)
+                for i in range(4)]
+        handles = [eng.submit(p, max_new=n, temperature=t, seed=s)
+                   for p, n, t, s in reqs]
+        packed = 0
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            packed = max(packed, eng.active_slots)
+            if packed == 4:
+                break
+            time.sleep(0.002)
+        if packed <= dense_slots:
+            raise AssertionError(
+                f"paged smoke packed only {packed} slots on "
+                f"{dense_slots}-dense-slot HBM — admission is "
+                f"reserving dense footprints again")
+        for (p, n, t, s), h in zip(reqs, handles):
+            got = h.result(timeout=300)
+            solo = gen.generate(
+                params, np.asarray([p], np.int32), n, cfg,
+                temperature=t, rng=jax.random.PRNGKey(s),
+                max_seq=64)[0, len(p):].tolist()
+            if got != solo:
+                raise AssertionError(
+                    f"packed-slot tokens diverge from solo: {got} != "
+                    f"{solo}")
+    finally:
+        eng.stop(drain=False, timeout=30)
+    extras.update({
+        "packed_slots": packed,
+        "dense_slots_equal_hbm": dense_slots,
+    })
     return extras
 
 

@@ -217,6 +217,21 @@ def main(argv: list[str] | None = None) -> int:
              "and more compiled prefill programs); routers and this "
              "replica hash identically, so the value is advertised in "
              "the serve/<id> row")
+    parser.add_argument(
+        "--kv-page-tokens", type=int, default=0,
+        help="tokens per KV page (paged KV cache). Default 0 = "
+             "--prefix-block, so a prefix block IS a page — the unit "
+             "zero-copy prefix sharing needs; any other value requires "
+             "--prefix-cache-bytes 0")
+    parser.add_argument(
+        "--kv-pool-tokens", type=int, default=0,
+        help="total KV tokens in the page pool ALL slots share "
+             "(default 0 = max-batch x max-seq, the dense-equivalent "
+             "HBM). Size it smaller to overcommit decode slots against "
+             "real prompt lengths: admission reserves only "
+             "prompt+max_new pages, and an exhausted pool queues "
+             "(RESOURCE_EXHAUSTED past --queue-depth) instead of "
+             "OOMing")
     parser.add_argument("--stream-tokens", type=int, default=1,
                         help="token-stream granularity: the first token "
                              "flushes immediately, later deltas batch up "
@@ -268,6 +283,8 @@ def main(argv: list[str] | None = None) -> int:
         default_max_new=args.default_max_new,
         prefix_cache_bytes=args.prefix_cache_bytes,
         prefix_block=args.prefix_block,
+        kv_page_tokens=args.kv_page_tokens,
+        kv_pool_tokens=args.kv_pool_tokens,
     )
     server = serve_server(
         args.endpoint,

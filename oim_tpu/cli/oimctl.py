@@ -379,7 +379,7 @@ def top_row(row_id: str, status: str, role: str, target: str,
     row = {"id": row_id, "status": status, "role": role, "qps": None,
            "ft_ms": (None, None), "it_ms": (None, None), "queue": None,
            "slots": None, "cache_hit": None, "prefix_hit": None,
-           "repl_lag": None, "spread": None, "events": {}}
+           "pages": None, "repl_lag": None, "spread": None, "events": {}}
     if status != "ALIVE" or not target:
         return row
     try:
@@ -412,6 +412,13 @@ def top_row(row_id: str, status: str, role: str, target: str,
         pmiss = _series_value(samples, "oim_serve_prefix_misses_total")
         if phits is not None and pmiss is not None and phits + pmiss > 0:
             row["prefix_hit"] = phits / (phits + pmiss)
+        # Paged KV pool occupancy (used/total). Dash for pre-paged
+        # replicas, whose scrapes lack the series entirely — the same
+        # mixed-version stance as PREFIX-HIT.
+        ptotal = _series_value(samples, "oim_serve_kv_pages_total")
+        pused = _series_value(samples, "oim_serve_kv_pages_used")
+        if ptotal is not None and pused is not None and ptotal > 0:
+            row["pages"] = (pused, ptotal)
     hits = _series_value(samples, "oim_stage_cache_hits_total")
     misses = _series_value(samples, "oim_stage_cache_misses_total")
     if hits is not None and misses is not None and hits + misses > 0:
@@ -447,8 +454,14 @@ def render_top(rows: list[dict]) -> str:
             return "-"
         return f"{p50:.1f}/{p99:.1f}"
 
+    def fmt_pages(pair):
+        if pair is None:
+            return "-"
+        used, total = pair
+        return f"{used:g}/{total:g}"
+
     headers = ("ID", "ROLE", "STATUS", "QPS", "FIRST-TOK(ms)",
-               "INTER-TOK(ms)", "QUEUE", "SLOTS", "CACHE-HIT",
+               "INTER-TOK(ms)", "QUEUE", "SLOTS", "PAGES", "CACHE-HIT",
                "PREFIX-HIT", "REPL-LAG", "SPREAD", "EVENTS")
     table = [headers]
     for r in rows:
@@ -458,6 +471,7 @@ def render_top(rows: list[dict]) -> str:
             r["id"], r["role"], r["status"], fmt(r["qps"]),
             fmt_pair(r["ft_ms"]), fmt_pair(r["it_ms"]),
             fmt(r["queue"], "{:g}"), fmt(r["slots"]),
+            fmt_pages(r.get("pages")),
             fmt(r["cache_hit"], "{:.0%}"),
             fmt(r.get("prefix_hit"), "{:.0%}"),
             fmt(r["repl_lag"], "{:g}"),

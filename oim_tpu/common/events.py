@@ -50,6 +50,7 @@ ROUTER_MARK_FAILED = "router_mark_failed"
 REPLICA_DRAIN = "replica_drain"
 STAGE_CACHE_EVICTION = "stage_cache_eviction"
 SLOT_EVICTED = "slot_evicted"
+PAGE_POOL_EXHAUSTED = "page_pool_exhausted"
 
 DEFAULT_CAPACITY = 2048
 

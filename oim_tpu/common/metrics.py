@@ -475,6 +475,19 @@ SERVE_PREFILL_TOKENS = DEFAULT.counter(
     "copied from the prefix store (prefill skipped), compute = forwarded "
     "through the model",
     labelnames=("source",))
+# Paged KV cache (serve/pagepool.py): the pool every slot's page table
+# maps into; shared = pages referenced more than once (prefix sharing).
+SERVE_KV_PAGES_TOTAL = DEFAULT.gauge(
+    "oim_serve_kv_pages_total",
+    "KV pages in the replica's page pool (capacity; excludes the "
+    "reserved scratch page)")
+SERVE_KV_PAGES_USED = DEFAULT.gauge(
+    "oim_serve_kv_pages_used",
+    "KV pages currently referenced by a live slot or the prefix store")
+SERVE_KV_PAGES_SHARED = DEFAULT.gauge(
+    "oim_serve_kv_pages_shared",
+    "KV pages with more than one reference — prompt-prefix pages shared "
+    "zero-copy between slots and/or the prefix store")
 SERVE_FIRST_TOKEN = DEFAULT.histogram(
     "oim_serve_first_token_seconds",
     "submit-to-first-token latency split by prefix-cache outcome "

@@ -126,112 +126,167 @@ def cached_forward(params, tokens, cache, pos, cfg: Config):
 
 # -- serving entry points (oim_tpu/serve: continuous batching) ------------
 #
-# The serving engine shares ONE [B, S] cache across live requests and
-# needs two operations generate() fuses: insert a new request's prefill
-# into a single batch row while other rows keep decoding, and advance the
-# whole batch one token with PER-ROW positions. Both reuse
-# cached_forward / the same attention, so there is still exactly one
-# cached-forward implementation to keep correct.
+# The serving engine's KV storage is PAGED: one pool of fixed-size pages
+# {"k","v"} [L, n_pages, page_tokens, kv_heads, head_dim] shared by every
+# live request, addressed through per-slot page tables (logical position
+# s of slot b lives at pool[:, table[b, s // page], s % page]). Capacity
+# stops being a per-slot [max_seq] reservation — short and long prompts
+# share one pool, and a cached prompt prefix is SHARED by pointing two
+# slots' tables at the same physical pages (vLLM's paged-attention idea
+# re-expressed on this repo's primitives). The two engine operations —
+# insert a new request's prefill into a slot mid-flight, advance the
+# whole batch one token with per-row positions — become scatter (write
+# this step's K/V through the table) + gather (materialize the slot's
+# logical cache from the table) around the SAME ``_cache_attention`` the
+# solo path uses, so there is still exactly one attention implementation
+# to keep correct.
+#
+# Why byte-identity to solo generate() survives paging: the gathered
+# logical cache holds exactly the values the dense cache held at every
+# position the causal mask admits, and masked positions (unwritten pads,
+# stale bytes in a freshly mapped page) contribute EXACT zeros through
+# the softmax (-inf score -> 0 probability -> 0 * finite = 0), so the
+# attention sums are term-for-term identical.
 
 
-def prefill_into_slot(params, tokens, n_tokens, cache, slot, cfg: Config,
-                      prefix=None, prefix_len=None):
+def init_page_pool(cfg: Config, n_pages: int, page_tokens: int):
+    """Zeroed page pool: {"k","v"} of [L, n_pages, page_tokens, kv_heads,
+    head_dim]. Physical page 0 is the engine's scratch/null page: every
+    unmapped page-table entry points at it, and idle decode rows write
+    their discarded K/V into it — its content is garbage by design and
+    is only ever read through the causal mask's exact-zero branch."""
+    shape = (cfg.n_layers, n_pages, page_tokens,
+             cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def prefill_into_pages(params, tokens, n_tokens, pool, page_table,
+                       start, cfg: Config, page_tokens: int):
     """Prefill ``tokens`` [1, T] (first ``n_tokens`` real, rest pad — the
-    engine buckets prompt lengths so one compiled program serves many) into
-    batch row ``slot`` of the shared cache.
+    engine buckets prompt lengths so one compiled program serves many)
+    through the slot's ``page_table`` [n_blocks] into the page pool,
+    occupying logical positions [start, start + n_tokens).
 
-    Returns (last real token's logits [vocab] f32, updated cache). Runs
-    cached_forward at batch 1 against a FRESH zero slot cache — the exact
-    solo numerics of generate()'s prefill, and provably no K/V leakage
-    from the slot's previous occupant. Pad positions >= n_tokens get their
-    K/V zeroed before the slot is written back: the causal mask keeps them
-    out of the prefill's own logits, but later decode steps WOULD attend
-    to them (pad positions fall below the advancing decode position).
+    Returns (last real token's logits [vocab] f32, updated pool). This is
+    BOTH prefill paths in one program: the full path is start=0 with the
+    whole prompt as ``tokens``; the prefix-cache hit passes only the
+    UNCACHED TAIL with ``start`` = the cached depth as a traced scalar —
+    the cached prefix K/V is never copied anywhere, the slot's page
+    table simply references the store's pages and the gather reads them
+    in place (zero-copy sharing; K/V at a prompt position is a pure
+    function of the tokens at and before it — causal attention,
+    absolute-position RoPE from 0 — so shared bytes are exactly what a
+    full prefill would recompute). Because ``start`` is traced and the
+    page-table shape is fixed, the compiled-program count is one per
+    TAIL bucket — strictly fewer than the dense resume path's
+    (tail buckets x prefix buckets).
 
-    ``prefix`` is the resume path (the serve engine's prefix KV cache):
-    ``{"k","v"}`` of [L, P_pad, kv_heads, head_dim] — K/V already
-    computed for the request's first ``prefix_len`` prompt tokens
-    (``prefix_len`` defaults to the array length; the engine pads the
-    operand to a power-of-two bucket and passes the real length as a
-    traced scalar, so ONE compiled program serves every prefix depth in
-    the bucket instead of one per depth). The cached rows are copied
-    into the fresh slot cache verbatim and ``tokens`` then holds only
-    the UNCACHED TAIL, forwarded from start position ``prefix_len``
-    (pad rows beyond it are overwritten by the tail / zeroed by the
-    keep mask). K/V at a prompt position is a pure function of the
-    tokens at and before it (causal attention, absolute-position RoPE
-    from 0), so reused prefix bytes are exactly what a full prefill
-    would have recomputed — the byte-identity invariant survives the
-    skip. The engine relies on the same shape-independence the bucketed
-    full prefill already pins: forwarding the tail at its own bucket
-    length produces the same bytes per real position as one pass over
-    the whole prompt.
+    Pad positions (t >= n_tokens, or logical positions past the table)
+    are DROPPED at the scatter instead of written-then-zeroed: the
+    causal mask already keeps them out of every real query's softmax
+    with exact-zero weight, and never writing them is what keeps a
+    SHARED page immutable — a slot may only write pages it privately
+    owns (its tail and decode blocks), which is the copy-on-write
+    contract the prefix store relies on.
     """
-    S = cache["k"].shape[2]
-    sub = init_cache(cfg, 1, S)
-    start = 0
-    if prefix is not None:
-        start = prefix["k"].shape[1] if prefix_len is None else prefix_len
-        # Verbatim copy into positions [0, P_pad) of the fresh slot
-        # cache — no arithmetic touches the cached bytes.
-        sub = {
-            name: lax.dynamic_update_slice_in_dim(
-                sub[name], prefix[name][:, None], 0, axis=2)
-            for name in ("k", "v")
-        }
-    logits, sub = cached_forward(params, tokens, sub, start, cfg)
-    keep = (jnp.arange(S) < start + n_tokens)[None, None, :, None, None]
-    cache = {
-        name: lax.dynamic_update_slice_in_dim(
-            cache[name], jnp.where(keep, sub[name], 0), slot, axis=1)
-        for name in ("k", "v")
-    }
+    B, T = tokens.shape  # B == 1: admission is per-slot
+    nb = page_table.shape[0]
+    S = nb * page_tokens
+    n_pages = pool["k"].shape[1]
+    cfg = _no_drop(cfg)
+    params = jax.tree.map(jnp.asarray, params)
+    cos, sin = rope_frequencies(cfg.head_dim, S, cfg.rope_theta)
+    positions = jnp.broadcast_to(start + jnp.arange(T), (B, T))
+    logical = start + jnp.arange(T)
+    blk = jnp.minimum(logical // page_tokens, nb - 1)
+    keep = (jnp.arange(T) < n_tokens) & (logical < S)
+    # Out-of-range physical index + mode="drop": pad K/V never lands.
+    phys = jnp.where(keep, page_table[blk], n_pages)
+    off = logical % page_tokens
+    x = params["embed"][tokens].astype(cfg.dtype)
+
+    def body(x, inp):
+        layer, pk, pv = inp  # [n_pages, page, kvh, hd]
+        h = rmsnorm(x, layer["attn_norm"])
+        q = (h @ layer["wq"]).reshape(B, T, cfg.n_heads, cfg.head_dim)
+        k = (h @ layer["wk"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ layer["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        pk = pk.at[phys, off].set(k[0], mode="drop")
+        pv = pv.at[phys, off].set(v[0], mode="drop")
+        # Gather-by-page-table: the slot's logical [S] cache view.
+        ck = pk[page_table].reshape(1, S, cfg.n_kv_heads, cfg.head_dim)
+        cv = pv[page_table].reshape(1, S, cfg.n_kv_heads, cfg.head_dim)
+        attn = _cache_attention(q, ck, cv, start, cfg)
+        x = x + attn.reshape(B, T, cfg.q_dim) @ layer["wo"]
+        h = rmsnorm(x, layer["mlp_norm"])
+        ffn, _ = _ffn(h, layer, cfg)
+        return x + ffn, (pk, pv)
+
+    x, (pk, pv) = lax.scan(body, x, (params["layers"], pool["k"], pool["v"]))
+    x = rmsnorm(x, params["final_norm"])
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
     last = lax.dynamic_index_in_dim(
         logits[0], n_tokens - 1, axis=0, keepdims=False)
-    return last, cache
+    return last, {"k": pk, "v": pv}
 
 
-def decode_step(params, tokens, cache, pos, cfg: Config):
+def decode_step(params, tokens, pool, page_tables, pos, cfg: Config,
+                page_tokens: int):
     """One lockstep decode step over the whole slot batch: ``tokens`` [B]
-    int32 (each slot's previous token) at absolute positions ``pos`` [B].
-    Returns (logits [B, vocab] f32, updated cache).
+    int32 (each slot's previous token) at absolute positions ``pos`` [B],
+    written and attended through ``page_tables`` [B, n_blocks]. Returns
+    (logits [B, vocab] f32, updated pool).
 
-    The per-slot generalization of ``cached_forward`` at T=1: mid-flight
-    admission leaves every slot at its own depth, so cache writes are
-    per-row scatters and the attention mask is per-row (_cache_attention
-    takes the [B] position vector directly). Idle slots decode a garbage
-    row the engine discards — the cost of lockstep is one batch row,
-    never a second compiled program.
+    Mid-flight admission leaves every slot at its own depth, so the K/V
+    write is a per-row scatter at (table[b, pos // page], pos % page)
+    and the attention mask is per-row (_cache_attention takes the [B]
+    position vector directly). Idle slots decode a garbage row the
+    engine discards; their page tables are all-zero, so their writes
+    land in scratch page 0, never in a page a live request owns. A live
+    row only ever writes the private page covering its own position —
+    shared prefix pages sit strictly below ``pos`` and are read-only by
+    construction.
     """
     B = tokens.shape[0]
-    S = cache["k"].shape[2]
+    nb = page_tables.shape[1]
+    S = nb * page_tokens
     cfg = _no_drop(cfg)
     params = jax.tree.map(jnp.asarray, params)
     cos, sin = rope_frequencies(cfg.head_dim, S, cfg.rope_theta)
     positions = pos[:, None]  # [B, 1]
     x = params["embed"][tokens[:, None]].astype(cfg.dtype)
     rows = jnp.arange(B)
+    # Idle rows' clamped positions may point one block past the table;
+    # the index clamp keeps the gather in range and the all-zero idle
+    # table routes the write to scratch page 0 either way.
+    blk = jnp.minimum(pos // page_tokens, nb - 1)
+    phys = page_tables[rows, blk]  # [B]
+    off = pos % page_tokens
 
     def body(x, inp):
-        layer, ck, cv = inp
+        layer, pk, pv = inp  # [n_pages, page, kvh, hd]
         h = rmsnorm(x, layer["attn_norm"])
         q = (h @ layer["wq"]).reshape(B, 1, cfg.n_heads, cfg.head_dim)
         k = (h @ layer["wk"]).reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
         v = (h @ layer["wv"]).reshape(B, 1, cfg.n_kv_heads, cfg.head_dim)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
-        ck = ck.at[rows, pos].set(k[:, 0])
-        cv = cv.at[rows, pos].set(v[:, 0])
+        pk = pk.at[phys, off].set(k[:, 0])
+        pv = pv.at[phys, off].set(v[:, 0])
+        ck = pk[page_tables].reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+        cv = pv[page_tables].reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
         attn = _cache_attention(q, ck, cv, pos, cfg)
         x = x + attn.reshape(B, 1, cfg.q_dim) @ layer["wo"]
         h = rmsnorm(x, layer["mlp_norm"])
         ffn, _ = _ffn(h, layer, cfg)
-        return x + ffn, (ck, cv)
+        return x + ffn, (pk, pv)
 
-    x, (ck, cv) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x, (pk, pv) = lax.scan(body, x, (params["layers"], pool["k"], pool["v"]))
     x = rmsnorm(x, params["final_norm"])
     logits = (x @ params["lm_head"]).astype(jnp.float32)
-    return logits[:, 0], {"k": ck, "v": cv}
+    return logits[:, 0], {"k": pk, "v": pv}
 
 
 def generate(params, prompt, n_new: int, cfg: Config,

@@ -9,11 +9,13 @@ weight-distribution system; this package puts the request path on top:
   it through the feeder, prestage it to N serving replicas, restore it
   into a params tree (O(1) cache-hit boots after the first replica).
 * ``engine``    — the slot-based continuous-batching scheduler: requests
-  are admitted into a fixed [max_batch, max_seq] decode batch mid-flight
-  (per-slot prefill insert + lockstep decode over a shared KV cache),
-  with per-request retirement, bounded-queue backpressure, and graceful
-  drain. The scheduler stays off the decode hot path the way OIM keeps
-  the control plane off the data path.
+  are admitted into the decode batch mid-flight (per-slot prefill insert
+  + lockstep decode over a PAGED KV cache — a shared page pool addressed
+  by per-slot page tables, ``pagepool``), with per-request page
+  reservation instead of dense max_seq slots, per-request retirement,
+  bounded-queue backpressure (pool exhaustion queues, never OOMs), and
+  graceful drain. The scheduler stays off the decode hot path the way
+  OIM keeps the control plane off the data path.
 * ``service``   — the ``oim.v1.Serve`` gRPC daemon (server-streaming
   token deltas; cancel/deadline evicts the slot).
 * ``registration`` — the replica's TTL-leased ``serve/<id>`` registry
@@ -27,6 +29,7 @@ from oim_tpu.serve.engine import (  # noqa: F401
     QueueFull,
     ServeEngine,
 )
+from oim_tpu.serve.pagepool import PagePool  # noqa: F401
 from oim_tpu.serve.registration import (  # noqa: F401
     SERVE_PREFIX,
     ServeRegistration,

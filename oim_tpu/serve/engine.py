@@ -1,14 +1,24 @@
 """Continuous-batching decode engine: the serving tier's scheduler.
 
-One fixed ``[max_batch, max_seq]`` KV cache is shared by every live
-request. A request is admitted into a free batch row MID-FLIGHT — its
-prefill (models/generate.py ``prefill_into_slot``, batch-1 numerics
-against a fresh zero slot cache) runs between decode steps of the
-residents, then the whole batch advances in lockstep through ONE compiled
-decode program (``decode_step``, per-row positions). Retirement is
-per-slot: an EOS token or the request's max-tokens budget frees the row
-for the next admission, so throughput is bounded by slot occupancy, not
-by the slowest request in a static batch.
+KV storage is a PAGED POOL (serve/pagepool.py): one
+[L, n_pages, page_tokens] device pool shared by every live request,
+addressed through per-slot page tables. Admission reserves only the
+pages the request can actually use — ceil((prompt + max_new - 1) /
+page_tokens) — never a dense ``max_seq`` slot, so short and long
+prompts share one budget and a pool sized below ``max_batch x max_seq``
+still fills every decode slot with short requests. When the pool cannot
+cover the next admission, the request WAITS at the head of the bounded
+queue (pool exhaustion backpressures through the existing QueueFull
+path, never an OOM) until retirements return pages.
+
+A request is admitted into a free batch row MID-FLIGHT — its prefill
+(models/generate.py ``prefill_into_pages``, batch-1 numerics writing
+straight through the slot's page table) runs between decode steps of
+the residents, then the whole batch advances in lockstep through ONE
+compiled decode program (``decode_step``, per-row positions + page
+tables). Retirement is per-slot: an EOS token or the request's
+max-tokens budget returns the slot's pages, so throughput is bounded by
+pool and slot occupancy, not by the slowest request in a static batch.
 
 Scheduling stays off the decode hot path: the engine thread's loop is
 admit-if-free-slot, one device step, emit — no locks are held across the
@@ -16,25 +26,31 @@ device dispatch, and token streams drain through per-request queues so a
 slow consumer never stalls the batch.
 
 Prompt-prefix KV reuse (serve/prefixcache.py): a retiring slot donates
-its prompt's full-block K/V to a content-addressed prefix store (chain
-hashes at ``prefix_block`` granularity, LRU under ``prefix_cache_bytes``
-with the stage cache's OOM valve); an admission copies the longest
-cached prefix into the fresh slot and prefills only the uncached tail —
-shared system prompts stop being re-prefilled per request, without
-changing a single output token (prefix K/V is a pure function of the
-prefix token chain).
+its prompt's full-block pages to a content-addressed prefix store by
+REFERENCE (chain hashes at ``prefix_block`` granularity — one block is
+one page — LRU under ``prefix_cache_bytes``); an admission that matches
+m blocks writes the store's page ids into its own page table and
+prefills only the uncached tail. A hit therefore moves ZERO K/V bytes —
+it is page-table writes plus a refcount — and divergence after the
+shared prefix lands in fresh private pages (copy-on-write by write
+discipline: a slot never writes a page it shares), without changing a
+single output token (prefix K/V is a pure function of the prefix token
+chain).
 
-Invariants the tests pin (tests/test_serve.py):
+Invariants the tests pin (tests/test_serve.py, tests/test_paged_pool.py):
 * outputs are byte-identical to a solo ``generate()`` run per request —
-  admission order, batch-mates, and slot reuse must not change a single
-  token (greedy AND sampled: the per-request RNG chain splits exactly the
-  way generate() does);
-* a retired slot leaks nothing into its next occupant (prefill starts
-  from a zero slot cache and zeroes its pad tail);
+  admission order, batch-mates, slot reuse, and page sharing must not
+  change a single token (greedy AND sampled: the per-request RNG chain
+  splits exactly the way generate() does);
+* a retired slot leaks nothing into its next occupant (stale bytes in a
+  reused page sit strictly above the causal mask's horizon, where the
+  softmax weighs them exactly zero);
 * a full admission queue refuses new work (``QueueFull`` →
-  RESOURCE_EXHAUSTED at the service layer) instead of queueing silently;
-* cancel evicts the slot at the next step boundary;
-* ``stop(drain=True)`` finishes residents, fails the queue as "drained".
+  RESOURCE_EXHAUSTED at the service layer) instead of queueing silently,
+  and an exhausted page pool queues instead of allocating;
+* cancel evicts the slot at the next step boundary and returns every
+  page; ``stop(drain=True)`` finishes residents, fails the queue as
+  "drained", and leaks no page either way.
 """
 
 from __future__ import annotations
@@ -48,9 +64,10 @@ from typing import Any
 
 import numpy as np
 
-from oim_tpu.common import events, looks_oom, metrics as M, prefixhash, tracing
+from oim_tpu.common import events, metrics as M, prefixhash, tracing
 from oim_tpu.common.logging import from_context
 from oim_tpu.models.llama import Config
+from oim_tpu.serve.pagepool import PagePool
 from oim_tpu.serve.prefixcache import PrefixStore
 
 
@@ -135,7 +152,8 @@ class ServeEngine:
     QPS_WINDOW_S = 10.0
     # Smallest prefill bucket: prompts are padded up to the next power of
     # two >= this, so a handful of compiled prefill programs serve every
-    # prompt length (the pad tail's K/V is zeroed by prefill_into_slot).
+    # prompt length (pad K/V never lands: prefill_into_pages drops the
+    # pad scatters at the page-table boundary).
     MIN_PREFILL_BUCKET = 8
 
     # How many hot chain hashes a replica advertises in its heartbeat
@@ -152,6 +170,8 @@ class ServeEngine:
         default_max_new: int = 64,
         prefix_cache_bytes: int = 64 << 20,
         prefix_block: int = 16,
+        kv_page_tokens: int = 0,
+        kv_pool_tokens: int = 0,
     ):
         import jax
         import jax.numpy as jnp
@@ -168,19 +188,54 @@ class ServeEngine:
         self.queue_depth = queue_depth
         self.default_max_new = default_max_new
         # Prompt-prefix KV reuse (serve/prefixcache.py): retired slots
-        # donate their prompt's full-block K/V, admissions copy the
-        # longest cached prefix and prefill only the tail. 0 bytes (or
-        # block < 1) disables it.
+        # donate their prompt's full-block pages by reference,
+        # admissions map the longest cached prefix into their page table
+        # and prefill only the tail. 0 bytes (or block < 1) disables it.
         self.prefix_block = max(1, int(prefix_block))
+        prefix_on = prefix_cache_bytes > 0 and int(prefix_block) >= 1
+        # Paged KV cache: pages default to the prefix-block size so a
+        # prefix block IS a page (the unit zero-copy sharing needs);
+        # the pool defaults to the dense-equivalent max_batch x max_seq
+        # tokens — size it SMALLER to overcommit slots against real
+        # prompt lengths instead of worst-case reservations.
+        self.page_tokens = int(kv_page_tokens) or self.prefix_block
+        if self.page_tokens < 1:
+            raise ValueError(
+                f"kv_page_tokens must be >= 1, got {self.page_tokens}")
+        if prefix_on and self.page_tokens != self.prefix_block:
+            raise ValueError(
+                f"zero-copy prefix sharing needs kv_page_tokens "
+                f"({self.page_tokens}) == prefix_block "
+                f"({self.prefix_block}); set them equal or disable the "
+                f"prefix cache (prefix_cache_bytes=0)")
+        self.n_blocks = -(-max_seq // self.page_tokens)
+        pool_tokens = int(kv_pool_tokens) or max_batch * max_seq
+        if pool_tokens < self.page_tokens:
+            # A flag typo must not boot a replica that then refuses
+            # essentially all traffic from a silently-clamped 1-page
+            # pool — reject it like every other bad knob.
+            raise ValueError(
+                f"kv_pool_tokens ({pool_tokens}) is smaller than one "
+                f"{self.page_tokens}-token page")
+        n_pages = pool_tokens // self.page_tokens
+        page_bytes = (2 * cfg.n_layers * self.page_tokens
+                      * cfg.n_kv_heads * cfg.head_dim
+                      * np.dtype(cfg.dtype).itemsize)
+        self._pagepool = PagePool(n_pages, self.page_tokens, page_bytes)
         self._prefix = (
-            PrefixStore(prefix_cache_bytes, self.prefix_block)
-            if prefix_cache_bytes > 0 and int(prefix_block) >= 1
-            else None)
+            PrefixStore(prefix_cache_bytes, self.prefix_block,
+                        self._pagepool)
+            if prefix_on else None)
         self.params = jax.tree.map(jnp.asarray, params)
-        self._cache = gen.init_cache(cfg, max_batch, max_seq)
+        # +1 physical page: id 0 is the reserved scratch/null page every
+        # unmapped table entry points at (see init_page_pool).
+        self._cache = gen.init_page_pool(
+            cfg, n_pages + 1, self.page_tokens)
+        page = self.page_tokens
 
-        def step(params, cache, tokens, pos, keys, temps):
-            logits, cache = gen.decode_step(params, tokens, cache, pos, cfg)
+        def step(params, cache, tokens, pos, keys, temps, tables):
+            logits, cache = gen.decode_step(
+                params, tokens, cache, tables, pos, cfg, page)
             split = jax.vmap(jax.random.split)(keys)  # [B, 2, key]
             carry, subs = split[:, 0], split[:, 1]
             # Sampling matches generate() bit-for-bit per row: each slot
@@ -207,9 +262,10 @@ class ServeEngine:
 
         self._step = jax.jit(step, donate_argnums=(1,))
 
-        def prefill(params, cache, tokens, n_tokens, slot, key, temp):
-            last, cache = gen.prefill_into_slot(
-                params, tokens, n_tokens, cache, slot, cfg)
+        def prefill(params, cache, tokens, n_tokens, table, start, key,
+                    temp):
+            last, cache = gen.prefill_into_pages(
+                params, tokens, n_tokens, cache, table, start, cfg, page)
             carry, sub = jax.random.split(key)
             safe = jnp.where(temp > 0, temp, 1.0)
             sampled = jax.random.categorical(sub, (last / safe)[None, :])[0]
@@ -217,35 +273,21 @@ class ServeEngine:
                 temp > 0, sampled, jnp.argmax(last)).astype(jnp.int32)
             return tok, cache, carry
 
-        # One compiled program per prompt-length BUCKET (tokens shape is
-        # static); buckets are powers of two, so log2(max_seq) programs
-        # cover every admissible prompt.
+        # ONE prefill program per prompt-length BUCKET (tokens shape is
+        # static; buckets are powers of two, so log2(max_seq) programs
+        # cover every admissible prompt) — and that same program IS the
+        # prefix-cache hit path: on a hit ``tokens`` carries only the
+        # uncached tail and ``start`` (a traced scalar) the cached
+        # depth, while the page table already references the store's
+        # pages. The compile-count discipline carries over from the
+        # dense engine and improves on it: the page-table operand has
+        # ONE fixed shape, so there is no (tail x prefix) bucket
+        # product. The RNG chain is untouched: one split after prefill,
+        # exactly as solo generate() does.
         self._prefill = jax.jit(prefill, donate_argnums=(1,))
 
-        def prefill_resume(params, cache, tokens, n_tokens, slot, key,
-                           temp, pk, pv, prefix_len):
-            last, cache = gen.prefill_into_slot(
-                params, tokens, n_tokens, cache, slot, cfg,
-                prefix={"k": pk, "v": pv}, prefix_len=prefix_len)
-            carry, sub = jax.random.split(key)
-            safe = jnp.where(temp > 0, temp, 1.0)
-            sampled = jax.random.categorical(sub, (last / safe)[None, :])[0]
-            tok = jnp.where(
-                temp > 0, sampled, jnp.argmax(last)).astype(jnp.int32)
-            return tok, cache, carry
-
-        # The prefix-cache-hit admission: ``tokens`` is only the UNCACHED
-        # TAIL (bucketed like the full path), pk/pv the cached prefix K/V
-        # copied in verbatim — PADDED to a power-of-two bucket, with the
-        # real prefix depth a traced scalar, so the program count is
-        # (tail buckets x prefix buckets), log x log, not one compile
-        # per distinct prefix depth stalling the admission path. The
-        # RNG chain is untouched: one split after prefill, exactly as
-        # the full path and solo generate() do.
-        self._prefill_resume = jax.jit(prefill_resume, donate_argnums=(1,))
-
         # Per-slot host state (the scheduler's view; device state is the
-        # cache + whatever the last step returned).
+        # page pool + whatever the last step returned).
         self._slots: list[_Request | None] = [None] * max_batch
         self._tokens = np.zeros(max_batch, np.int32)
         self._pos = np.zeros(max_batch, np.int32)
@@ -253,6 +295,17 @@ class ServeEngine:
         # Zero keys for idle rows (their split/sample is discarded); a
         # slot's real key chain starts at PRNGKey(seed) on admission.
         self._keys = np.zeros((max_batch, 2), np.uint32)
+        # Page tables: host-authored only (the device never mutates
+        # them), uploaded lazily — _tables_dev invalidates on every
+        # admission and retirement, so a freed page can never be
+        # re-allocated while a stale device table still routes an idle
+        # row's writes at it. Unmapped entries are 0 = the scratch page.
+        self._tables = np.zeros((max_batch, self.n_blocks), np.int32)
+        self._tables_dev = None
+        self._slot_pages: list[list[int]] = [[] for _ in range(max_batch)]
+        # Debounces the page_pool_exhausted event: one per episode, not
+        # one per engine-loop spin while blocked.
+        self._pool_blocked = False
         # Device-resident step operands (tokens, pos, keys, temps): the
         # decode hot loop feeds each step the previous step's outputs and
         # never touches the host mirrors above — per-step host work drops
@@ -286,6 +339,16 @@ class ServeEngine:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new}) "
                 f"exceeds the engine's max_seq {self.max_seq}")
+        need = self._blocks_needed(len(prompt), max_new)
+        if need > self._pagepool.n_pages:
+            # A request the whole pool can never hold would queue
+            # forever — refuse it up front (pool exhaustion that CAN
+            # clear backpressures through the queue instead).
+            raise ValueError(
+                f"request needs {need} KV pages "
+                f"({self.page_tokens} tokens each) but the pool holds "
+                f"{self._pagepool.n_pages}; raise kv_pool_tokens or "
+                f"lower max_new_tokens")
         req = _Request(
             prompt=prompt, max_new=max_new, temperature=float(temperature),
             seed=int(seed), eos=int(eos),
@@ -362,6 +425,24 @@ class ServeEngine:
                     "block": self.prefix_block}
         return self._prefix.stats()
 
+    def pool_stats(self) -> dict:
+        """Page-pool census: totals, occupancy, sharing, and the peak
+        watermark the paged-vs-dense acceptance compares against
+        ``dense_equiv_pages`` (what a max_batch x max_seq dense cache
+        would have reserved in page units)."""
+        s = self._pagepool.stats()
+        s["dense_equiv_pages"] = self.max_batch * self.n_blocks
+        return s
+
+    def _blocks_needed(self, n_prompt: int, max_new: int) -> int:
+        """Pages an admission reserves: the positions the request can
+        actually write — prompt [0, n) plus decode [n, n + max_new - 1)
+        (the final token is emitted, never written back) — NOT a dense
+        max_seq slot. This is what lets short requests pack a pool a
+        dense layout would have exhausted."""
+        tokens = max(1, n_prompt + max_new - 1)
+        return -(-tokens // self.page_tokens)
+
     # -- engine loop --------------------------------------------------------
 
     def _run(self) -> None:
@@ -405,6 +486,10 @@ class ServeEngine:
     def _evict_all(self, reason: str) -> None:
         for i, req in enumerate(self._slots):
             if req is not None:
+                # Hard eviction (ungraceful stop / engine error): no
+                # prefix donation, but every page MUST return — the
+                # pool outlives the request and leaks are forever.
+                self._release_slot(i, req, retain=False)
                 self._slots[i] = None
                 events.emit(events.SLOT_EVICTED,
                             trace_id=self._trace_id(req), slot=i,
@@ -475,39 +560,58 @@ class ServeEngine:
 
     def _admit(self) -> None:
         """Insert queued requests into free slots (prefill between decode
-        steps: new work overlaps residents' decoding at step granularity)."""
+        steps: new work overlaps residents' decoding at step granularity).
+        Admission reserves the request's pages first; an exhausted pool
+        leaves the request AT THE HEAD of the queue (FIFO preserved) and
+        returns — retirements free pages, the next loop pass retries.
+        The head is PEEKED, not popped, until its pages are mapped: only
+        this thread ever removes from the left, so the peek is safe, and
+        a blocked admission never transiently shrinks the queue (which
+        would let a submit slip past the queue-depth bound while the
+        pool is the real bottleneck)."""
         while True:
             with self._lock:
                 free = next(
                     (i for i, s in enumerate(self._slots) if s is None), None)
                 if free is None or not self._pending:
                     return
-                req = self._pending.popleft()
-                M.SERVE_QUEUE_DEPTH.set(len(self._pending))
-            if req.cancelled.is_set():
+                req = self._pending[0]
+                cancelled = req.cancelled.is_set()
+                if cancelled:
+                    self._pending.popleft()
+                    M.SERVE_QUEUE_DEPTH.set(len(self._pending))
+            if cancelled:
                 self._finish(req, "cancelled")
                 continue
-            req.admitted_at = time.monotonic()
-            # Admission backpressure, made visible: how long the bounded
-            # queue held this request before its prefill started (the
-            # request's trace_id rides the bucket as an exemplar).
-            M.SERVE_QUEUE_WAIT.observe(
-                req.admitted_at - req.submitted_at, self._trace_id(req))
             n = len(req.prompt)
-            chain, m = [], 0
+            m, shared = 0, []
             if self._prefix is not None:
                 chain = prefixhash.usable_hashes(
                     req.prompt, self.prefix_block)
                 m = self._prefix.match(chain)
-                # The bucketed tail write must stay inside the slot
-                # cache: dynamic_update_slice CLAMPS an out-of-range
-                # start, which would land the tail at the wrong
-                # positions — shorten the reused prefix instead.
-                while m and (m * self.prefix_block
-                             + self._bucket(n - m * self.prefix_block)
-                             > self.max_seq):
-                    m -= 1
-            tok, key = self._insert_slot(req, free, n, chain, m)
+                if m:
+                    got = self._prefix.gather(chain[:m])
+                    if got is None:
+                        m = 0  # a link evicted between match and gather
+                    else:
+                        shared = got
+                        # Pin the shared pages NOW: once referenced,
+                        # no eviction (LRU or pressure valve) can free
+                        # them out from under this admission.
+                        self._pagepool.ref(shared)
+            if not self._map_slot(req, free, n, m, shared):
+                return  # still the queue head; retried next loop pass
+            with self._lock:
+                self._pending.popleft()
+                M.SERVE_QUEUE_DEPTH.set(len(self._pending))
+            req.admitted_at = time.monotonic()
+            # Admission backpressure, made visible: how long the bounded
+            # queue (and, now, the page pool) held this request before
+            # its prefill started (the request's trace_id rides the
+            # bucket as an exemplar).
+            M.SERVE_QUEUE_WAIT.observe(
+                req.admitted_at - req.submitted_at, self._trace_id(req))
+            tok, key = self._prefill_slot(req, free, n, m)
             self._sync_host()  # merge device state before writing the row
             self._keys[free] = np.asarray(key)
             self._tokens[free] = tok
@@ -519,115 +623,101 @@ class ServeEngine:
             self._emit(req, tok)
             self._retire_if_done(free, req, tok)
 
-    def _insert_slot(self, req: _Request, free: int, n: int,
-                     chain: list, m: int):
-        """One request's prefill into slot ``free``: the prefix-resume
-        path when ``m`` chain blocks are cached (copy their K/V, forward
-        only the tail), the full path otherwise. Device OOM while
-        MATERIALIZING the prefix operand evicts the store and falls back
-        to the full prefill (the valve fires before the donating jit
-        dispatch — past dispatch the old cache is consumed and there is
-        nothing to fall back onto, so an OOM inside the compiled prefill
-        itself is the same engine-fatal class as one in the full path).
-        Returns (first token, RNG carry)."""
-        jnp = self._jnp
-        if m:
-            inserted = self._prefill_cached(req, free, n, chain, m)
-            if inserted is not None:
-                return inserted
-        if self._prefix is not None:
-            M.SERVE_PREFIX_MISSES.inc()
-        M.SERVE_PREFILL_TOKENS.labels(source="compute").inc(n)
-        padded = np.zeros((1, self._bucket(n)), np.int32)
-        padded[0, :n] = req.prompt
-        with tracing.start_span(
-                "serve.prefill", parent=req.trace_ctx,
-                slot=free, prompt_tokens=n):
-            tok, self._cache, key = self._prefill(
-                self.params, self._cache, jnp.asarray(padded),
-                jnp.int32(n), jnp.int32(free),
-                self._jax.random.PRNGKey(req.seed),
-                jnp.float32(req.temperature))
-            return int(tok), key
+    def _map_slot(self, req: _Request, slot: int, n: int,
+                  m: int, shared: list[int]) -> bool:
+        """Build slot ``slot``'s page table: ``m`` shared prefix pages
+        (already pinned by the caller) followed by freshly allocated
+        private pages for the tail and decode blocks. On pool pressure
+        the prefix store releases unreferenced pages first (never one a
+        live slot still maps — the refcount forbids it); if the pool
+        still cannot cover the request, every pin is undone and False
+        backpressures the admission."""
+        need = self._blocks_needed(n, req.max_new)
+        private = self._pagepool.alloc(need - m)
+        if private is None and self._prefix is not None:
+            # Pressure valve: shed cold cache references back to the
+            # pool. Store-only pages free immediately; pages shared
+            # with live slots are skipped (freeing them is impossible
+            # by refcount, dropping them would gain nothing).
+            deficit = (need - m) - self._pagepool.free_pages
+            self._prefix.release(deficit)
+            private = self._pagepool.alloc(need - m)
+        if private is None:
+            if shared:
+                self._pagepool.unref(shared)
+            if not self._pool_blocked:
+                self._pool_blocked = True
+                events.emit(events.PAGE_POOL_EXHAUSTED,
+                            trace_id=self._trace_id(req),
+                            needed_pages=need - m,
+                            free_pages=self._pagepool.free_pages,
+                            total_pages=self._pagepool.n_pages,
+                            queued=self.queue_len)
+            return False
+        self._pool_blocked = False
+        pages = shared + private
+        self._slot_pages[slot] = pages
+        self._tables[slot, :] = 0
+        self._tables[slot, :len(pages)] = pages
+        self._tables_dev = None
+        return True
 
-    def _prefill_cached(self, req: _Request, free: int, n: int,
-                        chain: list, m: int):
-        """The resume half of _insert_slot: longest-cached-prefix copy +
-        tail-only prefill. Returns None when the resume path cannot run
-        — a chain link evicted between match and gather, or device OOM
-        while assembling the prefix operand (valve: evict the store and
-        let the caller run the full prefill; the slot cache is untouched
-        at that point, so the fallback is always safe)."""
+    def _prefill_slot(self, req: _Request, slot: int, n: int, m: int):
+        """One request's prefill through slot ``slot``'s page table:
+        the first ``m`` blocks are shared store pages read in place
+        (ZERO K/V copies — the hit's device work is the tail forward
+        alone), the tail lands in the slot's private pages. One
+        program serves both (``start`` is traced). Returns (first
+        token, RNG carry)."""
         jnp = self._jnp
-        entries = self._prefix.gather(chain[:m])
-        if entries is None:
-            return None
         P = m * self.prefix_block
-        try:
-            # Pad the prefix operand to its power-of-two bucket (zeros
-            # beyond P are overwritten by the tail / zeroed by the keep
-            # mask), so every prefix depth in the bucket reuses ONE
-            # compiled resume program. block_until_ready forces the
-            # assembly HERE, while falling back is still possible —
-            # past the donating jit dispatch below the old cache is
-            # consumed and an OOM is no longer recoverable.
-            pad = self._bucket(P) - P
-            blocks_k = [e.k for e in entries]
-            blocks_v = [e.v for e in entries]
-            if pad:
-                zeros = jnp.zeros(
-                    blocks_k[0].shape[:1] + (pad,)
-                    + blocks_k[0].shape[2:], blocks_k[0].dtype)
-                blocks_k.append(zeros)
-                blocks_v.append(zeros)
-            pk = jnp.concatenate(blocks_k, axis=1)
-            pv = jnp.concatenate(blocks_v, axis=1)
-            self._jax.block_until_ready((pk, pv))
-        except Exception as exc:  # noqa: BLE001 - OOM valve
-            if not looks_oom(exc):
-                raise
-            self._prefix.evict_all()
-            return None
         tail = req.prompt[P:]
         padded = np.zeros((1, self._bucket(len(tail))), np.int32)
         padded[0, :len(tail)] = tail
+        span_attrs = {"slot": slot, "prompt_tokens": n}
+        if P:
+            span_attrs["prefix_tokens"] = P
         with tracing.start_span(
-                "serve.prefill", parent=req.trace_ctx, slot=free,
-                prompt_tokens=n, prefix_tokens=P):
-            tok, self._cache, key = self._prefill_resume(
+                "serve.prefill", parent=req.trace_ctx, **span_attrs):
+            tok, self._cache, key = self._prefill(
                 self.params, self._cache, jnp.asarray(padded),
-                jnp.int32(len(tail)), jnp.int32(free),
+                jnp.int32(len(tail)),
+                jnp.asarray(self._tables[slot]), jnp.int32(P),
                 self._jax.random.PRNGKey(req.seed),
-                jnp.float32(req.temperature), pk, pv, jnp.int32(P))
+                jnp.float32(req.temperature))
             tok = int(tok)
-        req.prefix_tokens = P
-        M.SERVE_PREFIX_HITS.inc()
-        M.SERVE_PREFILL_TOKENS.labels(source="cache").inc(P)
+        if self._prefix is not None:
+            if P:
+                req.prefix_tokens = P
+                M.SERVE_PREFIX_HITS.inc()
+                M.SERVE_PREFILL_TOKENS.labels(source="cache").inc(P)
+            else:
+                M.SERVE_PREFIX_MISSES.inc()
         M.SERVE_PREFILL_TOKENS.labels(source="compute").inc(n - P)
         return tok, key
 
-    def _retain_prefix(self, slot: int, req: _Request) -> None:
-        """Donate a retiring request's prompt K/V to the prefix store:
-        every FULL block of the prompt, keyed by its chain hash (blocks
-        already resident just get an LRU touch). The slot's prompt
-        region still holds exactly what prefill wrote — decode only
-        appends at positions >= len(prompt) — so the retained bytes are
-        a pure function of the prompt's token chain."""
-        if self._prefix is None:
-            return
-        hashes = prefixhash.chain_hashes(req.prompt, self.prefix_block)
-        if not hashes:
-            return
-        block = self.prefix_block
-        ck, cv = self._cache["k"], self._cache["v"]
-
-        def materialize(i):
-            # Slices are independent device buffers: they outlive the
-            # parent cache's donation to the next step.
-            return (ck[:, slot, i * block:(i + 1) * block],
-                    cv[:, slot, i * block:(i + 1) * block])
-
-        self._prefix.retain(hashes, materialize)
+    def _release_slot(self, slot: int, req: _Request,
+                      retain: bool = True) -> None:
+        """Return a retiring slot's pages to the pool. With ``retain``,
+        first donate the prompt's FULL blocks to the prefix store BY
+        REFERENCE — the store refs the very pages the prefill wrote, no
+        slice-out copy — then drop the slot's own references (donated
+        pages stay resident under the store's ref; undonated ones free
+        when this was the last ref). The page table row zeroes so the
+        now-idle decode row writes scratch page 0, never a page the
+        pool may hand to the next admission. Retained bytes are a pure
+        function of the prompt's token chain: decode only writes
+        positions >= len(prompt), which live in later pages."""
+        pages = self._slot_pages[slot]
+        if retain and self._prefix is not None and pages:
+            hashes = prefixhash.chain_hashes(req.prompt, self.prefix_block)
+            if hashes:
+                self._prefix.retain(hashes, pages[:len(hashes)])
+        if pages:
+            self._pagepool.unref(pages)
+        self._slot_pages[slot] = []
+        self._tables[slot, :] = 0
+        self._tables_dev = None
 
     def _retire_if_done(self, slot: int, req: _Request, token: int) -> bool:
         if req.cancelled.is_set():
@@ -638,7 +728,7 @@ class ServeEngine:
             reason = "length"
         else:
             return False
-        self._retain_prefix(slot, req)
+        self._release_slot(slot, req)
         with self._lock:
             self._slots[slot] = None
         if reason == "cancelled":
@@ -669,16 +759,19 @@ class ServeEngine:
             self._dev = (
                 jnp.asarray(self._tokens), jnp.asarray(self._pos),
                 jnp.asarray(self._keys), jnp.asarray(self._temps))
+        if self._tables_dev is None:
+            self._tables_dev = jnp.asarray(self._tables)
         d_tokens, d_pos, d_keys, d_temps = self._dev
         tok, self._cache, keys, pos = self._step(
-            self.params, self._cache, d_tokens, d_pos, d_keys, d_temps)
+            self.params, self._cache, d_tokens, d_pos, d_keys, d_temps,
+            self._tables_dev)
         self._dev = (tok, pos, keys, d_temps)
         tok = np.asarray(tok)  # forces the step; the only per-step fetch
         with self._lock:
             live = [(i, r) for i, r in enumerate(self._slots) if r is not None]
         for i, req in live:
             if req.cancelled.is_set():
-                self._retain_prefix(i, req)
+                self._release_slot(i, req)
                 with self._lock:
                     self._slots[i] = None
                 events.emit(events.SLOT_EVICTED,

@@ -1,65 +1,87 @@
-"""Content-addressed prefix KV store: the serving tier's answer to the
-stage cache.
+"""Content-addressed prefix KV store over the page pool: the serving
+tier's answer to the stage cache, without the copies.
 
 Production prompt traffic is dominated by shared prefixes — system
-prompts, few-shot templates, multi-turn history — and the engine used to
-pay a full prefill for every one of them. This store retains, at slot
-retirement, the K/V a request computed for its prompt's FULL blocks
-(common/prefixhash.py chain hashing), keyed by the chain hash so ``a``
-and ``a+b`` share the ``a`` blocks; the next admission walks its own
-chain, copies the longest cached prefix into the fresh slot, and
-prefills only the uncached tail (models/generate.py ``prefill_into_slot``
-``prefix=`` resume path).
+prompts, few-shot templates, multi-turn history — and the engine used
+to pay a full prefill for every one of them. Under the paged KV cache
+this store holds no K/V of its own: an entry is a REFERENCE (a
+refcounted physical page id, ``serve/pagepool.py``) to the very page a
+retiring request's prompt block already lives in, keyed by the chain
+hash (``common/prefixhash.py``) so ``a`` and ``a+b`` share the ``a``
+blocks. Retirement donates by taking a reference (no slice-out copy);
+an admission that matches m blocks writes the store's page ids straight
+into its slot's page table (no gather-and-copy) and prefills only the
+uncached tail — the hit path's device work is ZERO K/V block moves.
 
-Retention follows the stage cache's discipline (controller/stagecache.py):
-an LRU bounded by ``capacity_bytes`` of resident K/V, plus the
-device-OOM valve — an allocation failure while materializing blocks
-evicts every entry and retries once, so a prefix cache under HBM
-pressure degrades to a plain miss instead of killing the engine.
+Shared pages are immutable by the engine's write discipline: a slot
+only ever writes the private pages covering its tail and decode
+positions, so divergence after a shared prefix lands in fresh pages
+(copy-on-write where the "copy" is computing the divergent block's K/V
+into a private page) and a cached chain can never be corrupted by a
+later request.
+
+Eviction follows the stage cache's discipline — LRU under
+``capacity_bytes`` of referenced pages — but freeing is indirect: an
+evicted entry only DROPS THE STORE'S REFERENCE; the page returns to the
+pool when the last referencing slot retires, never under a live reader
+(the pool-pressure valve ``release()`` therefore skips entries whose
+pages a live slot still shares: evicting them would shed cache without
+yielding a single free page).
 
 K/V at a prompt position is a pure function of the tokens at and before
-it (causal attention, absolute-position RoPE from 0), so the retained
-bytes are exactly what a fresh prefill of the same token chain would
-recompute — reuse preserves the engine's byte-identity-to-solo pin.
+it (causal attention, absolute-position RoPE from 0), so a referenced
+page holds exactly what a fresh prefill of the same token chain would
+recompute — sharing preserves the engine's byte-identity-to-solo pin.
 
 Visibility: oim_serve_prefix_{hits,misses}_total,
-oim_serve_prefix_cache_bytes, oim_serve_prefill_tokens_total{source}.
+oim_serve_prefix_cache_bytes, oim_serve_prefill_tokens_total{source},
+oim_serve_kv_pages_shared.
 """
 
 from __future__ import annotations
 
 import threading
 from collections import OrderedDict
-from typing import Any, Callable, Sequence
+from typing import Sequence
 
-from oim_tpu.common import looks_oom as _looks_oom, metrics as M
+from oim_tpu.common import metrics as M
+from oim_tpu.serve.pagepool import PagePool
 
 
 class PrefixEntry:
-    """One block of cached K/V: ``k``/``v`` are [L, block, kv_heads,
-    head_dim] device arrays covering prompt positions
-    [i*block, (i+1)*block) of the chain the key names."""
+    """One cached block: ``page`` is the physical page id whose
+    [page_tokens] positions hold the K/V for prompt positions
+    [i*block, (i+1)*block) of the chain the key names. The store holds
+    one pool reference for it."""
 
-    __slots__ = ("key", "k", "v", "nbytes")
+    __slots__ = ("key", "page", "nbytes")
 
-    def __init__(self, key: str, k: Any, v: Any):
+    def __init__(self, key: str, page: int, nbytes: int):
         self.key = key
-        self.k = k
-        self.v = v
-        self.nbytes = int(k.nbytes) + int(v.nbytes)
+        self.page = page
+        self.nbytes = nbytes
 
 
 class PrefixStore:
     """Thread-safe LRU of PrefixEntry, bounded by ``capacity_bytes`` of
-    resident K/V. ``capacity_bytes=0`` disables the store (every match
-    is 0, retains are dropped) — the ``--prefix-cache-bytes 0`` off
-    switch costs nothing on the admission path."""
+    referenced pages. ``capacity_bytes=0`` disables the store (every
+    match is 0, retains are dropped) — the ``--prefix-cache-bytes 0``
+    off switch costs nothing on the admission path."""
 
-    def __init__(self, capacity_bytes: int, block: int):
+    def __init__(self, capacity_bytes: int, block: int, pool: PagePool):
         if block < 1:
             raise ValueError(f"prefix block must be >= 1, got {block}")
+        if pool.page_tokens != block:
+            # Zero-copy sharing only works when a prefix block IS a
+            # page: the page table maps whole pages, so a block that
+            # straddled pages could not be referenced, only copied.
+            raise ValueError(
+                f"prefix block ({block} tokens) must equal the KV page "
+                f"size ({pool.page_tokens} tokens) for zero-copy "
+                f"sharing — set --kv-page-tokens == --prefix-block")
         self.capacity_bytes = capacity_bytes
         self.block = block
+        self.pool = pool
         self._entries: OrderedDict[str, PrefixEntry] = OrderedDict()
         self._bytes = 0
         self._lock = threading.Lock()
@@ -84,96 +106,107 @@ class PrefixStore:
                 self._entries.move_to_end(h)
             return m
 
-    def gather(self, hashes: Sequence[str]) -> list[PrefixEntry] | None:
-        """The entries for a matched chain, in order; None if any link
-        was evicted since ``match`` (the caller falls back to a full
-        prefill — never a partial, misaligned copy)."""
+    def gather(self, hashes: Sequence[str]) -> list[int] | None:
+        """The physical page ids for a matched chain, in block order;
+        None if any link was evicted since ``match`` (the caller falls
+        back to a full prefill — never a partial, misaligned mapping).
+        The caller must ``pool.ref()`` the returned pages before
+        anything else can evict them (the engine does so while the
+        admission holds them)."""
         with self._lock:
             out = []
             for h in hashes:
                 entry = self._entries.get(h)
                 if entry is None:
                     return None
-                out.append(entry)
+                out.append(entry.page)
             return out
 
     # -- retention ---------------------------------------------------------
 
     def retain(self, hashes: Sequence[str],
-               materialize: Callable[[int], tuple[Any, Any]]) -> int:
-        """Insert the missing blocks of a retiring request's chain.
-        ``materialize(i)`` produces block i's (k, v) device arrays —
-        called only for absent blocks, inside the OOM valve: an
-        allocation failure evicts the whole store and retries once, and
-        a second failure (or nothing left to evict) DROPS the retain —
-        never raises OOM to the caller, because the caller is the
-        engine loop and a prefix cache must shed load under memory
-        pressure, not kill the replica. Non-OOM errors surface.
-        Returns blocks added."""
+               pages: Sequence[int]) -> int:
+        """Donate a retiring request's full prompt blocks: for each
+        missing hash, take a pool reference on the slot's page for that
+        block and index it — NO K/V moves (the page already holds what
+        the prefill wrote there). Blocks already resident keep the
+        store's existing page and just get the LRU touch; the donor's
+        duplicate page frees when the slot unrefs it. Returns blocks
+        added."""
+        if len(pages) < len(hashes):
+            raise ValueError(
+                f"retain needs one page per hash: {len(hashes)} hashes, "
+                f"{len(pages)} pages")
         added = 0
-        for i, h in enumerate(hashes):
-            with self._lock:
+        with self._lock:
+            if self.capacity_bytes == 0:
+                return 0
+            for h, page in zip(hashes, pages):
                 if h in self._entries:
                     continue
-            try:
-                k, v = materialize(i)
-            except Exception as exc:  # noqa: BLE001 - OOM valve
-                if not _looks_oom(exc):
-                    raise
-                freed = self.evict_all()
-                if i > 0 or freed == 0:
-                    # Nothing to shed, or the valve just wiped this
-                    # chain's own earlier blocks: STOP — inserting the
-                    # deeper blocks alone would leave a rootless chain
-                    # match() can never hit, dead capacity until LRU
-                    # churn clears it.
-                    return 0 if i > 0 else added
-                try:
-                    k, v = materialize(i)
-                except Exception as exc2:  # noqa: BLE001 - still OOM
-                    if not _looks_oom(exc2):
-                        raise
-                    return added  # valve fired and lost: drop it
-            self._insert(PrefixEntry(h, k, v))
-            added += 1
-        # Leave the whole chain root-MRU (same stance as match): a
-        # freshly retained chain must not offer its own root as the
-        # next LRU victim.
-        with self._lock:
+                entry = PrefixEntry(h, page, self.pool.page_bytes)
+                if entry.nbytes > self.capacity_bytes:
+                    break  # one block larger than the whole budget
+                self.pool.ref([page])
+                self._entries[h] = entry
+                self._bytes += entry.nbytes
+                added += 1
+            # Leave the whole chain root-MRU (same stance as match): a
+            # freshly retained chain must not offer its own root as the
+            # next LRU victim; over-capacity eviction below then sheds
+            # other chains — or this one's deepest blocks — first.
             for h in reversed(hashes):
                 if h in self._entries:
                     self._entries.move_to_end(h)
-        return added
-
-    def _insert(self, entry: PrefixEntry) -> None:
-        with self._lock:
-            if self.capacity_bytes == 0 or entry.key in self._entries:
-                return
-            if entry.nbytes > self.capacity_bytes:
-                return  # one block larger than the whole budget
-            while self._bytes + entry.nbytes > self.capacity_bytes \
-                    and self._entries:
+            while self._bytes > self.capacity_bytes and self._entries:
                 self._evict_lru_locked()
-            self._entries[entry.key] = entry
-            self._bytes += entry.nbytes
             M.SERVE_PREFIX_CACHE_BYTES.set(self._bytes)
+        return added
 
     # -- eviction ----------------------------------------------------------
 
-    def _evict_lru_locked(self) -> None:
+    def _evict_lru_locked(self) -> int:
+        """Drop the LRU entry's store reference. Returns pages actually
+        freed (0 when a live slot still shares the page — the page
+        outlives the entry until that slot retires)."""
         _, entry = self._entries.popitem(last=False)
         self._bytes -= entry.nbytes
-        entry.k = entry.v = None  # drop the device references now
+        freed = self.pool.unref([entry.page])
         M.SERVE_PREFIX_CACHE_BYTES.set(self._bytes)
+        return freed
+
+    def release(self, want_pages: int) -> int:
+        """The pool-pressure valve: walk the LRU end dropping entries
+        whose page would ACTUALLY free (store is the last reference)
+        until ``want_pages`` pages returned to the pool or nothing
+        freeable remains. Entries a live slot still shares are SKIPPED —
+        dropping them would shed cache content without yielding a page,
+        and the refcount already guarantees no live reader's page is
+        ever freed. Returns pages freed."""
+        freed = 0
+        with self._lock:
+            if want_pages <= 0 or not self._entries:
+                return 0
+            for key in list(self._entries.keys()):  # LRU -> MRU order
+                if freed >= want_pages:
+                    break
+                entry = self._entries[key]
+                if self.pool.refcount(entry.page) > 1:
+                    continue  # shared with a live slot: frees nothing
+                del self._entries[key]
+                self._bytes -= entry.nbytes
+                freed += self.pool.unref([entry.page])
+            M.SERVE_PREFIX_CACHE_BYTES.set(self._bytes)
+        return freed
 
     def evict_all(self) -> int:
-        """Free every entry NOW (the OOM pressure valve). Returns bytes
-        freed."""
+        """Drop every store reference NOW. Returns pages freed (pages a
+        live slot still maps stay resident until that slot retires)."""
+        freed = 0
         with self._lock:
-            freed = self._bytes
             while self._entries:
-                self._evict_lru_locked()
-            return freed
+                freed += self._evict_lru_locked()
+        return freed
 
     # -- introspection -----------------------------------------------------
 
@@ -193,6 +226,13 @@ class PrefixStore:
                 "capacity_bytes": self.capacity_bytes,
                 "block": self.block,
             }
+
+    def page_of(self, key: str) -> int | None:
+        """The physical page an entry references (tests pin the
+        zero-copy contract by comparing these against slot tables)."""
+        with self._lock:
+            entry = self._entries.get(key)
+            return None if entry is None else entry.page
 
     def __len__(self) -> int:
         with self._lock:

@@ -148,6 +148,8 @@ def serve_capabilities(engine: ServeEngine) -> list[str]:
         f"max_seq:{engine.max_seq}",
         f"queue_depth:{engine.queue_depth}",
         f"vocab:{engine.cfg.vocab}",
+        f"kv_page_tokens:{engine.page_tokens}",
+        f"kv_pool_pages:{engine._pagepool.n_pages}",
     ]
     if engine._prefix is not None:
         caps.append(f"prefix_block:{engine.prefix_block}")

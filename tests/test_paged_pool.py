@@ -1,0 +1,358 @@
+"""Ring-1 tests for the paged KV cache (serve/pagepool.py + the paged
+engine path in serve/engine.py + models/generate.py).
+
+The invariants this PR must hold: KV capacity is a shared page pool,
+not a per-slot ``max_seq`` reservation — a pool holding fewer tokens
+than ``max_batch x max_seq`` still fills every decode slot with short
+requests (more concurrent slots than dense slots of equal HBM); a
+prefix-cache hit performs ZERO K/V block copies (the slot's page table
+references the store's physical pages, pinned by comparing page ids);
+byte-identity to solo ``generate()`` survives oversubscription WITH
+shared pages, greedy and sampled; divergence mid-block after a shared
+prefix never corrupts the cached chain (copy-on-write by write
+discipline); pool exhaustion backpressures through the bounded queue
+(QueueFull, a flight-recorder event, never an OOM); refcount-zero pages
+return to the pool and are reused correctly; and drain/cancel/error all
+release every page — the ``jax.live_arrays``-style leak assertion is
+the pool's own refcount census reaching zero once the store lets go.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from oim_tpu.common import events, prefixhash
+from oim_tpu.models import generate as gen, llama
+from oim_tpu.serve import QueueFull, ServeEngine
+from oim_tpu.serve.pagepool import PagePool
+
+
+def wait_for(predicate, timeout=30.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama.tiny(vocab=64, dim=32, n_layers=2)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def solo_tokens(params, cfg, prompt, n_new, temperature=0.0, seed=0,
+                max_seq=64):
+    out = gen.generate(
+        params, np.asarray([prompt], np.int32), n_new, cfg,
+        temperature=temperature, rng=jax.random.PRNGKey(seed),
+        max_seq=max_seq)
+    return out[0, len(prompt):].tolist()
+
+
+# ---------------------------------------------------------------------------
+# PagePool: the host-side accounting everything above rides on.
+
+
+class TestPagePool:
+    def test_alloc_is_deterministic_and_bounded(self):
+        pool = PagePool(4, page_tokens=8, page_bytes=128)
+        assert pool.alloc(3) == [1, 2, 3]
+        assert pool.alloc(2) is None  # only 1 left: all-or-nothing
+        assert pool.free_pages == 1  # the failed alloc consumed nothing
+        assert pool.alloc(1) == [4]
+
+    def test_refcount_lifecycle_and_reuse(self):
+        pool = PagePool(2, page_tokens=4, page_bytes=64)
+        pages = pool.alloc(2)
+        pool.ref([pages[0]])
+        assert pool.refcount(pages[0]) == 2
+        assert pool.unref(pages) == 1  # page[1] freed, page[0] shared
+        assert pool.used_pages == 1
+        assert pool.unref([pages[0]]) == 1
+        assert pool.used_pages == 0
+        # Freed ids come back (LIFO off the free list).
+        assert sorted(pool.alloc(2)) == sorted(pages)
+
+    def test_shared_gauge_counts_multireferenced_pages(self):
+        pool = PagePool(4, page_tokens=4, page_bytes=64)
+        pages = pool.alloc(2)
+        assert pool.stats()["shared_pages"] == 0
+        pool.ref(pages)
+        assert pool.stats()["shared_pages"] == 2
+        pool.unref([pages[0]])
+        assert pool.stats()["shared_pages"] == 1
+
+    def test_peak_watermark(self):
+        pool = PagePool(8, page_tokens=4, page_bytes=64)
+        a = pool.alloc(5)
+        pool.unref(a)
+        pool.alloc(2)
+        assert pool.stats()["peak_used_pages"] == 5
+        assert pool.stats()["used_pages"] == 2
+
+    def test_misuse_is_loud(self):
+        pool = PagePool(2, page_tokens=4, page_bytes=64)
+        with pytest.raises(ValueError):
+            pool.unref([1])  # never allocated
+        with pytest.raises(ValueError):
+            pool.ref([2])  # never allocated
+        with pytest.raises(ValueError):
+            PagePool(0, page_tokens=4)
+
+
+# ---------------------------------------------------------------------------
+# Engine over the pool: sharing, identity, backpressure, leaks.
+
+
+class TestPagedEngine:
+    def test_oversubscribed_slots_share_pages_byte_identical(self, model):
+        """2 slots on HALF the dense HBM (pool 64 tokens vs dense 128),
+        every request opening on one shared prefix: slots reference the
+        SAME physical pages as the store (zero-copy, pinned by page
+        ids) while greedy and sampled outputs stay byte-identical."""
+        params, cfg = model
+        eng = ServeEngine(params, cfg, max_batch=2, max_seq=64,
+                          queue_depth=16, prefix_block=4,
+                          kv_pool_tokens=64)
+        shared = np.random.RandomState(3).randint(1, 64, 9).tolist()
+        try:
+            # Warm the store (first request misses, retains 2 blocks).
+            warm = eng.submit(shared + [1], max_new=2)
+            assert warm.result(timeout=120) == solo_tokens(
+                params, cfg, shared + [1], 2)
+            chain = prefixhash.usable_hashes(shared + [2], 4)
+            store_pages = [eng._prefix.page_of(h) for h in chain[:2]]
+            assert all(p is not None for p in store_pages)
+
+            # Two long-lived same-prefix residents: while both decode,
+            # their page tables must START with the store's pages (the
+            # zero-copy pin) and the pool must report them shared.
+            a = eng.submit(shared + [2], max_new=20, temperature=0.0,
+                           seed=1)
+            b = eng.submit(shared + [3], max_new=20, temperature=0.7,
+                           seed=2)
+            assert wait_for(lambda: eng.active_slots == 2)
+            tables = eng._tables.copy()
+            for row in tables:
+                assert row[:2].tolist() == store_pages
+            assert eng.pool_stats()["shared_pages"] >= 2
+            assert a.result(timeout=120) == solo_tokens(
+                params, cfg, shared + [2], 20, 0.0, 1)
+            assert b.result(timeout=120) == solo_tokens(
+                params, cfg, shared + [3], 20, 0.7, 2)
+            assert a.stats["prefix_tokens"] == 8
+            assert b.stats["prefix_tokens"] == 8
+        finally:
+            eng.stop(timeout=30)
+
+    def test_cow_divergence_mid_block_never_corrupts_the_chain(self, model):
+        """B shares A's first block but diverges MID-second-block: B's
+        divergent K/V lands in a fresh private page (write discipline =
+        copy-on-write), so a later request resuming A's full chain still
+        reads uncorrupted bytes — all three byte-identical to solo."""
+        params, cfg = model
+        eng = ServeEngine(params, cfg, max_batch=2, max_seq=64,
+                          queue_depth=16, prefix_block=4)
+        a = [11, 12, 13, 14, 21, 22, 23, 24, 9]  # 2 full blocks + 1
+        b = a[:6] + [40, 41, 9]  # diverges at position 5 (mid block 1)
+        try:
+            first = eng.submit(a, max_new=4, temperature=0.6, seed=5)
+            assert first.result(timeout=120) == solo_tokens(
+                params, cfg, a, 4, 0.6, 5)
+            div = eng.submit(b, max_new=4, seed=6)
+            assert div.result(timeout=120) == solo_tokens(
+                params, cfg, b, 4, 0.0, 6)
+            assert div.stats["prefix_tokens"] == 4  # block 0 only
+            again = eng.submit(a, max_new=4, temperature=0.6, seed=5)
+            assert again.result(timeout=120) == solo_tokens(
+                params, cfg, a, 4, 0.6, 5)
+            assert again.stats["prefix_tokens"] == 8  # full chain intact
+        finally:
+            eng.stop(timeout=30)
+
+    def test_pool_exhaustion_backpressures_then_recovers(self, model):
+        """A pool-exhausted admission WAITS in the bounded queue (then
+        QueueFull for the overflow — never an OOM), emits the
+        flight-recorder event, and completes byte-identically once the
+        resident's retirement returns its pages."""
+        params, cfg = model
+        # 26 pages of 16 tokens: the resident's 4 + 400 budget reserves
+        # every one, and its ~400-step decode keeps it resident while
+        # the assertions below observe the blocked state.
+        eng = ServeEngine(params, cfg, max_batch=2, max_seq=512,
+                          queue_depth=1, prefix_cache_bytes=0,
+                          kv_pool_tokens=416)
+        before = len(events.recorder().events(
+            type_=events.PAGE_POOL_EXHAUSTED))
+        try:
+            resident = eng.submit([1, 2, 3, 4], max_new=400)
+            assert wait_for(lambda: eng.active_slots == 1)
+            queued = eng.submit([5, 6], max_new=4)  # no pages left
+            assert wait_for(lambda: len(events.recorder().events(
+                type_=events.PAGE_POOL_EXHAUSTED)) > before)
+            assert eng.active_slots == 1  # a free SLOT, but no pages
+            assert eng.queue_len == 1  # ...so the head stays QUEUED
+            with pytest.raises(QueueFull):
+                eng.submit([7], max_new=2)
+            # The resident retires -> pages free -> the queued request
+            # admits and still matches its solo run exactly.
+            resident.cancel()
+            assert queued.result(timeout=120) == solo_tokens(
+                params, cfg, [5, 6], 4, max_seq=512)
+            resident.result(timeout=120)
+            assert resident.finish_reason == "cancelled"
+        finally:
+            eng.stop(timeout=30)
+
+    def test_more_slots_than_dense_hbm_would_allow(self, model):
+        """The acceptance pin: 4 decode slots on the HBM of 2 dense
+        slots (pool = 128 tokens, dense = 4 x 64) all resident at once
+        — dense admission could never exceed 2."""
+        params, cfg = model
+        eng = ServeEngine(params, cfg, max_batch=4, max_seq=64,
+                          queue_depth=8, prefix_cache_bytes=0,
+                          kv_pool_tokens=128)
+        dense_slots_of_equal_hbm = 128 // 64
+        try:
+            reqs = [([3 + i, 4, 5], 30, 0.0 if i % 2 else 0.9, i)
+                    for i in range(4)]
+            handles = [eng.submit(p, max_new=n, temperature=t, seed=s)
+                       for p, n, t, s in reqs]
+            assert wait_for(lambda: eng.active_slots == 4)
+            assert eng.active_slots > dense_slots_of_equal_hbm
+            stats = eng.pool_stats()
+            assert stats["used_pages"] <= stats["total_pages"]
+            for (p, n, t, s), h in zip(reqs, handles):
+                assert h.result(timeout=120) == solo_tokens(
+                    params, cfg, p, n, t, s)
+        finally:
+            eng.stop(timeout=30)
+
+    def test_refcount_zero_pages_are_reused_correctly(self, model):
+        """Evicting the store returns its pages; the next request maps
+        those very ids and still matches solo — stale bytes in a reused
+        page are invisible behind the causal mask."""
+        params, cfg = model
+        eng = ServeEngine(params, cfg, max_batch=1, max_seq=64,
+                          queue_depth=8, prefix_block=4,
+                          kv_pool_tokens=64)
+        p1 = np.random.RandomState(8).randint(1, 64, 10).tolist()
+        try:
+            eng.submit(p1, max_new=3).result(timeout=120)
+            held = eng.pool_stats()["used_pages"]
+            assert held >= 2  # the store kept p1's full blocks
+            freed = eng._prefix.evict_all()
+            assert freed == held  # no slot left: every page returned
+            assert eng.pool_stats()["used_pages"] == 0
+            p2 = np.random.RandomState(9).randint(1, 64, 12).tolist()
+            h = eng.submit(p2, max_new=5, temperature=0.5, seed=7)
+            assert h.result(timeout=120) == solo_tokens(
+                params, cfg, p2, 5, 0.5, 7)
+        finally:
+            eng.stop(timeout=30)
+
+    def test_drain_and_cancel_release_every_page(self, model):
+        """The leak assertion: after cancel + graceful drain, the pool's
+        only remaining references are the store's; evicting the store
+        brings the refcount census to exactly zero."""
+        params, cfg = model
+        eng = ServeEngine(params, cfg, max_batch=2, max_seq=64,
+                          queue_depth=8, prefix_block=4)
+        resident = eng.submit([1] * 9, max_new=40)
+        victim = eng.submit([2] * 9, max_new=40)
+        assert wait_for(lambda: eng.active_slots == 2)
+        victim.cancel()
+        assert wait_for(lambda: eng.active_slots == 1)
+        eng.stop(drain=True, timeout=60)
+        assert resident.finish_reason == "length"
+        stats = eng.pool_stats()
+        assert stats["peak_used_pages"] > 0
+        store_bytes = eng.prefix_stats()["bytes"]
+        assert store_bytes > 0  # retirement + cancel both donated
+        eng._prefix.evict_all()
+        assert eng.pool_stats()["used_pages"] == 0, \
+            "pages leaked past drain/cancel"
+
+    def test_ungraceful_stop_releases_without_retaining(self, model):
+        params, cfg = model
+        eng = ServeEngine(params, cfg, max_batch=1, max_seq=64,
+                          queue_depth=8, prefix_block=4)
+        eng.submit([4] * 9, max_new=40)
+        assert wait_for(lambda: eng.active_slots == 1)
+        eng.stop(drain=False, timeout=30)
+        # Hard eviction donates nothing; the store may hold nothing yet.
+        eng._prefix.evict_all()
+        assert eng.pool_stats()["used_pages"] == 0
+
+    def test_impossible_request_refused_up_front(self, model):
+        params, cfg = model
+        eng = ServeEngine(params, cfg, max_batch=2, max_seq=64,
+                          queue_depth=8, prefix_cache_bytes=0,
+                          kv_pool_tokens=32)  # 2 pages = 32 tokens
+        try:
+            with pytest.raises(ValueError, match="pool"):
+                eng.submit([1] * 10, max_new=40)  # needs 49 tokens
+            # ...but a request the pool CAN hold is fine.
+            assert eng.submit([1, 2], max_new=4).result(timeout=120) \
+                == solo_tokens(params, cfg, [1, 2], 4)
+        finally:
+            eng.stop(timeout=30)
+
+    def test_top_pages_column_and_pre_upgrade_dash(self):
+        """oimctl --top renders pool occupancy as used/total and
+        degrades to "-" for scrapes that predate the paged cache (the
+        PREFIX-HIT mixed-version stance)."""
+        import json as json_mod
+
+        from oim_tpu.cli.oimctl import render_top, top_row
+        from oim_tpu.common.metrics import Registry
+
+        def scrape(with_pages):
+            reg = Registry()
+            reg.gauge("oim_serve_qps").set(1.0)
+            if with_pages:
+                reg.gauge("oim_serve_kv_pages_total").set(32)
+                reg.gauge("oim_serve_kv_pages_used").set(12)
+            text = reg.render()
+            ev = json_mod.dumps({"events": [], "dropped": 0})
+            return lambda url, timeout=10.0: (
+                ev if "/debug/events" in url else text)
+
+        row = top_row("r0", "ALIVE", "serve", "127.0.0.1:1",
+                      http_get=scrape(True))
+        assert row["pages"] == (12.0, 32.0)
+        assert "12/32" in render_top([row])
+        old = top_row("r0", "ALIVE", "serve", "127.0.0.1:1",
+                      http_get=scrape(False))
+        assert old["pages"] is None
+        rendered = render_top([old])
+        assert "PAGES" in rendered
+
+    def test_page_size_must_match_prefix_block_when_sharing(self, model):
+        params, cfg = model
+        with pytest.raises(ValueError, match="kv_page_tokens"):
+            ServeEngine(params, cfg, max_batch=1, max_seq=64,
+                        prefix_block=4, kv_page_tokens=8)
+        # Prefix cache off: any page size goes.
+        eng = ServeEngine(params, cfg, max_batch=1, max_seq=64,
+                          prefix_cache_bytes=0, kv_page_tokens=8)
+        try:
+            assert eng.page_tokens == 8 and eng._prefix is None
+        finally:
+            eng.stop(drain=False, timeout=30)
+
+    def test_sub_page_pool_refused_not_clamped(self, model):
+        """A pool smaller than one page is a flag typo: it must refuse
+        at construction, never boot a replica that then rejects
+        essentially every request."""
+        params, cfg = model
+        for bad in (8, -128):
+            with pytest.raises(ValueError, match="kv_pool_tokens"):
+                ServeEngine(params, cfg, max_batch=1, max_seq=64,
+                            prefix_cache_bytes=0, kv_pool_tokens=bad)

@@ -80,137 +80,151 @@ class TestChainHashes:
 
 
 # ---------------------------------------------------------------------------
-# The store (serve/prefixcache.py) — numpy stands in for device arrays
-# (the store only needs .nbytes).
+# The store (serve/prefixcache.py) over a bare PagePool — pure host
+# accounting: entries are refcounted page ids, never K/V copies.
 
 
-def _blocks(n, nbytes=1024):
-    return [(np.zeros(nbytes // 2, np.uint8), np.zeros(nbytes // 2, np.uint8))
-            for _ in range(n)]
+def _store(capacity_bytes=1 << 20, block=4, n_pages=16, page_bytes=1024):
+    from oim_tpu.serve.pagepool import PagePool
+
+    pool = PagePool(n_pages, block, page_bytes)
+    return PrefixStore(capacity_bytes, block, pool), pool
 
 
 class TestPrefixStore:
     def test_match_and_gather_longest_chain(self):
-        store = PrefixStore(1 << 20, block=4)
-        blocks = _blocks(3)
-        store.retain(["h0", "h1", "h2"], lambda i: blocks[i])
+        store, pool = _store()
+        pages = pool.alloc(3)
+        store.retain(["h0", "h1", "h2"], pages)
         assert store.match(["h0", "h1", "h2", "h3"]) == 3
         assert store.match(["h0", "hX", "h2"]) == 1  # chain breaks at hX
         assert store.match(["hX"]) == 0
-        chain = store.gather(["h0", "h1"])
-        assert [e.key for e in chain] == ["h0", "h1"]
+        assert store.gather(["h0", "h1"]) == pages[:2]
 
-    def test_retain_skips_resident_blocks(self):
-        store = PrefixStore(1 << 20, block=4)
-        calls = []
+    def test_retain_is_a_reference_not_a_copy(self):
+        # Donation takes a pool reference on the DONOR'S OWN pages: no
+        # bytes move, and the page outlives the donor's retirement.
+        store, pool = _store()
+        pages = pool.alloc(2)
+        assert store.retain(["h0", "h1"], pages) == 2
+        assert [pool.refcount(p) for p in pages] == [2, 2]
+        pool.unref(pages)  # the donor slot retires
+        assert [pool.refcount(p) for p in pages] == [1, 1]
+        assert pool.used_pages == 2  # still resident, store-held
+        assert store.gather(["h0", "h1"]) == pages
 
-        def mat(i):
-            calls.append(i)
-            return _blocks(1)[0]
-
-        assert store.retain(["h0", "h1"], mat) == 2
-        assert store.retain(["h0", "h1", "h2"], mat) == 1
-        assert calls == [0, 1, 2]  # resident blocks never re-materialize
+    def test_retain_skips_resident_blocks_and_frees_duplicates(self):
+        # A second donor of the same chain keeps the store's existing
+        # pages; its own duplicates free when it retires.
+        store, pool = _store()
+        pa = pool.alloc(2)
+        store.retain(["h0", "h1"], pa)
+        pb = pool.alloc(3)
+        assert store.retain(["h0", "h1", "h2"], pb) == 1  # only h2 new
+        assert store.gather(["h0", "h1", "h2"]) == pa + [pb[2]]
+        pool.unref(pa)
+        pool.unref(pb)  # donor B retires: its h0/h1 duplicates free
+        assert pool.refcount(pb[0]) == 0 and pool.refcount(pb[1]) == 0
+        assert pool.used_pages == 3  # pa + pb[2], all store-held
 
     def test_lru_eviction_under_byte_budget(self):
-        # Budget fits exactly 2 blocks; inserting a third evicts the
-        # least-recently-USED (h0 was re-touched by match, so h1 goes).
-        store = PrefixStore(2048, block=4)
-        store.retain(["h0", "h1"], lambda i: _blocks(1, 1024)[0])
+        # Budget fits exactly 2 pages; inserting a third evicts the
+        # least-recently-USED (h0 was re-touched by match, so h1 goes)
+        # and its page returns to the pool (the store held the last ref).
+        store, pool = _store(capacity_bytes=2048)
+        pages = pool.alloc(2)
+        store.retain(["h0", "h1"], pages)
+        pool.unref(pages)  # donor gone: store refs only
         assert store.match(["h0"]) == 1  # touch h0
-        store.retain(["h2"], lambda i: _blocks(1, 1024)[0])
+        p2 = pool.alloc(1)
+        store.retain(["h2"], p2)
+        pool.unref(p2)
         assert "h1" not in store and "h0" in store and "h2" in store
         assert store.stats()["bytes"] == 2048
+        assert pool.refcount(pages[1]) == 0  # h1's page actually freed
+
+    def test_eviction_never_frees_a_page_a_live_slot_references(self):
+        # The ISSUE's leak-assertion fix: evicting an entry only drops
+        # the STORE's reference — a page a live slot still maps stays
+        # allocated until that slot retires, then frees exactly once.
+        store, pool = _store()
+        pages = pool.alloc(2)
+        store.retain(["h0", "h1"], pages)  # refcount 2 (slot + store)
+        freed = store.evict_all()
+        assert freed == 0  # live slot still references both pages
+        assert len(store) == 0
+        assert [pool.refcount(p) for p in pages] == [1, 1]
+        assert pool.used_pages == 2
+        assert pool.unref(pages) == 2  # the slot retires: NOW they free
+        assert pool.used_pages == 0  # nothing leaked, nothing double-freed
+
+    def test_release_frees_cold_pages_and_skips_shared(self):
+        # The pool-pressure valve frees store-only (refcount 1) pages
+        # in LRU order and SKIPS pages a live slot shares — dropping
+        # those would shed cache content without yielding a free page.
+        store, pool = _store()
+        shared = pool.alloc(1)
+        store.retain(["hot"], shared)  # refcount 2: slot still live
+        cold = pool.alloc(2)
+        store.retain(["c0", "c1"], cold)
+        pool.unref(cold)  # cold donor retired: store-only refs
+        assert store.release(1) == 1  # LRU cold page freed
+        assert store.release(5) == 1  # the other cold page; "hot" skipped
+        assert "hot" in store and pool.refcount(shared[0]) == 2
+        assert store.release(1) == 0  # nothing freeable remains
 
     def test_gather_returns_none_on_broken_chain(self):
-        store = PrefixStore(2048, block=4)
-        store.retain(["h0", "h1"], lambda i: _blocks(1, 1024)[0])
-        store.retain(["h2"], lambda i: _blocks(1, 1024)[0])  # evicts h0
+        store, pool = _store(capacity_bytes=2048)
+        pages = pool.alloc(2)
+        store.retain(["h0", "h1"], pages)
+        pool.unref(pages)
+        p2 = pool.alloc(1)
+        store.retain(["h2"], p2)  # evicts h0 (capacity = 2 pages)
+        pool.unref(p2)
         assert store.gather(["h0", "h1"]) is None
 
-    def test_oom_valve_evicts_all_and_retries_once(self):
-        store = PrefixStore(1 << 20, block=4)
-        store.retain(["h0"], lambda i: _blocks(1)[0])
-        attempts = []
-
-        def pressured(i):
-            attempts.append(i)
-            if len(attempts) == 1:
-                raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
-            return _blocks(1)[0]
-
-        assert store.retain(["h1"], pressured) == 1
-        assert len(attempts) == 2  # failed, valve fired, retried
-        assert "h0" not in store  # the valve evicted everything idle
-        assert "h1" in store
-
-    def test_mid_chain_oom_never_leaves_a_rootless_chain(self):
-        """OOM while materializing a DEEP block fires the valve — which
-        wipes the chain's own just-inserted roots — so the retain must
-        STOP there: inserting the deeper blocks alone would strand
-        unmatchable entries that occupy capacity until LRU churn."""
-        store = PrefixStore(1 << 20, block=4)
-        calls = []
-
-        def pressured(i):
-            calls.append(i)
-            if i == 1:
-                raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
-            return _blocks(1)[0]
-
-        assert store.retain(["h0", "h1", "h2"], pressured) == 0
-        assert len(store) == 0  # no rootless h2; nothing resident
-        assert calls == [0, 1]  # never went past the failed block
-
-    def test_oom_never_escapes_retain(self):
-        """The caller is the engine loop: OOM must DROP the retain (with
-        nothing left to evict, or when the post-evict retry fails too),
-        never propagate and kill the replica."""
-        store = PrefixStore(1 << 20, block=4)
-
-        def hopeless(i):
-            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
-
-        assert store.retain(["h0"], hopeless) == 0  # empty store: drop
-        store.retain(["h0"], lambda i: _blocks(1)[0])
-        assert store.retain(["h1"], hopeless) == 0  # retry fails: drop
-        assert len(store) == 0  # the valve did evict before giving up
-
-    def test_non_oom_errors_surface_unretried(self):
-        store = PrefixStore(1 << 20, block=4)
-        store.retain(["h0"], lambda i: _blocks(1)[0])
-        calls = []
-
-        def broken(i):
-            calls.append(i)
-            raise ValueError("not a memory problem")
-
-        with pytest.raises(ValueError):
-            store.retain(["h1"], broken)
-        assert calls == [0]
-        assert "h0" in store  # the valve did NOT fire
-
     def test_capacity_zero_disables(self):
-        store = PrefixStore(0, block=4)
-        store.retain(["h0"], lambda i: _blocks(1)[0])
+        store, pool = _store(capacity_bytes=0)
+        pages = pool.alloc(1)
+        assert store.retain(["h0"], pages) == 0
         assert store.match(["h0"]) == 0 and len(store) == 0
+        assert pool.refcount(pages[0]) == 1  # no store ref was taken
+
+    def test_block_must_equal_page_tokens(self):
+        from oim_tpu.serve.pagepool import PagePool
+
+        pool = PagePool(4, page_tokens=8, page_bytes=1024)
+        with pytest.raises(ValueError, match="page"):
+            PrefixStore(1 << 20, block=4, pool=pool)
+
+    def test_retain_requires_a_page_per_hash(self):
+        store, pool = _store()
+        with pytest.raises(ValueError, match="page per hash"):
+            store.retain(["h0", "h1"], pool.alloc(1))
 
     def test_hot_advertises_roots_first_and_deep_evicts_first(self):
         # A retained chain leaves its ROOT most-recently-used: hot()
         # (the router advertisement) leads with the shared end of the
         # chain, and byte-budget pressure evicts the deepest (least
         # shared) block first — never the root every lookup needs.
-        store = PrefixStore(3 * 1024, block=4)
-        store.retain(["h0", "h1", "h2"], lambda i: _blocks(1, 1024)[0])
+        store, pool = _store(capacity_bytes=3 * 1024)
+        pages = pool.alloc(3)
+        store.retain(["h0", "h1", "h2"], pages)
+        pool.unref(pages)
         assert store.hot(2) == ["h0", "h1"]
-        store.retain(["g0"], lambda i: _blocks(1, 1024)[0])
+        g = pool.alloc(1)
+        store.retain(["g0"], g)
+        pool.unref(g)
         assert "h2" not in store  # deepest went, root survived
         assert "h0" in store and "h1" in store
 
     def test_prefix_cache_bytes_gauge_tracks(self):
-        store = PrefixStore(1 << 20, block=4)
-        store.retain(["g0"], lambda i: _blocks(1, 2048)[0])
+        store, pool = _store(page_bytes=2048)
+        g = pool.alloc(1)
+        store.retain(["g0"], g)
         assert M.SERVE_PREFIX_CACHE_BYTES.value == store.stats()["bytes"]
+        assert store.stats()["bytes"] == 2048
+        pool.unref(g)
         store.evict_all()
         assert M.SERVE_PREFIX_CACHE_BYTES.value == 0
 
