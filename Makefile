@@ -9,7 +9,7 @@
 PY ?= python
 RUFF := $(shell command -v ruff 2>/dev/null)
 
-.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke prefix-smoke paged-smoke
+.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke prefix-smoke paged-smoke spec-smoke
 
 # drift and tsan are standalone conveniences; the full pytest target
 # already runs both (SpecDrift + the TSAN stream test build in-fixture).
@@ -87,6 +87,18 @@ prefix-smoke:
 # in tier-1 as tests/test_paged_smoke.py.
 paged-smoke:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --serve --smoke --prompt-mix
+
+# Speculative-decoding acceptance loop (seconds): the serve smoke with
+# a self-draft proposing 4 tokens per verify round — every greedy
+# output byte-identical to solo generate(), acceptance rate > 0, more
+# than one decode token per target dispatch, zero pages left in EITHER
+# pool (target and draft) after a graceful drain, and the interleaved
+# spec-on vs spec-off inter-token comparison reported — plus a routed
+# mixed-fleet half (2 replicas, one speculating) proving byte-identity
+# through the router wherever the pick lands. Also runs in tier-1 as
+# tests/test_spec_smoke.py.
+spec-smoke:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --serve --smoke --spec-tokens 4
 
 # Observability-plane acceptance loop (seconds): in-process registry +
 # 2 serve replicas + router; one trace_id traced from a /metrics

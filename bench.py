@@ -90,6 +90,16 @@ def main(argv=None) -> int:
                              "same p99 columns, and peak pool pages vs "
                              "the dense reservation (with --smoke: the "
                              "asserting paged-KV smoke)")
+    parser.add_argument("--spec-tokens", type=int, default=0,
+                        help="with --serve: speculative decoding — a "
+                             "draft model proposes this many tokens per "
+                             "verify round (the bench drafts with the "
+                             "target itself, so acceptance is "
+                             "deterministic); adds spec_accept_rate / "
+                             "tokens_per_target_step and an interleaved "
+                             "spec-on vs spec-off inter-token min-time "
+                             "comparison (with --smoke: the asserting "
+                             "speculative-decoding smoke)")
     parser.add_argument("--obs-smoke", action="store_true",
                         help="observability-plane acceptance run: one "
                              "trace_id traced from a /metrics exemplar "
@@ -123,7 +133,9 @@ def main(argv=None) -> int:
                           args.replicas,
                           replica_procs=not args.in_process_replicas))
         elif args.smoke:
-            if args.prompt_mix:
+            if args.spec_tokens > 0:
+                extras = spec_smoke(args.spec_tokens)
+            elif args.prompt_mix:
                 extras = paged_smoke()
             elif args.prefix_share > 0:
                 extras = prefix_smoke(args.prefix_share)
@@ -131,7 +143,8 @@ def main(argv=None) -> int:
                 extras = serve_smoke()
         else:
             extras = serve_bench(prefix_share=args.prefix_share,
-                                 prompt_mix=args.prompt_mix)
+                                 prompt_mix=args.prompt_mix,
+                                 spec_tokens=args.spec_tokens)
         print(json.dumps({
             "metric": "serve_qps",
             "value": extras["serve_qps"],
@@ -660,10 +673,36 @@ def bench_llama(chain_short: int, chain_long: int, profile_dir: str = "") -> dic
     }
 
 
+def _hist_quantiles(child, before, qs=(0.5, 0.99)):
+    """Percentile estimates (ms) from a live metrics Histogram child's
+    bucket deltas since ``before`` (a prior ``bucket_snapshot()``) —
+    converted to cumulative le-buckets and fed through the ONE
+    estimator the repo has (`oimctl._histogram_quantile`, the PromQL
+    interpolation `oimctl --top` applies to a scrape), run in-process
+    so the bench surfaces the engine-side ``kind=next`` inter-token
+    cadence without one."""
+    from oim_tpu.cli.oimctl import _histogram_quantile
+
+    bounds, counts, total = child.bucket_snapshot()
+    _, b_counts, b_total = before
+    cum = 0.0
+    buckets = []
+    for bound, c, b in zip(bounds, counts, b_counts):
+        cum += c - b
+        buckets.append((bound, cum))
+    buckets.append((float("inf"), float(total - b_total)))
+    out = []
+    for q in qs:
+        v = _histogram_quantile(buckets, q)
+        out.append(None if v != v else round(v * 1e3, 3))
+    return out
+
+
 def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
                 max_batch: int = 8, max_new: int = 16,
                 verify_all: bool = False, prefix_share: float = 0.0,
-                prefix_block: int = 16, prompt_mix: bool = False) -> dict:
+                prefix_block: int = 16, prompt_mix: bool = False,
+                spec_tokens: int = 0) -> dict:
     """Serving-plane bench: a synthetic OPEN-LOOP load (requests arrive
     on a fixed clock whether or not earlier ones finished — the arrival
     process of real traffic, not a closed feedback loop) against an
@@ -690,6 +729,20 @@ def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
     report gains ``prefix_hit_rate``, ``prefill_tokens_saved`` (prompt
     tokens whose K/V came from the cache instead of the model), and
     first-token p50/p99 split by hit vs miss.
+
+    ``spec_tokens`` > 0 turns on speculative decoding with the TARGET
+    MODEL AS ITS OWN DRAFT (proposals come from the same weights, so
+    greedy acceptance is deterministic and the whole propose/verify/
+    accept machinery runs at its best case — what the smoke gates on;
+    a real deployment points --draft-weights-file at a smaller
+    checkpoint). Greedy outputs stay byte-identical to solo
+    ``generate()``; sampled outputs are distribution-exact, so the
+    byte-identity tripwire checks greedy requests only (the ratio-test
+    mechanism is pinned by tests/test_spec.py). The report gains
+    ``spec_accept_rate``, ``tokens_per_target_step`` (decode tokens per
+    decode/verify dispatch — > 1 is speculation paying off), the
+    post-drain page-leak census for BOTH pools, and an interleaved
+    spec-on vs spec-off inter-token min-time comparison.
 
     ``prompt_mix`` is the paged-KV acceptance workload (ROADMAP item 1):
     bimodal short/long prompt lengths over a page pool sized at HALF
@@ -754,7 +807,10 @@ def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
         engine = ServeEngine(tree, cfg, max_batch=max_batch,
                              max_seq=max_seq, queue_depth=n_requests,
                              prefix_block=prefix_block,
-                             kv_pool_tokens=pool_tokens)
+                             kv_pool_tokens=pool_tokens,
+                             draft_params=tree if spec_tokens else None,
+                             draft_cfg=cfg if spec_tokens else None,
+                             spec_tokens=spec_tokens)
         server = serve_server("tcp://127.0.0.1:0", ServeService(engine))
         # Warmup: compile the prefill bucket + decode program outside the
         # measured window, so first-token latency is queue+prefill time,
@@ -817,6 +873,11 @@ def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
         prefix_before = (
             M2.SERVE_PREFIX_HITS.value, M2.SERVE_PREFIX_MISSES.value,
             M2.SERVE_PREFILL_TOKENS.labels(source="cache").value)
+        # Engine-side inter-token cadence (the kind=next half of
+        # oim_serve_token_latency_seconds) — the speculation headline;
+        # the client-observed gap columns keep measuring the wire.
+        next_child = M2.SERVE_TOKEN_LATENCY.labels(kind="next")
+        next_before = next_child.bucket_snapshot()
         results: list[list[int] | None] = [None] * n_requests
         first_token_s: list[float] = []
         first_hit_s: list[float] = []
@@ -922,6 +983,13 @@ def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
             if results[i] is None:
                 continue
             prompt, n_new, temp, seed = reqs[i]
+            if spec_tokens and temp > 0:
+                # Sampled output under speculation is distribution-
+                # exact, not byte-identical (acceptance draws reshape
+                # the RNG stream); the ratio-test mechanism is pinned
+                # by tests/test_spec.py — greedy rows carry the
+                # byte-identity gate here.
+                continue
             solo = gen.generate(
                 params, np.asarray([prompt], np.int32), n_new, cfg,
                 temperature=temp, rng=jax.random.PRNGKey(seed),
@@ -930,6 +998,21 @@ def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
                 raise AssertionError(
                     f"served tokens diverge from solo generate() for "
                     f"request {i}: {results[i]} != {solo}")
+
+        token_engine_p50, token_engine_p99 = _hist_quantiles(
+            next_child, next_before)
+        engine_stats = engine.stats()
+        mix_pstats = engine.pool_stats() if prompt_mix else None
+        # Graceful drain, then the page-leak census: once the prefix
+        # store lets go of its references, the target pool — and the
+        # draft pool, when speculating — must be EMPTY (what `make
+        # spec-smoke` gates; the finally-clause stop below is then a
+        # no-op).
+        engine.stop(drain=True, timeout=60)
+        if engine._prefix is not None:
+            engine._prefix.evict_all()
+        pages_leaked = engine.pool_stats()["used_pages"]
+        draft_pages_leaked = engine.spec_stats()["draft_used_pages"]
 
         pct = lambda xs, q: (  # noqa: E731
             round(float(np.percentile(xs, q)) * 1e3, 3) if xs else None)
@@ -949,6 +1032,9 @@ def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
             "first_token_p99_ms": pct(first_token_s, 99),
             "token_p50_ms": pct(token_gap_s, 50),
             "token_p99_ms": pct(token_gap_s, 99),
+            "token_engine_p50_ms": token_engine_p50,
+            "token_engine_p99_ms": token_engine_p99,
+            "kv_pages_leaked": int(pages_leaked),
             "weights_bytes": int(pub.bytes),
             "weights_publish_s": round(weights_publish_s, 4),
             "weights_cache_hit": weights_cache_hit,
@@ -964,8 +1050,22 @@ def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
                 "first_token_miss_p50_ms": pct(first_miss_s, 50),
                 "first_token_miss_p99_ms": pct(first_miss_s, 99),
             })
+        if spec_tokens:
+            extras.update({
+                "spec_tokens": spec_tokens,
+                "spec_accept_rate": engine_stats.get("spec_accept_rate"),
+                "spec_proposed": engine_stats.get("spec_proposed"),
+                "spec_accepted": engine_stats.get("spec_accepted"),
+                "spec_rounds": engine_stats.get("spec_rounds"),
+                "spec_fallbacks": engine_stats.get("spec_fallbacks"),
+                "tokens_per_target_step": round(
+                    engine_stats["decode_tokens"]
+                    / max(engine_stats["target_steps"], 1), 3),
+                "draft_pages_leaked": int(draft_pages_leaked),
+            })
+            extras.update(_spec_ab_compare(params, cfg, spec_tokens))
         if prompt_mix:
-            pstats = engine.pool_stats()
+            pstats = mix_pstats
             extras.update({
                 "prompt_mix": True,
                 "slot_occupancy_mean": (
@@ -1000,6 +1100,207 @@ def serve_smoke() -> dict:
     if extras["serve_completed"] != extras["serve_requests"]:
         raise AssertionError(
             f"serve smoke dropped requests: {extras}")
+    return extras
+
+
+def _spec_ab_compare(params, cfg, spec_tokens: int, rounds: int = 2,
+                     n_req: int = 2, max_new: int = 12) -> dict:
+    """Interleaved spec-on vs spec-off inter-token comparison: the same
+    greedy burst against two engines built from the same weights (one
+    speculating with a self-draft, one plain), alternating on/off each
+    round, min-time across rounds (the PR 7 bench discipline for the CI
+    box's minute-scale CPU swings). Reported, NOT gated: with draft ==
+    target on a shared CPU every proposal costs a full target-sized
+    forward, so the 2-core box understates speculation by construction
+    — byte-identity and acceptance are the acceptance criteria."""
+    import threading
+
+    from oim_tpu.serve import ServeEngine
+
+    engines = {
+        "on": ServeEngine(params, cfg, max_batch=n_req, max_seq=64,
+                          queue_depth=16, draft_params=params,
+                          draft_cfg=cfg, spec_tokens=spec_tokens),
+        "off": ServeEngine(params, cfg, max_batch=n_req, max_seq=64,
+                           queue_depth=16),
+    }
+    best_p50: dict = {"on": None, "off": None}
+    best_mean: dict = {"on": None, "off": None}
+    try:
+        for eng in engines.values():
+            # Warm every program off the clock (prefill bucket, decode
+            # step, and — on the spec engine — propose + verify).
+            eng.submit([1, 2, 3], max_new=2).result(timeout=300)
+        for _ in range(rounds):
+            for mode, eng in engines.items():
+                gaps: list = []
+                lock = threading.Lock()
+
+                def consume(handle):
+                    last = None
+                    mine = []
+                    for _tok in handle.tokens(timeout=300):
+                        now = time.monotonic()
+                        if last is not None:
+                            mine.append(now - last)
+                        last = now
+                    with lock:
+                        gaps.extend(mine)
+
+                handles = [eng.submit([5 + i, 7, 9], max_new=max_new,
+                                      seed=i) for i in range(n_req)]
+                threads = [threading.Thread(target=consume, args=(h,),
+                                            daemon=True)
+                           for h in handles]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=300)
+                if gaps:
+                    p50 = float(np.percentile(gaps, 50))
+                    mean = float(np.mean(gaps))
+                    if best_p50[mode] is None or p50 < best_p50[mode]:
+                        best_p50[mode] = p50
+                    if best_mean[mode] is None or mean < best_mean[mode]:
+                        best_mean[mode] = mean
+    finally:
+        for eng in engines.values():
+            eng.stop(drain=False, timeout=30)
+    ms = lambda v: round(v * 1e3, 3) if v is not None else None  # noqa: E731
+    out = {
+        # p50 is the PERCEIVED cadence (a verify round emits its
+        # accepted tokens as a burst, so spec-on p50 collapses toward
+        # 0); the mean is wall time per token — the honest basis for
+        # the speedup ratio.
+        "spec_on_token_p50_ms": ms(best_p50["on"]),
+        "spec_off_token_p50_ms": ms(best_p50["off"]),
+        "spec_on_token_mean_ms": ms(best_mean["on"]),
+        "spec_off_token_mean_ms": ms(best_mean["off"]),
+    }
+    if best_mean["on"] and best_mean["off"]:
+        out["spec_token_speedup"] = round(
+            best_mean["off"] / best_mean["on"], 3)
+    return out
+
+
+def spec_smoke(spec_tokens: int = 4) -> dict:
+    """The speculative-decoding acceptance run (seconds, in-process),
+    two halves:
+
+    1. engine — the serve smoke with a self-draft proposing
+       ``spec_tokens`` per round: every GREEDY output byte-identical to
+       its solo generate() run (sampled rows are distribution-exact —
+       the ratio-test mechanism is pinned by tests/test_spec.py),
+       acceptance rate > 0, more than one decode token per target
+       dispatch, ZERO pages left in either pool after a graceful
+       drain, and the interleaved spec-on/off comparison reported;
+    2. router — 2 replicas behind an oim-router, ONE speculating and
+       one plain (the mixed-fleet shape of a rolling spec rollout):
+       every routed greedy output byte-identical to solo, wherever the
+       least-loaded pick landed it, and no draft page leaked on either
+       replica.
+
+    The tier-1 guard wired in as tests/test_spec_smoke.py and
+    `make spec-smoke`."""
+    import jax
+
+    from oim_tpu.common import tlsutil
+    from oim_tpu.models import generate as gen, llama
+    from oim_tpu.spec import ServeStub, pb
+
+    extras = serve_bench(n_requests=12, offered_rps=24.0, max_batch=4,
+                         max_new=8, verify_all=True,
+                         spec_tokens=spec_tokens)
+    if extras["serve_completed"] != extras["serve_requests"]:
+        raise AssertionError(f"spec smoke dropped requests: {extras}")
+    if not (extras["spec_accept_rate"] or 0) > 0:
+        raise AssertionError(
+            f"spec smoke accepted no draft tokens: {extras}")
+    if not extras["tokens_per_target_step"] > 1:
+        raise AssertionError(
+            f"speculation never advanced more than one token per "
+            f"target step: {extras}")
+    if extras["kv_pages_leaked"] or extras["draft_pages_leaked"]:
+        raise AssertionError(
+            f"page leak after drain (target "
+            f"{extras['kv_pages_leaked']}, draft "
+            f"{extras['draft_pages_leaked']}): {extras}")
+
+    # ---- routed mixed-fleet half -------------------------------------
+    import threading
+
+    cfg = llama.tiny(vocab=64, dim=32, n_layers=2)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    outs: list = [None] * 6
+    errors: list = []
+    with router_cluster(params, cfg, replicas=2, max_batch=2, max_seq=64,
+                        queue_depth=16, heartbeat_s=0.3,
+                        engine_kwargs=[
+                            {"draft_params": params, "draft_cfg": cfg,
+                             "spec_tokens": spec_tokens},
+                            {},
+                        ]) as (router_srv, engines, _regs, _pool):
+        for engine in engines:
+            engine.submit([1, 2, 3], max_new=2).result(timeout=300)
+        rounds_warm = engines[0].stats()["spec_rounds"]
+        tokens_warm = [e.stats()["decode_tokens"] for e in engines]
+
+        def run_routed(i):
+            prompt = [11 + i, 3, 5]
+            try:
+                with tlsutil.dial(router_srv.addr, None) as channel:
+                    toks = []
+                    for delta in ServeStub(channel).Generate(
+                            pb.GenerateRequest(prompt=prompt,
+                                               max_new_tokens=6,
+                                               seed=i),
+                            timeout=120):
+                        toks.extend(delta.tokens)
+                outs[i] = (prompt, toks)
+            except Exception as err:  # noqa: BLE001 - tallied below
+                errors.append(err)
+
+        # CONCURRENT streams: the router's inflight overlay then
+        # spreads them over both replicas, so the speculating one
+        # demonstrably serves routed traffic (sequential sends could
+        # all land on one pick and gate nothing).
+        threads = [threading.Thread(target=run_routed, args=(i,),
+                                    daemon=True) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        if errors:
+            raise AssertionError(
+                f"routed mixed-fleet requests failed: {errors[0]!r}")
+        spec_rounds_routed = engines[0].stats()["spec_rounds"] \
+            - rounds_warm
+        served = [e.stats()["decode_tokens"] - w
+                  for e, w in zip(engines, tokens_warm)]
+    for prompt, toks in outs:
+        solo = gen.generate(
+            params, np.asarray([prompt], np.int32), 6, cfg,
+            temperature=0.0, rng=jax.random.PRNGKey(0),
+            max_seq=64)[0, len(prompt):].tolist()
+        if toks != solo:
+            raise AssertionError(
+                f"mixed-fleet routed tokens diverge from solo: "
+                f"{toks} != {solo}")
+    if spec_rounds_routed < 1 or min(served) < 1:
+        # Byte-identity above must not pass vacuously: the speculating
+        # replica AND the plain one both served routed traffic.
+        raise AssertionError(
+            f"mixed fleet never exercised both replicas "
+            f"(spec rounds {spec_rounds_routed}, decode tokens "
+            f"{served})")
+    draft_leaks = [e.spec_stats()["draft_used_pages"] for e in engines]
+    if any(draft_leaks):
+        raise AssertionError(
+            f"routed half leaked draft pages: {draft_leaks}")
+    extras.update({
+        "router_mixed_fleet_byte_identity": True,
+        "router_spec_replica_rounds": int(spec_rounds_routed),
+    })
     return extras
 
 
@@ -1167,7 +1468,8 @@ def prefix_smoke(prefix_share: float = 0.5) -> dict:
 @contextlib.contextmanager
 def router_cluster(params, cfg, replicas: int, max_batch: int,
                    max_seq: int, queue_depth: int, heartbeat_s: float = 0.5,
-                   stream_tokens: int = 1, unix_sockets: bool = False):
+                   stream_tokens: int = 1, unix_sockets: bool = False,
+                   engine_kwargs: list | None = None):
     """N in-process serve replicas behind an oim-router, wired through a
     real in-process registry: each replica serves ``oim.v1.Serve`` on
     localhost and heartbeats a TTL-leased ``serve/<id>`` load row; the
@@ -1201,8 +1503,14 @@ def router_cluster(params, cfg, replicas: int, max_batch: int,
     router_srv = None
     try:
         for i in range(replicas):
-            engine = ServeEngine(params, cfg, max_batch=max_batch,
-                                 max_seq=max_seq, queue_depth=queue_depth)
+            kwargs = dict(max_batch=max_batch, max_seq=max_seq,
+                          queue_depth=queue_depth)
+            if engine_kwargs:
+                # Per-replica overrides: the mixed-fleet smokes boot
+                # replicas with different engine configs (e.g. one
+                # speculating, one plain) behind one router.
+                kwargs.update(engine_kwargs[i])
+            engine = ServeEngine(params, cfg, **kwargs)
             server = serve_server(
                 endpoint(f"r{i}"),
                 ServeService(engine, stream_tokens=stream_tokens))

@@ -50,7 +50,10 @@ DEFAULT_VOLUME = "weights"
 
 
 def _load_params(args, log):
-    """The params tree + model config from whichever source was given."""
+    """The params tree + model config from whichever source was given.
+    Returns (params, model_cfg, feeder) — feeder is None in
+    checkpoint-dir mode and otherwise shared with the draft loader, so
+    two weights volumes ride one control-plane connection."""
     from oim_tpu.train import TrainConfig, Trainer
 
     if args.checkpoint_dir:
@@ -71,7 +74,7 @@ def _load_params(args, log):
 
             size = save_packed(params, args.pack_to)
             log.info("packed weights", path=args.pack_to, bytes=size)
-        return params, mcfg
+        return params, mcfg, None
 
     # Packed-blob modes need the model config to shape the KV cache; the
     # blob itself carries only the param tree.
@@ -92,6 +95,54 @@ def _load_params(args, log):
             _prestage_peer(feeder, request, peer, log)
     params = restore_weights(feeder, args.weights_volume)
     log.info("restored weights volume", volume=args.weights_volume)
+    return params, mcfg, feeder
+
+
+def _load_draft_params(args, log, feeder=None):
+    """The speculative-decoding draft model, from either draft source.
+    A packed blob rides the exact same control-plane fan-out as the
+    target weights — a SECOND content-addressed volume, published once,
+    prestaged to the same peers, O(1) cache-hit boots on every warmed
+    replica."""
+    from oim_tpu.train import TrainConfig, Trainer
+
+    mcfg = TrainConfig(model=args.draft_model).model_config()
+    if args.draft_checkpoint_dir:
+        cfg = TrainConfig(model=args.draft_model,
+                          checkpoint_dir=args.draft_checkpoint_dir)
+        trainer = Trainer(cfg)
+        step = trainer.init_or_resume()
+        if step == 0:
+            raise SystemExit(
+                f"no draft checkpoint found in "
+                f"{args.draft_checkpoint_dir!r} "
+                "(refusing to speculate from random init)")
+        log.info("restored draft checkpoint", step=step,
+                 model=args.draft_model)
+        return trainer.state.params, mcfg
+
+    if feeder is None:  # target came from a checkpoint dir
+        feeder = _make_feeder(args)
+    from oim_tpu.serve.weights import (
+        publish_weights,
+        restore_weights,
+        weights_request,
+    )
+
+    if args.draft_weights_file:
+        request = weights_request(
+            args.draft_weights_volume, args.draft_weights_file,
+            os.path.getsize(args.draft_weights_file))
+        publish_weights(feeder, args.draft_weights_volume,
+                        args.draft_weights_file)
+        for peer in args.prestage:
+            _prestage_peer(feeder, request, peer, log)
+    # else --draft-restore-only: the volume is already mapped on this
+    # replica's controller (prestaged by a peer's publish) — no blob
+    # file on local disk, no redundant re-publish.
+    params = restore_weights(feeder, args.draft_weights_volume)
+    log.info("restored draft weights volume",
+             volume=args.draft_weights_volume)
     return params, mcfg
 
 
@@ -232,6 +283,45 @@ def main(argv: list[str] | None = None) -> int:
              "prompt+max_new pages, and an exhausted pool queues "
              "(RESOURCE_EXHAUSTED past --queue-depth) instead of "
              "OOMing")
+    parser.add_argument(
+        "--spec-tokens", type=int, default=0,
+        help="speculative decoding: tokens the draft model proposes "
+             "per verify round (0 disables). Needs exactly one draft "
+             "source (--draft-checkpoint-dir or --draft-weights-file). "
+             "Greedy output stays byte-identical to plain decode; "
+             "sampled output is distribution-exact (acceptance ratio "
+             "test); an adaptive valve falls back to plain decode when "
+             "the rolling acceptance rate stops paying")
+    parser.add_argument("--draft-model", default="llama-tiny",
+                        choices=("llama-tiny", "llama-tiny-moe",
+                                 "llama3-8b"),
+                        help="draft model config (must share the "
+                             "target's vocabulary)")
+    parser.add_argument(
+        "--draft-checkpoint-dir", default="",
+        help="restore the draft model from a trainer checkpoint in "
+             "process")
+    parser.add_argument(
+        "--draft-weights-file", default="",
+        help="packed draft weights blob to publish-and-restore as a "
+             "SECOND content-addressed volume (same --prestage fan-out "
+             "as the target weights: publish once, O(1) cache-hit "
+             "boots everywhere)")
+    parser.add_argument(
+        "--draft-weights-volume", default="draft-weights",
+        help="volume id for the draft weights blob")
+    parser.add_argument(
+        "--draft-restore-only", action="store_true",
+        help="remote mode without --draft-weights-file: restore "
+             "--draft-weights-volume as already mapped on the "
+             "controller (a warmed replica boots without the blob "
+             "file — the --restore-only of the draft volume)")
+    parser.add_argument(
+        "--spec-pool-tokens", type=int, default=0,
+        help="total KV tokens in the DRAFT model's page pool (default "
+             "0 = the target pool's token count; the draft's pages are "
+             "smaller in bytes). A request whose draft pages can't be "
+             "mapped decodes plainly instead of waiting")
     parser.add_argument("--stream-tokens", type=int, default=1,
                         help="token-stream granularity: the first token "
                              "flushes immediately, later deltas batch up "
@@ -258,6 +348,21 @@ def main(argv: list[str] | None = None) -> int:
             "exactly one weights source required: --checkpoint-dir, "
             "--weights-file, or --restore-only (+ --weights-volume)"
         )
+    draft_sources = bool(args.draft_checkpoint_dir) \
+        + bool(args.draft_weights_file) + bool(args.draft_restore_only)
+    if args.spec_tokens > 0 and draft_sources != 1:
+        raise SystemExit(
+            "--spec-tokens needs exactly one draft source: "
+            "--draft-checkpoint-dir, --draft-weights-file, or "
+            "--draft-restore-only (+ --draft-weights-volume)")
+    if draft_sources and args.spec_tokens < 1:
+        raise SystemExit(
+            "a draft source without --spec-tokens >= 1 does nothing; "
+            "set the proposal depth or drop the draft flags")
+    if args.draft_restore_only and args.backend:
+        raise SystemExit(
+            "--draft-restore-only restores an already-mapped volume "
+            "and needs remote mode (--registry + --controller-id)")
     if args.prestage and args.backend:
         # _prestage_peer routes through the registry proxy; a local
         # in-process backend has no registry to route through.
@@ -274,7 +379,11 @@ def main(argv: list[str] | None = None) -> int:
 
     from oim_tpu.serve import ServeEngine, ServeService, serve_server
 
-    params, mcfg = _load_params(args, log)
+    params, mcfg, feeder = _load_params(args, log)
+    draft_params, draft_mcfg = (None, None)
+    if args.spec_tokens > 0:
+        draft_params, draft_mcfg = _load_draft_params(
+            args, log, feeder=feeder)
     engine = ServeEngine(
         params, mcfg,
         max_batch=args.max_batch,
@@ -285,6 +394,10 @@ def main(argv: list[str] | None = None) -> int:
         prefix_block=args.prefix_block,
         kv_page_tokens=args.kv_page_tokens,
         kv_pool_tokens=args.kv_pool_tokens,
+        draft_params=draft_params,
+        draft_cfg=draft_mcfg,
+        spec_tokens=args.spec_tokens,
+        spec_pool_tokens=args.spec_pool_tokens,
     )
     server = serve_server(
         args.endpoint,

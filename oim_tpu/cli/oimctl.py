@@ -379,7 +379,8 @@ def top_row(row_id: str, status: str, role: str, target: str,
     row = {"id": row_id, "status": status, "role": role, "qps": None,
            "ft_ms": (None, None), "it_ms": (None, None), "queue": None,
            "slots": None, "cache_hit": None, "prefix_hit": None,
-           "pages": None, "repl_lag": None, "spread": None, "events": {}}
+           "pages": None, "accept": None, "repl_lag": None,
+           "spread": None, "events": {}}
     if status != "ALIVE" or not target:
         return row
     try:
@@ -419,6 +420,23 @@ def top_row(row_id: str, status: str, role: str, target: str,
         pused = _series_value(samples, "oim_serve_kv_pages_used")
         if ptotal is not None and pused is not None and ptotal > 0:
             row["pages"] = (pused, ptotal)
+        # Speculative-decoding acceptance: the valve's ROLLING window
+        # when the scrape carries it (what fallback decisions track),
+        # else the lifetime accepted/proposed ratio. Dash for pre-spec
+        # scrapes (series absent) and for replicas that never
+        # speculated — the PAGES/PREFIX-HIT mixed-version stance.
+        sprop = _series_value(
+            samples, "oim_serve_spec_proposed_tokens_total")
+        sacc = _series_value(
+            samples, "oim_serve_spec_accepted_tokens_total")
+        if sprop is not None and sacc is not None and sprop > 0:
+            rolling = _series_value(
+                samples, "oim_serve_spec_accept_rolling")
+            # `is not None`, not truthiness: a rolling rate of exactly
+            # 0.0 (total collapse) is the one value this column most
+            # needs to show instead of the healthy lifetime ratio.
+            row["accept"] = rolling if rolling is not None \
+                else sacc / sprop
     hits = _series_value(samples, "oim_stage_cache_hits_total")
     misses = _series_value(samples, "oim_stage_cache_misses_total")
     if hits is not None and misses is not None and hits + misses > 0:
@@ -461,8 +479,9 @@ def render_top(rows: list[dict]) -> str:
         return f"{used:g}/{total:g}"
 
     headers = ("ID", "ROLE", "STATUS", "QPS", "FIRST-TOK(ms)",
-               "INTER-TOK(ms)", "QUEUE", "SLOTS", "PAGES", "CACHE-HIT",
-               "PREFIX-HIT", "REPL-LAG", "SPREAD", "EVENTS")
+               "INTER-TOK(ms)", "QUEUE", "SLOTS", "PAGES", "ACCEPT",
+               "CACHE-HIT", "PREFIX-HIT", "REPL-LAG", "SPREAD",
+               "EVENTS")
     table = [headers]
     for r in rows:
         top_events = sorted(r["events"].items(),
@@ -472,6 +491,7 @@ def render_top(rows: list[dict]) -> str:
             fmt_pair(r["ft_ms"]), fmt_pair(r["it_ms"]),
             fmt(r["queue"], "{:g}"), fmt(r["slots"]),
             fmt_pages(r.get("pages")),
+            fmt(r.get("accept"), "{:.0%}"),
             fmt(r["cache_hit"], "{:.0%}"),
             fmt(r.get("prefix_hit"), "{:.0%}"),
             fmt(r["repl_lag"], "{:g}"),

@@ -51,6 +51,7 @@ REPLICA_DRAIN = "replica_drain"
 STAGE_CACHE_EVICTION = "stage_cache_eviction"
 SLOT_EVICTED = "slot_evicted"
 PAGE_POOL_EXHAUSTED = "page_pool_exhausted"
+SPEC_FALLBACK = "spec_fallback"
 
 DEFAULT_CAPACITY = 2048
 

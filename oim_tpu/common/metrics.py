@@ -120,6 +120,16 @@ class _HistogramValue:
         with self._lock:
             return self._sum
 
+    def bucket_snapshot(self) -> tuple[tuple[float, ...],
+                                       tuple[int, ...], int]:
+        """(bucket upper bounds, per-bucket counts, total count) — the
+        raw data in-process quantile estimation needs (bench.py reads
+        engine-side percentiles off the live histogram without a
+        /metrics scrape; per-bucket counts are NON-cumulative, values
+        above the last bound appear only in the total)."""
+        with self._lock:
+            return tuple(self._buckets), tuple(self._counts), self._count
+
     @staticmethod
     def _exemplar_suffix(ex: tuple[str, float, float] | None) -> str:
         # OpenMetrics exemplar: `# {trace_id="..."} <value> <timestamp>`.
@@ -496,6 +506,29 @@ SERVE_FIRST_TOKEN = DEFAULT.histogram(
     labelnames=("prefix",),
     buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
              1.0, 2.5))
+# Speculative decoding (serve/spec.py): draft-model proposals verified
+# by one multi-token target forward per round.
+SERVE_SPEC_PROPOSED = DEFAULT.counter(
+    "oim_serve_spec_proposed_tokens_total",
+    "draft-model tokens proposed to the target verify pass (K per "
+    "speculating slot per verify round)")
+SERVE_SPEC_ACCEPTED = DEFAULT.counter(
+    "oim_serve_spec_accepted_tokens_total",
+    "proposed draft tokens the target accepted (greedy: proposal == "
+    "target argmax; sampled: the ratio test passed); accepted/proposed "
+    "is the LIFETIME ratio — the adaptive valve's rolling window is "
+    "oim_serve_spec_accept_rolling")
+SERVE_SPEC_ACCEPT_ROLLING = DEFAULT.gauge(
+    "oim_serve_spec_accept_rolling",
+    "acceptance rate over the adaptive valve's rolling window of "
+    "verify rounds — what the fallback decision and oimctl --top's "
+    "ACCEPT column actually track (a healthy lifetime ratio can mask "
+    "a draft that stopped predicting the current traffic)")
+SERVE_SPEC_FALLBACK = DEFAULT.counter(
+    "oim_serve_spec_fallback_total",
+    "times the adaptive valve disabled speculation because the rolling "
+    "acceptance rate fell below the floor (the engine decodes plainly "
+    "until the re-probe cooldown lapses)")
 # Request router (oim_tpu/router: least-loaded LB over serve replicas).
 ROUTER_REQUESTS_TOTAL = DEFAULT.counter(
     "oim_router_requests_total",

@@ -258,11 +258,13 @@ def decode_step(params, tokens, pool, page_tables, pos, cfg: Config,
     positions = pos[:, None]  # [B, 1]
     x = params["embed"][tokens[:, None]].astype(cfg.dtype)
     rows = jnp.arange(B)
-    # Idle rows' clamped positions may point one block past the table;
-    # the index clamp keeps the gather in range and the all-zero idle
-    # table routes the write to scratch page 0 either way.
+    # Positions past the table (an idle row's clamped position, or a
+    # draft model speculating past a request's final position) write
+    # scratch page 0 — never the clamped LAST page, which a live row
+    # may own. In-range positions of an idle row land in scratch via
+    # its all-zero table either way.
     blk = jnp.minimum(pos // page_tokens, nb - 1)
-    phys = page_tables[rows, blk]  # [B]
+    phys = jnp.where(pos < S, page_tables[rows, blk], 0)  # [B]
     off = pos % page_tokens
 
     def body(x, inp):
@@ -287,6 +289,74 @@ def decode_step(params, tokens, pool, page_tables, pos, cfg: Config,
     x = rmsnorm(x, params["final_norm"])
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits[:, 0], {"k": pk, "v": pv}
+
+
+def verify_step(params, tokens, pool, page_tables, pos, cfg: Config,
+                page_tokens: int):
+    """The multi-token sibling of ``decode_step``: forward ``tokens``
+    [B, T] (each row's previous token followed by T-1 speculated
+    candidates) at absolute positions pos..pos+T-1 (``pos`` [B]),
+    scattering every position's K/V through the slot page tables and
+    gathering the logical cache for attention. Returns (logits
+    [B, T, vocab] f32, updated pool) — per-row logits for ALL T
+    positions in ONE program, so a draft model's K proposals verify in
+    a single target forward (compiled once per T).
+
+    Write discipline matches ``prefill_into_pages``: positions past the
+    table (t >= S) DROP at the scatter, and a row's unmapped table
+    entries (an idle row's whole table, or positions past a live row's
+    reserved pages) route to scratch page 0 — a verify can therefore
+    never touch a page it does not privately own. Within the program a
+    query at position p attends exactly the positions <= p a sequential
+    decode would have written (this round's candidates included — the
+    scatter lands before the gather), so row logits are the ones T
+    single-token decode_steps would have produced.
+
+    Rejected-suffix discipline (the speculative-decoding contract): the
+    engine advances ``pos`` only past ACCEPTED tokens. K/V written for
+    rejected candidates stays in place but is logically dead — the next
+    round's scatter overwrites positions pos'..pos'+T-1 before its
+    gather, and anything beyond that horizon is masked by ``pos`` with
+    exact-zero softmax weight (the same argument that makes paged
+    attention byte-identical)."""
+    B, T = tokens.shape
+    nb = page_tables.shape[1]
+    S = nb * page_tokens
+    n_pages = pool["k"].shape[1]
+    cfg = _no_drop(cfg)
+    params = jax.tree.map(jnp.asarray, params)
+    cos, sin = rope_frequencies(cfg.head_dim, S, cfg.rope_theta)
+    positions = pos[:, None] + jnp.arange(T)[None, :]  # [B, T]
+    rows = jnp.arange(B)[:, None]
+    blk = jnp.minimum(positions // page_tokens, nb - 1)
+    # Out-of-range physical index + mode="drop": past-the-table K/V
+    # never lands (same stance as prefill_into_pages' pad positions).
+    phys = jnp.where(positions < S, page_tables[rows, blk], n_pages)
+    off = positions % page_tokens
+    x = params["embed"][tokens].astype(cfg.dtype)
+
+    def body(x, inp):
+        layer, pk, pv = inp  # [n_pages, page, kvh, hd]
+        h = rmsnorm(x, layer["attn_norm"])
+        q = (h @ layer["wq"]).reshape(B, T, cfg.n_heads, cfg.head_dim)
+        k = (h @ layer["wk"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        v = (h @ layer["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        pk = pk.at[phys, off].set(k, mode="drop")
+        pv = pv.at[phys, off].set(v, mode="drop")
+        ck = pk[page_tables].reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+        cv = pv[page_tables].reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+        attn = _cache_attention(q, ck, cv, pos, cfg)
+        x = x + attn.reshape(B, T, cfg.q_dim) @ layer["wo"]
+        h = rmsnorm(x, layer["mlp_norm"])
+        ffn, _ = _ffn(h, layer, cfg)
+        return x + ffn, (pk, pv)
+
+    x, (pk, pv) = lax.scan(body, x, (params["layers"], pool["k"], pool["v"]))
+    x = rmsnorm(x, params["final_norm"])
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, {"k": pk, "v": pv}
 
 
 def generate(params, prompt, n_new: int, cfg: Config,

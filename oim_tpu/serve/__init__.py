@@ -16,6 +16,13 @@ weight-distribution system; this package puts the request path on top:
   bounded-queue backpressure (pool exhaustion queues, never OOMs), and
   graceful drain. The scheduler stays off the decode hot path the way
   OIM keeps the control plane off the data path.
+* ``spec``      — speculative decoding: a small draft model proposes K
+  tokens per slot, the target verifies all K in one multi-token
+  forward (``models/generate.py verify_step``); greedy output stays
+  byte-identical to solo ``generate()`` by construction, sampled output
+  is distribution-exact under the standard acceptance ratio test, and
+  an adaptive valve falls back to plain decode when the rolling
+  acceptance rate stops paying for the draft forwards.
 * ``service``   — the ``oim.v1.Serve`` gRPC daemon (server-streaming
   token deltas; cancel/deadline evicts the slot).
 * ``registration`` — the replica's TTL-leased ``serve/<id>`` registry
@@ -37,6 +44,7 @@ from oim_tpu.serve.registration import (  # noqa: F401
     serve_key,
 )
 from oim_tpu.serve.service import ServeService, serve_server  # noqa: F401
+from oim_tpu.serve.spec import AcceptanceValve, accept_tokens  # noqa: F401
 from oim_tpu.serve.weights import (  # noqa: F401
     pack_params,
     publish_weights,

@@ -37,11 +37,31 @@ discipline: a slot never writes a page it shares), without changing a
 single output token (prefix K/V is a pure function of the prefix token
 chain).
 
-Invariants the tests pin (tests/test_serve.py, tests/test_paged_pool.py):
+Speculative decoding (serve/spec.py): with a DRAFT model configured
+(``draft_params``/``draft_cfg``/``spec_tokens=K``), a decode round
+becomes draft-propose (K fused ``decode_step``s over the draft's own
+small page pool) + target-verify (ONE multi-token ``verify_step``
+forward scoring all K candidates) + acceptance — each slot advances
+1..K+1 tokens per target dispatch. Greedy output stays byte-identical
+to solo ``generate()`` by construction (every emitted token is a target
+argmax); sampled output is distribution-exact under the standard ratio
+test. The draft cache lifecycle rides the same admit/retire/cancel/
+drain paths as the target's (a failed draft-page allocation demotes the
+request to plain decode, never delays it), and an adaptive valve drops
+to plain decode when the rolling acceptance rate stops paying for the
+draft forwards.
+
+Invariants the tests pin (tests/test_serve.py, tests/test_paged_pool.py,
+tests/test_spec.py):
 * outputs are byte-identical to a solo ``generate()`` run per request —
   admission order, batch-mates, slot reuse, and page sharing must not
   change a single token (greedy AND sampled: the per-request RNG chain
-  splits exactly the way generate() does);
+  splits exactly the way generate() does). With a DRAFT model
+  configured the pin narrows to GREEDY requests: a speculating
+  engine's sampled rows draw through the acceptance test's K+2-way
+  round splits, so their streams are distribution-exact (the ratio
+  test's guarantee, pinned by tests/test_spec.py) but not bytewise
+  reproductions of the solo chain;
 * a retired slot leaks nothing into its next occupant (stale bytes in a
   reused page sit strictly above the causal mask's horizon, where the
   softmax weighs them exactly zero);
@@ -57,6 +77,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import functools
 import queue
 import threading
 import time
@@ -69,6 +90,7 @@ from oim_tpu.common.logging import from_context
 from oim_tpu.models.llama import Config
 from oim_tpu.serve.pagepool import PagePool
 from oim_tpu.serve.prefixcache import PrefixStore
+from oim_tpu.serve.spec import DRAFT_KEY_FOLD, AcceptanceValve, accept_tokens
 
 
 class QueueFull(Exception):
@@ -147,6 +169,159 @@ class GenHandle:
         }
 
 
+@functools.lru_cache(maxsize=64)
+def _target_programs(cfg: Config, page: int, max_seq: int):
+    """The engine's two jitted target programs — one lockstep decode
+    step, one bucketed prefill — built ONCE per geometry and shared by
+    every ServeEngine in the process. jit caches on the function
+    object, so per-engine closures would recompile byte-identical HLO
+    for each instance (in-process bench replicas, restarted engines,
+    the test suite's dozens of tiny engines all paid full XLA compiles
+    for programs an identical engine had already built).
+
+    Prefill compile discipline: ONE program per prompt-length BUCKET
+    (tokens shape is static; buckets are powers of two, so
+    log2(max_seq) programs cover every admissible prompt) — and that
+    same program IS the prefix-cache hit path: on a hit ``tokens``
+    carries only the uncached tail and ``start`` (a traced scalar) the
+    cached depth, while the page table already references the store's
+    pages. The page-table operand has ONE fixed shape, so there is no
+    (tail x prefix) bucket product. The RNG chain matches solo
+    generate(): one split after prefill, one per decode step."""
+    import jax
+    import jax.numpy as jnp
+
+    from oim_tpu.models import generate as gen
+
+    def step(params, cache, tokens, pos, keys, temps, tables):
+        logits, cache = gen.decode_step(
+            params, tokens, cache, tables, pos, cfg, page)
+        split = jax.vmap(jax.random.split)(keys)  # [B, 2, key]
+        carry, subs = split[:, 0], split[:, 1]
+        # Sampling matches generate() bit-for-bit per row: each slot
+        # samples its OWN key against a [1, vocab] row — the shapes a
+        # solo batch-1 run feeds categorical — so a sampled request's
+        # tokens don't depend on its batch-mates. Greedy rows compute
+        # the (discarded) sampled branch against temperature 1.
+        safe = jnp.where(temps > 0, temps, 1.0)
+
+        def samp(key, row, t):
+            return jax.random.categorical(key, (row / t)[None, :])[0]
+
+        sampled = jax.vmap(samp)(subs, logits, safe)
+        greedy = jnp.argmax(logits, axis=-1)
+        tok = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+        # The step returns its OWN next operands (tok / pos+1 / key
+        # chain), so steady-state decode re-dispatches device arrays
+        # instead of re-uploading host mirrors (see _decode_once).
+        # pos advances for every row; idle rows' garbage positions are
+        # clamped to max_seq so they can't drift without bound (a live
+        # row retires before its position could reach the clamp, so
+        # the clamp never alters a real request's numerics).
+        return tok, cache, carry, jnp.minimum(pos + 1, max_seq)
+
+    def prefill(params, cache, tokens, n_tokens, table, start, key,
+                temp):
+        last, cache = gen.prefill_into_pages(
+            params, tokens, n_tokens, cache, table, start, cfg, page)
+        carry, sub = jax.random.split(key)
+        safe = jnp.where(temp > 0, temp, 1.0)
+        sampled = jax.random.categorical(sub, (last / safe)[None, :])[0]
+        tok = jnp.where(
+            temp > 0, sampled, jnp.argmax(last)).astype(jnp.int32)
+        return tok, cache, carry
+
+    return (jax.jit(step, donate_argnums=(1,)),
+            jax.jit(prefill, donate_argnums=(1,)))
+
+
+@functools.lru_cache(maxsize=64)
+def _spec_programs(cfg: Config, dcfg: Config, page: int, max_seq: int,
+                   K: int):
+    """The three speculative-decoding programs — draft prefill, the
+    scanned K+1-step draft propose, and the fused verify+accept —
+    built once per (target cfg, draft cfg, geometry, K) and shared
+    across engines exactly like :func:`_target_programs`."""
+    import jax
+    import jax.numpy as jnp
+
+    from oim_tpu.models import generate as gen
+
+    def draft_prefill(dparams, dcache, tokens, n_tokens, table, start,
+                      key):
+        # The draft's cache fill at admission: same program shape as
+        # the target prefill (bucketed tokens, traced start), its
+        # logits discarded — the round's first input is always the
+        # TARGET's last emission, so no temperature operand either.
+        # The key splits once, mirroring the target chain's shape.
+        _, dcache = gen.prefill_into_pages(
+            dparams, tokens, n_tokens, dcache, table, start, dcfg,
+            page)
+        carry, _ = jax.random.split(key)
+        return dcache, carry
+
+    def propose(dparams, dcache, tokens, pos, keys, temps, tables):
+        # K+1 draft decode steps in ONE program: each step feeds the
+        # previous token, writes its K/V through the draft page tables
+        # (overflow past a row's reservation lands in scratch page 0 —
+        # decode_step's discipline), and samples the next proposal on
+        # the DRAFT key chain (fold_in-decorrelated from the accept
+        # chain). The EXTRA step ingests the last proposal d_K so its
+        # K/V lands at pos+K: after an ALL-ACCEPT round the next round
+        # starts at pos+K+1 and its scatter never revisits pos+K —
+        # without this write the draft's context would hole exactly
+        # when it performs best, silently eroding acceptance for the
+        # request's rest (the step's own sampled token is discarded).
+        safe = jnp.where(temps > 0, temps, 1.0)
+
+        def one(carry, _):
+            dcache_, tok, pos_, keys_ = carry
+            logits, dcache_ = gen.decode_step(
+                dparams, tok, dcache_, tables, pos_, dcfg, page)
+            split = jax.vmap(jax.random.split)(keys_)
+            carry_keys, subs = split[:, 0], split[:, 1]
+
+            def samp(k, row, t):
+                return jax.random.categorical(
+                    k, (row / t)[None, :])[0]
+
+            sampled = jax.vmap(samp)(subs, logits, safe)
+            greedy = jnp.argmax(logits, axis=-1)
+            nxt = jnp.where(
+                temps > 0, sampled, greedy).astype(jnp.int32)
+            return ((dcache_, nxt,
+                     jnp.minimum(pos_ + 1, max_seq), carry_keys),
+                    (nxt, logits))
+
+        (dcache, _, _, keys), (toks, logits) = jax.lax.scan(
+            one, (dcache, tokens, pos, keys), None, length=K + 1)
+        # scan stacks along axis 0 = the step axis; the verify side
+        # wants the K proposals as [B, K(, V)].
+        return (jnp.swapaxes(toks[:K], 0, 1),
+                jnp.swapaxes(logits[:K], 0, 1), dcache, keys)
+
+    def verify(params_, cache, tokens, pos, keys, temps, tables,
+               draft_toks, draft_logits, spec_mask):
+        seq = jnp.concatenate([tokens[:, None], draft_toks],
+                              axis=1)  # [B, K+1]
+        logits, cache = gen.verify_step(
+            params_, seq, cache, tables, pos, cfg, page)
+        out, n_emit, carry = accept_tokens(
+            logits, draft_toks, draft_logits, temps, keys, spec_mask)
+        rows = jnp.arange(out.shape[0])
+        final = out[rows, n_emit - 1]
+        # Device state advances past every emitted token; a row the
+        # host truncates (eos / max_new mid-round) retires, so its
+        # stale device row is rewritten at the next admission like any
+        # other freed slot.
+        new_pos = jnp.minimum(pos + n_emit, max_seq)
+        return out, n_emit, final, carry, cache, new_pos
+
+    return (jax.jit(draft_prefill, donate_argnums=(1,)),
+            jax.jit(propose, donate_argnums=(1,)),
+            jax.jit(verify, donate_argnums=(1,)))
+
+
 class ServeEngine:
     # Sliding window (seconds) behind the oim_serve_qps gauge.
     QPS_WINDOW_S = 10.0
@@ -172,6 +347,13 @@ class ServeEngine:
         prefix_block: int = 16,
         kv_page_tokens: int = 0,
         kv_pool_tokens: int = 0,
+        draft_params=None,
+        draft_cfg: Config | None = None,
+        spec_tokens: int = 0,
+        spec_pool_tokens: int = 0,
+        spec_accept_floor: float = 0.3,
+        spec_window_rounds: int = 64,
+        spec_reprobe_rounds: int = 256,
     ):
         import jax
         import jax.numpy as jnp
@@ -181,6 +363,23 @@ class ServeEngine:
         if max_batch < 1 or max_seq < 2:
             raise ValueError(f"need max_batch >= 1 and max_seq >= 2, got "
                              f"{max_batch}x{max_seq}")
+        # Speculative decoding needs BOTH halves: a draft model and a
+        # proposal depth (one without the other is a config typo, not a
+        # preference — refuse it like every other bad knob).
+        if (draft_params is None) != (spec_tokens < 1):
+            raise ValueError(
+                "speculative decoding needs draft_params AND "
+                f"spec_tokens >= 1 together (got draft_params="
+                f"{'set' if draft_params is not None else 'None'}, "
+                f"spec_tokens={spec_tokens})")
+        if draft_params is not None:
+            if draft_cfg is None:
+                raise ValueError("draft_params needs draft_cfg")
+            if draft_cfg.vocab != cfg.vocab:
+                raise ValueError(
+                    f"draft vocab ({draft_cfg.vocab}) must equal the "
+                    f"target vocab ({cfg.vocab}): the acceptance ratio "
+                    f"test compares distributions over one vocabulary")
         self._jax, self._jnp = jax, jnp
         self.cfg = cfg
         self.max_batch = max_batch
@@ -232,59 +431,47 @@ class ServeEngine:
         self._cache = gen.init_page_pool(
             cfg, n_pages + 1, self.page_tokens)
         page = self.page_tokens
+        # Jitted programs are SHARED across engine instances of one
+        # geometry (_target_programs / _spec_programs below): jit
+        # caching keys on the function object, so per-engine closures
+        # used to recompile byte-identical HLO for every engine built
+        # in a process — in-process bench replicas and the test suite
+        # paid seconds apiece for programs an identical engine had
+        # already compiled.
+        self._step, self._prefill = _target_programs(cfg, page, max_seq)
 
-        def step(params, cache, tokens, pos, keys, temps, tables):
-            logits, cache = gen.decode_step(
-                params, tokens, cache, tables, pos, cfg, page)
-            split = jax.vmap(jax.random.split)(keys)  # [B, 2, key]
-            carry, subs = split[:, 0], split[:, 1]
-            # Sampling matches generate() bit-for-bit per row: each slot
-            # samples its OWN key against a [1, vocab] row — the shapes a
-            # solo batch-1 run feeds categorical — so a sampled request's
-            # tokens don't depend on its batch-mates. Greedy rows compute
-            # the (discarded) sampled branch against temperature 1.
-            safe = jnp.where(temps > 0, temps, 1.0)
-
-            def samp(key, row, t):
-                return jax.random.categorical(key, (row / t)[None, :])[0]
-
-            sampled = jax.vmap(samp)(subs, logits, safe)
-            greedy = jnp.argmax(logits, axis=-1)
-            tok = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
-            # The step returns its OWN next operands (tok / pos+1 / key
-            # chain), so steady-state decode re-dispatches device arrays
-            # instead of re-uploading host mirrors (see _decode_once).
-            # pos advances for every row; idle rows' garbage positions are
-            # clamped to max_seq so they can't drift without bound (a live
-            # row retires before its position could reach the clamp, so
-            # the clamp never alters a real request's numerics).
-            return tok, cache, carry, jnp.minimum(pos + 1, max_seq)
-
-        self._step = jax.jit(step, donate_argnums=(1,))
-
-        def prefill(params, cache, tokens, n_tokens, table, start, key,
-                    temp):
-            last, cache = gen.prefill_into_pages(
-                params, tokens, n_tokens, cache, table, start, cfg, page)
-            carry, sub = jax.random.split(key)
-            safe = jnp.where(temp > 0, temp, 1.0)
-            sampled = jax.random.categorical(sub, (last / safe)[None, :])[0]
-            tok = jnp.where(
-                temp > 0, sampled, jnp.argmax(last)).astype(jnp.int32)
-            return tok, cache, carry
-
-        # ONE prefill program per prompt-length BUCKET (tokens shape is
-        # static; buckets are powers of two, so log2(max_seq) programs
-        # cover every admissible prompt) — and that same program IS the
-        # prefix-cache hit path: on a hit ``tokens`` carries only the
-        # uncached tail and ``start`` (a traced scalar) the cached
-        # depth, while the page table already references the store's
-        # pages. The compile-count discipline carries over from the
-        # dense engine and improves on it: the page-table operand has
-        # ONE fixed shape, so there is no (tail x prefix) bucket
-        # product. The RNG chain is untouched: one split after prefill,
-        # exactly as solo generate() does.
-        self._prefill = jax.jit(prefill, donate_argnums=(1,))
+        # -- speculative decoding (serve/spec.py): draft propose K
+        # tokens through its OWN small page pool (K lockstep decode
+        # steps fused into one scanned program), target verifies all K
+        # in ONE verify_step forward, acceptance math fused behind it.
+        # Both programs compile once per K.
+        self.spec_tokens = int(spec_tokens) if draft_params is not None \
+            else 0
+        if self.spec_tokens:
+            K = self.spec_tokens
+            dcfg = draft_cfg
+            self._draft_cfg = dcfg
+            self._draft_params = jax.tree.map(jnp.asarray, draft_params)
+            draft_pool_tokens = int(spec_pool_tokens) or pool_tokens
+            if draft_pool_tokens < self.page_tokens:
+                raise ValueError(
+                    f"spec_pool_tokens ({draft_pool_tokens}) is smaller "
+                    f"than one {self.page_tokens}-token page")
+            draft_page_bytes = (2 * dcfg.n_layers * self.page_tokens
+                                * dcfg.n_kv_heads * dcfg.head_dim
+                                * np.dtype(dcfg.dtype).itemsize)
+            n_draft_pages = draft_pool_tokens // self.page_tokens
+            self._draft_pagepool = PagePool(
+                n_draft_pages, self.page_tokens, draft_page_bytes,
+                track_metrics=False)
+            self._draft_cache = gen.init_page_pool(
+                dcfg, n_draft_pages + 1, self.page_tokens)
+            self._valve = AcceptanceValve(
+                floor=spec_accept_floor,
+                window_rounds=spec_window_rounds,
+                reprobe_rounds=spec_reprobe_rounds)
+            self._draft_prefill, self._propose, self._verify = \
+                _spec_programs(cfg, dcfg, page, max_seq, K)
 
         # Per-slot host state (the scheduler's view; device state is the
         # page pool + whatever the last step returned).
@@ -303,6 +490,32 @@ class ServeEngine:
         self._tables = np.zeros((max_batch, self.n_blocks), np.int32)
         self._tables_dev = None
         self._slot_pages: list[list[int]] = [[] for _ in range(max_batch)]
+        # Draft-side slot state (speculative decoding): a row with a
+        # draft page table + pages is a SPEC row — it proposes every
+        # verify round; a row whose draft allocation failed (or that
+        # was admitted while the valve was closed) decodes at exactly
+        # plain speed through the same verify program (spec_mask False
+        # forces its accepted count to 0). All-zero draft tables route
+        # non-spec and idle rows' draft writes to scratch page 0.
+        self._spec_row = [False] * max_batch
+        self._draft_tables = np.zeros((max_batch, self.n_blocks), np.int32)
+        self._draft_tables_dev = None
+        # Device mirror of _spec_row, cached like the page tables: the
+        # mask only changes at admission / retirement / valve flips, so
+        # steady-state verify rounds must not pay a per-round H2D
+        # upload for it (invalidated exactly where _draft_tables_dev
+        # is).
+        self._spec_mask_dev = None
+        self._draft_slot_pages: list[list[int]] = \
+            [[] for _ in range(max_batch)]
+        self._spec_keys = np.zeros((max_batch, 2), np.uint32)
+        self._spec_keys_dev = None
+        self._spec_rounds = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
+        self._spec_fallbacks = 0
+        self._target_steps = 0
+        self._decode_tokens = 0
         # Debounces the page_pool_exhausted event: one per episode, not
         # one per engine-loop spin while blocked.
         self._pool_blocked = False
@@ -331,8 +544,15 @@ class ServeEngine:
         stopping), and ``ValueError`` for an inadmissible request."""
         prompt = [int(t) for t in prompt]
         max_new = int(max_new) or self.default_max_new
+        temperature = float(temperature)
         if not prompt:
             raise ValueError("empty prompt")
+        if temperature < 0:
+            # A negative temperature would flip the logit ordering
+            # mid-stream (garbage sampling, not an error) — fail the
+            # request at admission like every other bad argument.
+            raise ValueError(
+                f"temperature must be >= 0, got {temperature}")
         if max_new < 1:
             raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
         if len(prompt) + max_new > self.max_seq:
@@ -400,14 +620,44 @@ class ServeEngine:
         slots first, queued backlog as the tie-break)."""
         with self._lock:
             active = sum(s is not None for s in self._slots)
-            return {
+            snap = {
                 "free_slots": self.max_batch - active,
                 "active_slots": active,
                 "queue_depth": len(self._pending),
                 "queue_capacity": self.queue_depth,
                 "max_batch": self.max_batch,
                 "ready": not (self._draining or self._stopping),
+                # Decode cadence accounting: tokens emitted by decode /
+                # verify rounds over the rounds that produced them —
+                # tokens_per_target_step > 1 is speculation paying off
+                # (bench.py's headline spec column). Extra keys ride
+                # the heartbeat row; pre-spec routers ignore them
+                # (Replica.parse reads only the fields it knows).
+                "target_steps": self._target_steps,
+                "decode_tokens": self._decode_tokens,
             }
+            if self.spec_tokens:
+                proposed, accepted = self._spec_proposed, \
+                    self._spec_accepted
+                snap.update({
+                    "spec_tokens": self.spec_tokens,
+                    "spec_on": self._valve.open,
+                    "spec_rounds": self._spec_rounds,
+                    "spec_proposed": proposed,
+                    "spec_accepted": accepted,
+                    "spec_accept_rate": (
+                        round(accepted / proposed, 4) if proposed
+                        else None),
+                    # The valve's window — what fallback decisions and
+                    # --top's ACCEPT column track; the lifetime ratio
+                    # above can mask a recent collapse.
+                    "spec_accept_rate_rolling": (
+                        round(r, 4)
+                        if (r := self._valve.rate()) is not None
+                        else None),
+                    "spec_fallbacks": self._spec_fallbacks,
+                })
+            return snap
 
     def hot_prefixes(self, n: int | None = None) -> list[str]:
         """The hottest cached chain hashes (MRU first) — what the
@@ -433,6 +683,26 @@ class ServeEngine:
         s = self._pagepool.stats()
         s["dense_equiv_pages"] = self.max_batch * self.n_blocks
         return s
+
+    def spec_stats(self) -> dict:
+        """Speculation census: the draft pool's occupancy (the leak
+        gate `make spec-smoke` drives to zero after drain) plus the
+        valve state. Zeros when speculation is not configured."""
+        if not self.spec_tokens:
+            return {"enabled": False, "spec_tokens": 0,
+                    "draft_total_pages": 0, "draft_used_pages": 0,
+                    "draft_free_pages": 0, "draft_peak_used_pages": 0,
+                    "spec_on": False}
+        s = self._draft_pagepool.stats()
+        return {
+            "enabled": True,
+            "spec_tokens": self.spec_tokens,
+            "draft_total_pages": s["total_pages"],
+            "draft_used_pages": s["used_pages"],
+            "draft_free_pages": s["free_pages"],
+            "draft_peak_used_pages": s["peak_used_pages"],
+            "spec_on": self._valve.open,
+        }
 
     def _blocks_needed(self, n_prompt: int, max_new: int) -> int:
         """Pages an admission reserves: the positions the request can
@@ -536,6 +806,8 @@ class ServeEngine:
                 prefix="hit" if req.prefix_tokens else "miss").observe(
                 now - base, self._trace_id(req))
         M.SERVE_TOKENS_TOTAL.inc()
+        if kind == "next":
+            self._decode_tokens += 1
         req.last_emit_at = now
         req.emitted += 1
         req.out.put(int(token))
@@ -550,6 +822,9 @@ class ServeEngine:
         """Pull the device-resident step operands back into the host
         mirrors (writable copies) before an admission mutates a row; the
         next decode step re-uploads the merged state once."""
+        if self._spec_keys_dev is not None:
+            self._spec_keys = np.array(self._spec_keys_dev)
+            self._spec_keys_dev = None
         if self._dev is None:
             return
         d_tokens, d_pos, d_keys, _ = self._dev
@@ -601,6 +876,12 @@ class ServeEngine:
                         self._pagepool.ref(shared)
             if not self._map_slot(req, free, n, m, shared):
                 return  # still the queue head; retried next loop pass
+            # The draft half of the slot, best-effort: a request whose
+            # draft pages can't be mapped (draft pool pressure, valve
+            # closed) decodes plainly in the same batch instead of
+            # waiting — target pages are the admission contract, draft
+            # pages only an accelerator.
+            spec_row = self._map_draft_slot(req, free, n)
             with self._lock:
                 self._pending.popleft()
                 M.SERVE_QUEUE_DEPTH.set(len(self._pending))
@@ -612,11 +893,16 @@ class ServeEngine:
             M.SERVE_QUEUE_WAIT.observe(
                 req.admitted_at - req.submitted_at, self._trace_id(req))
             tok, key = self._prefill_slot(req, free, n, m)
+            dkey = self._draft_prefill_slot(req, free, n) if spec_row \
+                else None
             self._sync_host()  # merge device state before writing the row
             self._keys[free] = np.asarray(key)
             self._tokens[free] = tok
             self._pos[free] = n
             self._temps[free] = req.temperature
+            self._spec_row[free] = spec_row
+            if spec_row:
+                self._spec_keys[free] = np.asarray(dkey)
             with self._lock:
                 self._slots[free] = req
             self._occupancy()
@@ -661,6 +947,59 @@ class ServeEngine:
         self._tables[slot, :len(pages)] = pages
         self._tables_dev = None
         return True
+
+    def _map_draft_slot(self, req: _Request, slot: int, n: int) -> bool:
+        """Reserve the request's draft pages (same footprint math as
+        the target: ceil((prompt + max_new - 1) / page) — the draft
+        never needs positions the target can't use). Returns False —
+        plain decode for this request — when speculation is off, the
+        valve is closed, or the draft pool can't cover it; draft
+        exhaustion must never delay an admission the target pool
+        already accepted."""
+        if not self.spec_tokens or not self._valve.open:
+            return False
+        need = self._blocks_needed(n, req.max_new)
+        pages = self._draft_pagepool.alloc(need)
+        if pages is None:
+            return False
+        self._draft_slot_pages[slot] = pages
+        self._draft_tables[slot, :] = 0
+        self._draft_tables[slot, :len(pages)] = pages
+        self._draft_tables_dev = None
+        self._spec_mask_dev = None
+        return True
+
+    def _draft_prefill_slot(self, req: _Request, slot: int, n: int):
+        """Fill the draft model's cache with the prompt (full prefill —
+        the draft keeps no prefix store; it is small by definition).
+        Returns the row's draft RNG carry, fold_in-decorrelated from
+        the target/accept chain that shares the request seed."""
+        jnp = self._jnp
+        padded = np.zeros((1, self._bucket(n)), np.int32)
+        padded[0, :n] = req.prompt
+        key = self._jax.random.fold_in(
+            self._jax.random.PRNGKey(req.seed), DRAFT_KEY_FOLD)
+        with tracing.start_span(
+                "serve.draft_prefill", parent=req.trace_ctx, slot=slot,
+                prompt_tokens=n):
+            self._draft_cache, dkey = self._draft_prefill(
+                self._draft_params, self._draft_cache,
+                jnp.asarray(padded), jnp.int32(n),
+                jnp.asarray(self._draft_tables[slot]), jnp.int32(0),
+                key)
+        return dkey
+
+    def _release_draft(self, slot: int) -> None:
+        """Return a slot's draft pages and zero its draft table (the
+        now-idle row's draft writes go back to scratch page 0)."""
+        pages = self._draft_slot_pages[slot]
+        if pages:
+            self._draft_pagepool.unref(pages)
+        self._draft_slot_pages[slot] = []
+        self._draft_tables[slot, :] = 0
+        self._draft_tables_dev = None
+        self._spec_row[slot] = False
+        self._spec_mask_dev = None
 
     def _prefill_slot(self, req: _Request, slot: int, n: int, m: int):
         """One request's prefill through slot ``slot``'s page table:
@@ -718,6 +1057,8 @@ class ServeEngine:
         self._slot_pages[slot] = []
         self._tables[slot, :] = 0
         self._tables_dev = None
+        if self.spec_tokens:
+            self._release_draft(slot)
 
     def _retire_if_done(self, slot: int, req: _Request, token: int) -> bool:
         if req.cancelled.is_set():
@@ -742,6 +1083,126 @@ class ServeEngine:
         return True
 
     def _decode_once(self) -> None:
+        """One decode round over every resident slot: a speculative
+        draft-propose / target-verify round when a draft model is
+        configured, the valve is open and any live slot holds a draft
+        cache; one plain lockstep decode step otherwise (a closed
+        valve's plain rounds tick the re-probe cooldown)."""
+        if self.spec_tokens:
+            if self._valve.open:
+                with self._lock:
+                    any_spec = any(
+                        r is not None and self._spec_row[i]
+                        for i, r in enumerate(self._slots))
+                if any_spec:
+                    self._spec_once()
+                    return
+            elif self._valve.tick_plain():
+                from_context().info(
+                    "speculation re-probing after cooldown",
+                    reprobe_rounds=self._valve.reprobe_rounds)
+        self._plain_once()
+
+    def _spec_once(self) -> None:
+        """One speculative round: the draft proposes K tokens per row
+        (K fused decode steps over its own page pool), the target
+        verifies all K in ONE multi-token forward, and each live row
+        emits its accepted prefix plus one target-supplied token —
+        1..K+1 tokens for a single target dispatch. Rows without a
+        draft slot ride the same programs at plain-decode semantics
+        (spec_mask pins their accepted count to 0), so mixed
+        spec/non-spec batches stay lockstep."""
+        jnp = self._jnp
+        if self._dev is None:
+            self._dev = (
+                jnp.asarray(self._tokens), jnp.asarray(self._pos),
+                jnp.asarray(self._keys), jnp.asarray(self._temps))
+        if self._tables_dev is None:
+            self._tables_dev = jnp.asarray(self._tables)
+        if self._draft_tables_dev is None:
+            self._draft_tables_dev = jnp.asarray(self._draft_tables)
+        if self._spec_keys_dev is None:
+            self._spec_keys_dev = jnp.asarray(self._spec_keys)
+        d_tokens, d_pos, d_keys, d_temps = self._dev
+        with self._lock:
+            live = [(i, r) for i, r in enumerate(self._slots)
+                    if r is not None]
+            # A True _spec_row implies a live slot (retirement clears
+            # it via _release_draft), so the row list IS the mask.
+            spec_rows = list(self._spec_row)
+        if self._spec_mask_dev is None:
+            self._spec_mask_dev = jnp.asarray(
+                np.array(spec_rows, dtype=bool))
+        draft_toks, draft_logits, self._draft_cache, \
+            self._spec_keys_dev = self._propose(
+                self._draft_params, self._draft_cache, d_tokens, d_pos,
+                self._spec_keys_dev, d_temps, self._draft_tables_dev)
+        out, n_emit, tok, keys, self._cache, pos = self._verify(
+            self.params, self._cache, d_tokens, d_pos, d_keys, d_temps,
+            self._tables_dev, draft_toks, draft_logits,
+            self._spec_mask_dev)
+        self._dev = (tok, pos, keys, d_temps)
+        out = np.asarray(out)  # forces the round; the per-round fetch
+        n_emit = np.asarray(n_emit)
+        self._target_steps += 1
+        self._spec_rounds += 1
+        proposed = self.spec_tokens * sum(spec_rows)
+        accepted = sum(int(n_emit[i]) - 1 for i, _ in live
+                       if spec_rows[i])
+        self._spec_proposed += proposed
+        self._spec_accepted += accepted
+        if proposed:
+            M.SERVE_SPEC_PROPOSED.inc(proposed)
+            if accepted:
+                M.SERVE_SPEC_ACCEPTED.inc(accepted)
+        closed_now = self._valve.observe(proposed, accepted)
+        rolling = self._valve.rate()
+        if rolling is not None:
+            # The gauge tracks the valve's own window (the fallback
+            # signal), not the lifetime counter ratio — a draft that
+            # stopped predicting the current traffic must show up on
+            # the operator surface the moment the valve sees it.
+            M.SERVE_SPEC_ACCEPT_ROLLING.set(round(rolling, 4))
+        if closed_now:
+            # The draft has stopped predicting this traffic: K draft
+            # forwards per round now cost more than the accepted
+            # tokens repay. Fall back to plain decode — live rows
+            # release their draft pages NOW (their caches would only
+            # go stale through the plain rounds) and re-probe after
+            # the cooldown.
+            self._spec_fallbacks += 1
+            M.SERVE_SPEC_FALLBACK.inc()
+            events.emit(events.SPEC_FALLBACK,
+                        accept_floor=self._valve.floor,
+                        window_rounds=self._valve.window_rounds,
+                        reprobe_rounds=self._valve.reprobe_rounds,
+                        proposed_total=self._spec_proposed,
+                        accepted_total=self._spec_accepted)
+            for i, _ in live:
+                if spec_rows[i]:
+                    self._release_draft(i)
+        for i, req in live:
+            if req.cancelled.is_set():
+                self._release_slot(i, req)
+                with self._lock:
+                    self._slots[i] = None
+                events.emit(events.SLOT_EVICTED,
+                            trace_id=self._trace_id(req), slot=i,
+                            reason="cancelled", tokens=req.emitted)
+                self._occupancy()
+                self._finish(req, "cancelled")
+                continue
+            # The device advanced past every token of the round; the
+            # host emits only what the request's budget admits and
+            # stops at the first EOS — a truncated row retires, so its
+            # stale device row is rewritten at the next admission.
+            count = min(int(n_emit[i]), req.max_new - req.emitted)
+            for t in out[i, :count]:
+                self._emit(req, int(t))
+                if self._retire_if_done(i, req, int(t)):
+                    break
+
+    def _plain_once(self) -> None:
         """One lockstep decode step over every resident slot; idle rows
         compute a discarded garbage token.
 
@@ -767,6 +1228,7 @@ class ServeEngine:
             self._tables_dev)
         self._dev = (tok, pos, keys, d_temps)
         tok = np.asarray(tok)  # forces the step; the only per-step fetch
+        self._target_steps += 1
         with self._lock:
             live = [(i, r) for i, r in enumerate(self._slots) if r is not None]
         for i, req in live:

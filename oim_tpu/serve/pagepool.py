@@ -39,7 +39,8 @@ class PagePool:
     layers — the unit the prefix store's byte budget is charged in.
     """
 
-    def __init__(self, n_pages: int, page_tokens: int, page_bytes: int = 0):
+    def __init__(self, n_pages: int, page_tokens: int, page_bytes: int = 0,
+                 track_metrics: bool = True):
         if n_pages < 1:
             raise ValueError(f"need >= 1 usable page, got {n_pages}")
         if page_tokens < 1:
@@ -47,6 +48,10 @@ class PagePool:
         self.n_pages = n_pages
         self.page_tokens = page_tokens
         self.page_bytes = page_bytes
+        # The oim_serve_kv_pages_* gauges describe the replica's ONE
+        # serving pool; a secondary pool (the speculative-decoding
+        # draft model's) keeps its census in stats() only.
+        self.track_metrics = track_metrics
         # pop() from the end => pages allocate 1, 2, 3, ... — handy for
         # deterministic tests and readable page tables.
         self._free = list(range(n_pages, 0, -1))
@@ -54,9 +59,10 @@ class PagePool:
         self._shared = 0  # pages with refcount >= 2
         self._peak_used = 0
         self._lock = threading.Lock()
-        M.SERVE_KV_PAGES_TOTAL.set(n_pages)
-        M.SERVE_KV_PAGES_USED.set(0)
-        M.SERVE_KV_PAGES_SHARED.set(0)
+        if track_metrics:
+            M.SERVE_KV_PAGES_TOTAL.set(n_pages)
+            M.SERVE_KV_PAGES_USED.set(0)
+            M.SERVE_KV_PAGES_SHARED.set(0)
 
     # -- allocation --------------------------------------------------------
 
@@ -136,5 +142,6 @@ class PagePool:
         used = self.n_pages - len(self._free)
         if used > self._peak_used:
             self._peak_used = used
-        M.SERVE_KV_PAGES_USED.set(used)
-        M.SERVE_KV_PAGES_SHARED.set(self._shared)
+        if self.track_metrics:
+            M.SERVE_KV_PAGES_USED.set(used)
+            M.SERVE_KV_PAGES_SHARED.set(self._shared)

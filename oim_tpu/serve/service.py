@@ -153,6 +153,8 @@ def serve_capabilities(engine: ServeEngine) -> list[str]:
     ]
     if engine._prefix is not None:
         caps.append(f"prefix_block:{engine.prefix_block}")
+    if engine.spec_tokens:
+        caps.append(f"spec_tokens:{engine.spec_tokens}")
     return caps
 
 

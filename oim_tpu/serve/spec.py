@@ -1,0 +1,220 @@
+"""Speculative decoding: draft-model propose, target-model verify.
+
+Classic speculative decoding (Leviathan et al. 2023; Chen et al. 2023)
+on this repo's serving primitives: a small DRAFT model proposes K
+tokens per decode slot by running the ordinary paged ``decode_step`` K
+times over its own small page pool, and the TARGET model verifies all
+K in ONE ``models/generate.py verify_step`` forward — per-row logits
+for every candidate position in a single program. The engine then
+emits, per row, the longest accepted prefix of the proposals plus one
+more token the target itself supplies, so a decode round advances a
+slot by 1..K+1 tokens for one target forward.
+
+This module holds the two pieces that make speculation CORRECT rather
+than merely fast:
+
+* ``accept_tokens`` — the acceptance-sampling math, a pure jax
+  function the engine composes with ``verify_step`` inside one jitted
+  program. Greedy rows accept a proposal iff it equals the target's
+  argmax, so greedy output is byte-identical to solo ``generate()`` BY
+  CONSTRUCTION (every emitted token is a target argmax, whether it
+  arrived as an accepted proposal or a correction). Sampled rows run
+  the standard ratio test — accept d with probability
+  min(1, p(d)/q(d)), resample rejections from the normalized residual
+  max(p - q, 0) — which leaves the OUTPUT DISTRIBUTION exactly the
+  target's for any draft q (the Leviathan et al. identity), with the
+  per-request RNG chain split so every round's draws are deterministic
+  per (seed, round) and independent of the draft's own sampling chain.
+
+* ``AcceptanceValve`` — the adaptive fallback: speculation costs K
+  draft forwards per target forward, so when the rolling acceptance
+  rate over a window of rounds drops below the floor, the valve
+  closes (plain decode, draft slots released) and re-probes after a
+  cooldown — a draft that has stopped predicting the traffic must not
+  tax it forever, and a traffic shift back must not be locked out.
+
+Page accounting rides PR 11 unchanged: ``max_new`` already bounds the
+positions a request can need, verify writes past a row's reserved
+pages land in scratch page 0 (never a page another slot owns), and the
+rejected suffix's K/V stays in place but logically dead — the next
+round overwrites it before any gather can attend it, and ``pos`` masks
+everything beyond with exact-zero softmax weight.
+"""
+
+from __future__ import annotations
+
+import collections
+
+# Decorrelates the draft model's sampling chain from the target/accept
+# chain: both derive from PRNGKey(request seed), and the ratio test's
+# uniforms must be independent of the draws that picked the proposals.
+DRAFT_KEY_FOLD = 0x5BEC
+
+
+def accept_tokens(logits, draft_tokens, draft_logits, temps, keys,
+                  spec_mask):
+    """The acceptance-sampling half of a verify round (pure jax; the
+    engine jits it fused with ``verify_step``).
+
+    Arguments (B rows, K proposals per row):
+      logits        [B, K+1, V] target logits: row position i holds the
+                    target distribution for the token AFTER input i
+                    (input 0 is the row's previous token, inputs 1..K
+                    the draft proposals).
+      draft_tokens  [B, K] the proposals, d_i sampled from (or argmaxed
+                    over) draft_logits[:, i-1].
+      draft_logits  [B, K, V] the draft distribution each proposal was
+                    drawn from — acceptance MUST test against the
+                    distribution that actually proposed.
+      temps         [B] request temperatures (0 = greedy).
+      keys          [B, 2] uint32 per-request RNG chains; split K+2 ways
+                    per round (carry, K acceptance uniforms, one final
+                    sample) so the chain advances identically whatever
+                    the acceptance pattern.
+      spec_mask     [B] bool; False rows (no draft slot, or an idle
+                    row) ignore the proposals entirely and emit ONE
+                    token drawn from / argmaxed over the target's first
+                    position — exactly a plain decode step.
+
+    Returns (out_tokens [B, K+1], n_emit [B], carry_keys [B, 2]):
+    row b emits out_tokens[b, :n_emit[b]] — its accepted prefix, then
+    one target-supplied token (the rejection's residual sample, the
+    all-accepted bonus, or the non-spec row's plain token).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    B, K1, _ = logits.shape
+    K = K1 - 1
+    rows = jnp.arange(B)
+    safe = jnp.where(temps > 0, temps, 1.0)[:, None, None]
+    p = jax.nn.softmax(logits / safe, axis=-1)        # [B, K+1, V]
+    q = jax.nn.softmax(draft_logits / safe, axis=-1)  # [B, K, V]
+    ks = jax.vmap(lambda k: jax.random.split(k, K + 2))(keys)
+    carry, final_key = ks[:, 0], ks[:, K + 1]
+    u = jax.vmap(jax.random.uniform)(
+        ks[:, 1:K + 1].reshape(B * K, 2)).reshape(B, K)
+
+    d = draft_tokens
+    p_d = jnp.take_along_axis(p[:, :K], d[..., None], axis=-1)[..., 0]
+    q_d = jnp.take_along_axis(q, d[..., None], axis=-1)[..., 0]
+    # q(d) can underflow to exact 0 in f32 for a proposal the draft
+    # nonetheless emitted; the clamp turns the ratio into "accept"
+    # (p/tiny >= 1 > u), the only answer consistent with d having been
+    # drawn from q at all.
+    ratio_ok = u < p_d / jnp.maximum(q_d, 1e-38)
+    greedy_tgt = jnp.argmax(logits, axis=-1)  # [B, K+1]
+    greedy_ok = d == greedy_tgt[:, :K]
+    accept = jnp.where(temps[:, None] > 0, ratio_ok, greedy_ok)
+    accept = accept & spec_mask[:, None]
+    # a = longest accepted PREFIX (a proposal after a rejection is
+    # conditioned on a token the target refused — it cannot stand).
+    a = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)
+
+    # The one target-supplied token closing the round, from one of
+    # three distributions — all exactly the target's:
+    #   rejected at i < K  -> residual max(p_i - q_i, 0), normalized
+    #                         (the ratio test's complement: accepted-
+    #                         or-residual composes to exactly p_i);
+    #   all K accepted     -> bonus from p_K (a free extra position the
+    #                         verify forward already computed);
+    #   non-spec row       -> p_0, a plain decode step's sample.
+    j = jnp.minimum(a, K - 1) if K > 0 else jnp.zeros_like(a)
+    resid = jnp.maximum(p[rows, j] - q[rows, j], 0.0) if K > 0 \
+        else p[rows, 0]
+    rsum = resid.sum(axis=-1, keepdims=True)
+    # p == q makes rejection probability 0 exactly; if f32 rounding
+    # nonetheless lands here with an all-zero residual, the target
+    # distribution itself is the only sound fallback.
+    resid = jnp.where(rsum > 0, resid / jnp.maximum(rsum, 1e-38),
+                      p[rows, j])
+    use_p = (~spec_mask) | (a == K)
+    dist = jnp.where(use_p[:, None], p[rows, a], resid)
+    sampled = jax.vmap(
+        lambda k, row: jax.random.categorical(k, jnp.log(row)[None, :])[0]
+    )(final_key, dist)
+    final = jnp.where(
+        temps > 0, sampled, greedy_tgt[rows, a]).astype(jnp.int32)
+
+    idx = jnp.arange(K + 1)[None, :]
+    d_pad = jnp.concatenate([d, jnp.zeros((B, 1), d.dtype)], axis=1)
+    out = jnp.where(idx < a[:, None], d_pad,
+                    jnp.where(idx == a[:, None], final[:, None], 0))
+    return (out.astype(jnp.int32), (a + 1).astype(jnp.int32),
+            carry.astype(keys.dtype))
+
+
+class AcceptanceValve:
+    """The adaptive spec-on/spec-off switch: a rolling window of verify
+    rounds' (proposed, accepted) counts. When the window fills and the
+    acceptance rate sits below ``floor``, the valve CLOSES — the engine
+    releases every draft slot and decodes plainly — and after
+    ``reprobe_rounds`` plain rounds it reopens for new admissions, so a
+    traffic shift back toward the draft's competence is re-probed
+    instead of locked out. Not thread-safe by design: only the engine
+    loop thread drives it (stats readers tolerate torn reads of two
+    ints)."""
+
+    def __init__(self, floor: float = 0.3, window_rounds: int = 64,
+                 reprobe_rounds: int = 256):
+        if not 0.0 <= floor <= 1.0:
+            raise ValueError(f"acceptance floor must be in [0, 1], "
+                             f"got {floor}")
+        if window_rounds < 1 or reprobe_rounds < 1:
+            raise ValueError("window_rounds and reprobe_rounds must be "
+                             ">= 1")
+        self.floor = floor
+        self.window_rounds = window_rounds
+        self.reprobe_rounds = reprobe_rounds
+        self._window: collections.deque[tuple[int, int]] = \
+            collections.deque(maxlen=window_rounds)
+        # Running window sums: rate() is read from other threads
+        # (stats(), the heartbeat publisher) while the engine loop
+        # appends — plain int reads tear harmlessly, iterating the
+        # deque concurrently would raise.
+        self._win_proposed = 0
+        self._win_accepted = 0
+        self.open = True
+        self._plain_rounds = 0
+
+    def rate(self) -> float | None:
+        """Acceptance rate over the current window (None = no data)."""
+        proposed, accepted = self._win_proposed, self._win_accepted
+        if proposed < 1:
+            return None
+        return min(accepted / proposed, 1.0)
+
+    def observe(self, proposed: int, accepted: int) -> bool:
+        """Record one verify round. Returns True exactly when this
+        round CLOSED the valve (the caller emits the fallback event)."""
+        if not self.open or proposed < 1:
+            return False
+        if len(self._window) == self.window_rounds:
+            old_p, old_a = self._window[0]  # about to fall off
+            self._win_proposed -= old_p
+            self._win_accepted -= old_a
+        self._window.append((proposed, accepted))
+        self._win_proposed += proposed
+        self._win_accepted += accepted
+        if len(self._window) < self.window_rounds:
+            return False
+        rate = self.rate()
+        if rate is not None and rate < self.floor:
+            self.open = False
+            self._plain_rounds = 0
+            self._window.clear()
+            self._win_proposed = 0
+            self._win_accepted = 0
+            return True
+        return False
+
+    def tick_plain(self) -> bool:
+        """Count one plain round while closed. Returns True exactly
+        when the cooldown lapsed and the valve reopened."""
+        if self.open:
+            return False
+        self._plain_rounds += 1
+        if self._plain_rounds >= self.reprobe_rounds:
+            self.open = True
+            return True
+        return False

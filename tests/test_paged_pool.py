@@ -133,19 +133,25 @@ class TestPagedEngine:
             # Two long-lived same-prefix residents: while both decode,
             # their page tables must START with the store's pages (the
             # zero-copy pin) and the pool must report them shared.
-            a = eng.submit(shared + [2], max_new=20, temperature=0.0,
+            a = eng.submit(shared + [2], max_new=24, temperature=0.0,
                            seed=1)
-            b = eng.submit(shared + [3], max_new=20, temperature=0.7,
+            b = eng.submit(shared + [3], max_new=24, temperature=0.7,
                            seed=2)
-            assert wait_for(lambda: eng.active_slots == 2)
+            # Admission is monotone (a transit of active_slots == 2 is
+            # a couple dozen fast decode steps — a 10ms poll can miss
+            # it); the 1ms interval snapshots the tables well inside
+            # the ~24 steps both slots stay live together.
+            assert wait_for(
+                lambda: a._req.admitted_at and b._req.admitted_at,
+                interval=0.001)
             tables = eng._tables.copy()
             for row in tables:
                 assert row[:2].tolist() == store_pages
             assert eng.pool_stats()["shared_pages"] >= 2
             assert a.result(timeout=120) == solo_tokens(
-                params, cfg, shared + [2], 20, 0.0, 1)
+                params, cfg, shared + [2], 24, 0.0, 1)
             assert b.result(timeout=120) == solo_tokens(
-                params, cfg, shared + [3], 20, 0.7, 2)
+                params, cfg, shared + [3], 24, 0.7, 2)
             assert a.stats["prefix_tokens"] == 8
             assert b.stats["prefix_tokens"] == 8
         finally:
@@ -268,7 +274,11 @@ class TestPagedEngine:
         victim = eng.submit([2] * 9, max_new=40)
         assert wait_for(lambda: eng.active_slots == 2)
         victim.cancel()
-        assert wait_for(lambda: eng.active_slots == 1)
+        # Wait on the victim's terminal state, not a transit of
+        # active_slots: the 2 -> 1 -> 0 window is a handful of decode
+        # steps and shared-program engines step fast enough for a 10ms
+        # poll to miss it entirely.
+        assert wait_for(lambda: victim.finish_reason == "cancelled")
         eng.stop(drain=True, timeout=60)
         assert resident.finish_reason == "length"
         stats = eng.pool_stats()
@@ -283,8 +293,10 @@ class TestPagedEngine:
         params, cfg = model
         eng = ServeEngine(params, cfg, max_batch=1, max_seq=64,
                           queue_depth=8, prefix_block=4)
-        eng.submit([4] * 9, max_new=40)
-        assert wait_for(lambda: eng.active_slots == 1)
+        h = eng.submit([4] * 9, max_new=40)
+        # Admission, not slot occupancy: a fast engine can finish all
+        # 40 steps between 10ms polls of active_slots.
+        assert wait_for(lambda: h._req.admitted_at > 0)
         eng.stop(drain=False, timeout=30)
         # Hard eviction donates nothing; the store may hold nothing yet.
         eng._prefix.evict_all()

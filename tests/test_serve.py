@@ -193,12 +193,17 @@ class TestEngineInvariants:
         params, cfg = model
         eng = ServeEngine(params, cfg, max_batch=1, max_seq=64,
                           queue_depth=4)
-        resident = eng.submit([6, 7], max_new=6)
-        assert wait_for(lambda: eng.active_slots == 1)
+        # A budget long enough that the resident is still decoding
+        # when the queued submit and the drain land (a 6-step request
+        # can finish inside one 10ms poll on a warm engine, and then
+        # the "queued" request would simply be admitted).
+        resident = eng.submit([6, 7], max_new=48)
+        assert wait_for(lambda: resident._req.admitted_at > 0,
+                        interval=0.001)
         queued = eng.submit([8], max_new=6)
         eng.stop(drain=True, timeout=60)
         assert resident.result(timeout=5) == solo_tokens(
-            params, cfg, [6, 7], 6)
+            params, cfg, [6, 7], 48)
         assert resident.finish_reason == "length"
         assert queued.result(timeout=5) == []
         assert queued.finish_reason == "drained"

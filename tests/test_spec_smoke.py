@@ -1,0 +1,29 @@
+"""Tier-1 wiring of `make spec-smoke`: the serve smoke with speculative
+decoding (self-draft, 4 proposals per verify round) — bench.spec_smoke()
+itself raises unless every greedy output stayed byte-identical to its
+solo generate() run, the acceptance rate was > 0, speculation advanced
+more than one decode token per target dispatch, both page pools (target
+AND draft) drained to zero, and the routed mixed-fleet half (one
+speculating replica, one plain, behind the router) stayed byte-identical
+wherever the pick landed."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def test_spec_smoke_identity_acceptance_and_leaks():
+    import bench
+
+    extras = bench.spec_smoke(4)  # raises AssertionError on any break
+    assert extras["serve_completed"] == extras["serve_requests"]
+    assert extras["spec_accept_rate"] > 0
+    assert extras["tokens_per_target_step"] > 1
+    assert extras["kv_pages_leaked"] == 0
+    assert extras["draft_pages_leaked"] == 0
+    # The interleaved comparison is REPORTED (min-time p50 per mode);
+    # wall-clock improvement is not gated on the noisy 2-core CI box.
+    assert extras["spec_on_token_p50_ms"] is not None
+    assert extras["spec_off_token_p50_ms"] is not None
+    assert extras["router_mixed_fleet_byte_identity"] is True
