@@ -9,7 +9,7 @@
 PY ?= python
 RUFF := $(shell command -v ruff 2>/dev/null)
 
-.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke prefix-smoke paged-smoke spec-smoke
+.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke prefix-smoke paged-smoke spec-smoke chaos chaos-smoke
 
 # drift and tsan are standalone conveniences; the full pytest target
 # already runs both (SpecDrift + the TSAN stream test build in-fixture).
@@ -108,6 +108,26 @@ spec-smoke:
 # obs_overhead_ratio. Also runs in tier-1 as tests/test_obs_smoke.py.
 obs-smoke:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --obs-smoke
+
+# Chaos ladder (minutes): seeded, scripted fault schedules over an
+# in-process cluster sim — replica SIGKILL, black-holed channel,
+# page-pool exhaustion, registry-primary kill -> auto-promotion,
+# controller kill -> feeder failover + warm-standby cache hit, draft
+# collapse -> spec-valve fallback, and the compound rung (promotion
+# while a replica drains while the prefix-holder dies). Every rung
+# asserts CONVERGENCE: the expected heal events on /debug/events, in
+# order; zero client-visible errors where the retry contract promises
+# them; byte-identical routed outputs; zero-leak page/prefix/channel
+# censuses. Same seed -> same heal-event sequence, or a loud assert.
+chaos:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --chaos
+
+# The trimmed 3-rung tier-1 variant (seconds): the fast serving-tier
+# rungs only, plus the fault_overhead_ratio guard that every fault
+# point is free when unarmed. Also runs in tier-1 as
+# tests/test_chaos_smoke.py.
+chaos-smoke:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --chaos --smoke
 
 demo:
 	bash scripts/demo_cluster.sh demo

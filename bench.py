@@ -100,6 +100,19 @@ def main(argv=None) -> int:
                              "spec-on vs spec-off inter-token min-time "
                              "comparison (with --smoke: the asserting "
                              "speculative-decoding smoke)")
+    parser.add_argument("--chaos", action="store_true",
+                        help="chaos ladder: seeded, scripted fault "
+                             "schedules over an in-process cluster sim "
+                             "(registry pair, controllers, serve "
+                             "replicas behind a router), each rung "
+                             "asserting heal-path CONVERGENCE on "
+                             "/debug/events plus zero-leak censuses "
+                             "(with --smoke: the trimmed 3-rung tier-1 "
+                             "variant — fast serving-tier rungs only)")
+    parser.add_argument("--chaos-seed", type=int, default=None,
+                        help="with --chaos: the ladder's deterministic "
+                             "seed (same seed -> same heal-event "
+                             "sequence)")
     parser.add_argument("--obs-smoke", action="store_true",
                         help="observability-plane acceptance run: one "
                              "trace_id traced from a /metrics exemplar "
@@ -113,6 +126,17 @@ def main(argv=None) -> int:
     if args.obs_smoke:
         print(json.dumps({"metric": "obs_smoke", "value": 1,
                           "unit": "ok", "extras": obs_smoke()}))
+        return 0
+
+    if args.chaos:
+        extras = (chaos_smoke(args.chaos_seed) if args.smoke
+                  else chaos_ladder(args.chaos_seed))
+        print(json.dumps({
+            "metric": "chaos_rungs",
+            "value": extras["chaos_rungs"],
+            "unit": "rungs",
+            "extras": extras,
+        }))
         return 0
 
     if args.serve:
@@ -2007,6 +2031,53 @@ def router_smoke(replicas: int = 2) -> dict:
         "first_token_p99_ms": pct(first_token_s, 99),
         "router_byte_identity": True,
     }
+
+
+def chaos_ladder(seed=None, include_slow: bool = True,
+                 names=None) -> dict:
+    """The chaos ladder (oim_tpu/chaos): each rung is a seeded,
+    scripted fault schedule over a fresh in-process cluster sim, and a
+    rung passes only when its heal-event signature on /debug/events
+    matches its declaration IN ORDER, its zero-error / byte-identity
+    assertions hold, and the page/prefix/channel census shows zero
+    leaks. ``fault_overhead_ratio`` guards that the serving tier's
+    fault points are free when unarmed (paired interleaved comparison,
+    the obs_overhead methodology). Raises AssertionError on any
+    divergence — the `make chaos` gate."""
+    from oim_tpu import chaos
+
+    report = chaos.run_ladder(
+        seed=chaos.ladder.DEFAULT_SEED if seed is None else seed,
+        include_slow=include_slow, names=names)
+    extras = {
+        "chaos_seed": report["seed"],
+        "chaos_rungs": len(report["rungs"]),
+        "chaos_rung_names": [r["name"] for r in report["rungs"]],
+        "chaos_event_signature": report["event_signature"],
+        "chaos_report": report["rungs"],
+    }
+    extras.update(chaos.fault_overhead())
+    # The no-op-when-unarmed claim is a GATE, not a report column: an
+    # unarmed fire() is one dict lookup, so the paired median must sit
+    # at ~1.0 (>= 0.90 absorbs the sandboxed box's scheduling noise,
+    # the obs_overhead_ratio stance).
+    if extras["fault_overhead_ratio"] < 0.90:
+        raise AssertionError(
+            f"unarmed fault points are no longer free: "
+            f"fault_overhead_ratio={extras['fault_overhead_ratio']} "
+            f"(pair spread {extras['fault_overhead_pair_spread']})")
+    return extras
+
+
+def chaos_smoke(seed=None) -> dict:
+    """The trimmed tier-1 ladder: the three fast serving-tier rungs
+    (replica kill, channel blackhole, pool exhaustion) — no replication
+    pair, no controllers, no speculative compile. Wired into tier-1 as
+    tests/test_chaos_smoke.py and `make chaos-smoke`."""
+    from oim_tpu import chaos
+
+    return chaos_ladder(seed, include_slow=False,
+                        names=chaos.SMOKE_RUNGS)
 
 
 def obs_overhead(params, cfg, rounds: int = 8, n_requests: int = 48,

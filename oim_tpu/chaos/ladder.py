@@ -1,0 +1,515 @@
+"""The deterministic chaos ladder: scripted fault schedules ("rungs")
+over the in-process cluster sim, each ending in a CONVERGENCE assertion.
+
+PRs 1-12 proved every heal mechanism with a bespoke unit test; this
+module proves they CONVERGE — the expected heal events fire, in order,
+on ``/debug/events`` (read over HTTP, the way an operator would), with
+zero client-visible errors wherever the retry contract promises them,
+byte-identical routed outputs, and a zero-leak page/prefix/channel
+census at the end of every rung.
+
+Determinism: each rung gets its own ``random.Random`` seeded from
+``(ladder seed, rung name)`` — adding a rung never shifts another's
+request stream — and every backoff in the process draws through the
+same seeded stream (``common/backoff.use_rng``). A rung PASSES exactly
+when its observed heal signature (first-occurrence order of the
+expected event types) equals its declared ``expect`` tuple, so a
+passing ladder's event sequence is identical run to run by
+construction: same seed → same signature, or a loud assertion.
+
+The rung table (ladder order):
+
+==================  =================================  =================
+rung                fault                              heal proven
+==================  =================================  =================
+replica_kill        SIGKILL 1 of 2 replicas mid-lease  retry-before-
+                                                       first-token
+channel_blackhole   listener dies, heartbeat lives     pool eviction +
+                                                       redial
+pool_exhaustion     long-prompt burst > page pool      backpressure, not
+                                                       OOM or error
+registry_promotion  SIGKILL the PRIMARY registry       standby auto-
+                                                       promotion
+feeder_failover     SIGKILL the pinned controller      feeder failover +
+                                                       warm cache hit
+draft_collapse      a draft that stops predicting      valve fallback,
+                                                       byte-identity
+compound [slow]     promotion + drain + prefix-holder  all of the above,
+                                                       overlapped
+==================  =================================  =================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from typing import Callable
+
+import numpy as np
+
+from oim_tpu.common import backoff, events, metrics as M
+from oim_tpu.chaos.sim import (
+    ClusterSim,
+    model,
+    solo_tokens,
+    wait_for,
+)
+
+DEFAULT_SEED = 1337
+
+
+def _reqs(rng: random.Random, n: int, *, vocab: int = 64,
+          prompt_len=(2, 8), max_new=(4, 8), temps=(0.0, 0.9),
+          prefix=()) -> list:
+    """A deterministic request batch from the rung's seeded stream."""
+    out = []
+    for i in range(n):
+        prompt = list(prefix) + [
+            rng.randrange(1, vocab)
+            for _ in range(rng.randint(*prompt_len))]
+        out.append((prompt, rng.randint(*max_new),
+                    temps[i % len(temps)], rng.randrange(1 << 16)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Rungs.
+
+
+def _run_replica_kill(sim: ClusterSim, rng: random.Random) -> dict:
+    """SIGKILL one of two replicas: its TTL-leased row outlives it, the
+    router keeps picking the corpse and must retry BEFORE the first
+    token — zero client errors, byte-identical outputs."""
+    sim.warm()
+    reqs = _reqs(rng, 8)
+    results, errors = sim.routed_load(reqs[:2])
+    assert not errors, f"warm load failed: {errors[0]!r}"
+    mark = sim.mark_faults()
+    sim.replicas[1].kill()
+    results, errors = sim.routed_load(reqs)
+    assert not errors, f"client saw errors across the kill: {errors[0]!r}"
+    checked = sim.assert_byte_identity(reqs, results)
+    sim.wait_heal([events.ROUTER_MARK_FAILED, events.ROUTER_RETRY], mark)
+    retries = [e for e in sim.debug_events(events.ROUTER_RETRY)
+               if e["seq"] > mark]
+    assert all(e.get("trace_id") for e in retries), \
+        f"router_retry events missing trace stamps: {retries}"
+    # The corpse leaves the table once its lease lapses.
+    assert wait_for(
+        lambda: all(r.replica_id != "r1" for r in sim.table.replicas()),
+        timeout=10), "dead replica never left the routing table"
+    return {"requests": len(reqs), "byte_identical": checked,
+            "retries": len(retries)}
+
+
+def _run_channel_blackhole(sim: ClusterSim, rng: random.Random) -> dict:
+    """Black-holed endpoint: r1's listener dies but its heartbeat keeps
+    the row fresh, so the router keeps dialing a dead socket — the
+    channel pool must evict and, once the listener returns, the next
+    pick must RE-DIAL (not ride the dead channel) and serve
+    byte-identical output."""
+    sim.warm()
+    r1 = sim.replicas[1]
+    addr = r1.server.addr
+
+    def dials() -> int:
+        return sum(n for (a, _), n in sim.pool.stats().items()
+                   if a == addr)
+
+    mark = sim.mark_faults()
+    r1.kill_listener()
+    reqs = _reqs(rng, 6)
+    results, errors = sim.routed_load(reqs)
+    assert not errors, f"client saw errors across the blackhole: " \
+                       f"{errors[0]!r}"
+    sim.assert_byte_identity(reqs, results)
+    sim.wait_heal([events.ROUTER_MARK_FAILED, events.ROUTER_RETRY], mark)
+
+    r1.restart_listener()
+    # Snapshot AFTER the listener returns: dials made during the
+    # blackhole (each failed attempt dials the dead socket before the
+    # pool evicts it) would satisfy a pre-fault snapshot vacuously.
+    # Every blackhole failure evicted its channel, so reaching the
+    # recovered replica requires a fresh post-restart dial — that is
+    # the redial this assert proves.
+    dials_before = dials()
+    r1.registration.beat_once()  # a CHANGED row clears the failure mark
+    assert wait_for(
+        lambda: any(r.replica_id == "r1" for r in sim.table.replicas()),
+        timeout=10), "recovered replica never re-entered the table"
+    # Keep offering load until a request actually lands on r1 through a
+    # freshly dialed channel.
+    served_before = r1.completed()
+    deadline = time.monotonic() + 30
+    extra = 0
+    while r1.completed() == served_before:
+        assert time.monotonic() < deadline, \
+            "no request reached the recovered replica"
+        more = _reqs(rng, 2)
+        extra += len(more)
+        results, errors = sim.routed_load(more)
+        assert not errors
+        sim.assert_byte_identity(more, results)
+    assert dials() > dials_before, \
+        "recovery never re-dialed: the pool rode a dead channel"
+    return {"requests": len(reqs) + extra,
+            "redials": dials() - dials_before}
+
+
+def _run_pool_exhaustion(sim: ClusterSim, rng: random.Random) -> dict:
+    """A long-prompt burst wants more KV pages than the pool holds:
+    admissions must WAIT (page_pool_exhausted + queueing), never OOM or
+    error, and every page returns after the burst."""
+    sim.warm()
+    engine = sim.replicas[0].engine
+    mark = sim.mark_faults()
+    reqs = [([rng.randrange(1, 64) for _ in range(24)], 17, 0.0,
+             rng.randrange(1 << 16)) for _ in range(6)]
+    handles = [engine.submit(p, max_new=n, temperature=t, seed=s)
+               for p, n, t, s in reqs]
+    results = [h.result(timeout=300) for h in handles]
+    for (prompt, n_new, temp, seed), toks in zip(reqs, results):
+        expect = solo_tokens(prompt, n_new, temperature=temp, seed=seed)
+        assert toks == expect, \
+            f"backpressured output diverged: {toks} != {expect}"
+    assert all(h.finish_reason == "length" for h in handles)
+    sim.wait_heal([events.PAGE_POOL_EXHAUSTED], mark)
+    stats = engine.pool_stats()
+    assert stats["used_pages"] == 0, f"pages leaked: {stats}"
+    assert stats["peak_used_pages"] <= stats["total_pages"]
+    return {"requests": len(reqs),
+            "peak_used_pages": stats["peak_used_pages"],
+            "total_pages": stats["total_pages"]}
+
+
+def _run_registry_promotion(sim: ClusterSim, rng: random.Random) -> dict:
+    """SIGKILL the PRIMARY registry: the standby auto-promotes after the
+    primary lease lapses, registrations and the routing table rotate to
+    it, and routed traffic converges back to clean. No routability
+    contract covers the failover window itself — errors there are
+    recorded, not asserted — but post-convergence load must be
+    error-free and byte-identical."""
+    sim.warm()
+    reqs = _reqs(rng, 8)
+    results, errors = sim.routed_load(reqs[:2])
+    assert not errors, f"pre-fault load failed: {errors[0]!r}"
+    mark = sim.mark_faults()
+    sim.kill_registry_primary()
+    # Load THROUGH the outage: the table's cached snapshot and the
+    # standby's read path keep most picks routable.
+    during, during_errors = sim.routed_load(reqs[2:5])
+    sim.assert_byte_identity(reqs[2:5], during)
+    sim.wait_heal([events.REGISTRY_PROMOTION], mark)
+    # Convergence: every replica re-registered against the new primary.
+    assert wait_for(lambda: len(sim.table) == sim.n_replicas, timeout=15), \
+        "replicas never re-registered on the promoted standby"
+    results, errors = sim.routed_load(reqs[5:])
+    assert not errors, \
+        f"post-promotion load saw errors: {errors[0]!r}"
+    sim.assert_byte_identity(reqs[5:], results)
+    promo = [e for e in sim.debug_events(events.REGISTRY_PROMOTION)
+             if e["seq"] > mark]
+    return {"requests": len(reqs),
+            "during_outage_errors": len(during_errors),
+            "promotion_epoch": promo[-1]["attrs"]["epoch"]}
+
+
+def _run_feeder_failover(sim: ClusterSim, rng: random.Random) -> dict:
+    """SIGKILL the pinned controller mid-volume: the feeder fails over
+    to the same-coordinate replica, re-publishes (volume_healed), and —
+    because the publish was prestaged to the standby — the restage is a
+    stage-cache HIT, not a second disk scan."""
+    from oim_tpu.registry.registry import CONTROLLER_ID_META
+    from oim_tpu.spec import ControllerStub, pb
+
+    data = np.random.RandomState(rng.randrange(1 << 31)).bytes(50_000)
+    path = sim.tmpfile(data)
+    feeder = sim.feeder("host-0")
+    request = pb.MapVolumeRequest(
+        volume_id="chaos-vol",
+        file=pb.FileParams(path=path, format="raw"))
+    feeder.publish(request, timeout=60)
+    w, total, _ = feeder.fetch_window("chaos-vol", 0, 10_000, heal=True)
+    assert w.tobytes() == data[:10_000] and total == len(data)
+
+    # Warm the standby (the prestage.fanout path), then wait for the
+    # async stage to land: PrestageVolume answers already_cached once.
+    assert feeder.prestage_replica(request) == "host-1"
+    stub = ControllerStub(sim.pool.get(
+        sim.registries[0][1].addr, None, "component.registry"))
+
+    def warmed() -> bool:
+        return stub.PrestageVolume(
+            request, metadata=[(CONTROLLER_ID_META, "host-1")],
+            timeout=10.0).already_cached
+
+    assert wait_for(warmed, timeout=30), "standby prestage never landed"
+
+    hits_before = M.STAGE_CACHE_HITS.value
+    mark = sim.mark_faults()
+    sim.controllers[0].kill()
+    w2, total2, _ = feeder.fetch_window(
+        "chaos-vol", 10_000, 20_000, timeout=60, heal=True)
+    assert w2.tobytes() == data[10_000:30_000] and total2 == len(data)
+    assert feeder.controller_id == "host-1"
+    sim.wait_heal([events.FEEDER_FAILOVER, events.VOLUME_HEALED], mark)
+    cache_hits = M.STAGE_CACHE_HITS.value - hits_before
+    assert cache_hits >= 1, \
+        "failover restage missed the prestaged cache (full restage paid)"
+    return {"volume_bytes": len(data), "warm_standby_cache_hits": cache_hits}
+
+
+def _run_draft_collapse(sim: ClusterSim, rng: random.Random) -> dict:
+    """A draft that stops predicting the traffic: the acceptance valve
+    must close (spec_fallback), live rows release their draft pages,
+    and greedy output stays byte-identical throughout the flip."""
+    sim.warm()
+    engine = sim.replicas[0].engine
+    mark = sim.mark_faults()
+    reqs = [([rng.randrange(1, 64) for _ in range(4)], 24, 0.0,
+             rng.randrange(1 << 16)) for _ in range(3)]
+    handles = [engine.submit(p, max_new=n, temperature=t, seed=s)
+               for p, n, t, s in reqs]
+    results = [h.result(timeout=300) for h in handles]
+    for (prompt, n_new, temp, seed), toks in zip(reqs, results):
+        expect = solo_tokens(prompt, n_new, temperature=temp, seed=seed)
+        assert toks == expect, \
+            f"output diverged across the valve flip: {toks} != {expect}"
+    sim.wait_heal([events.SPEC_FALLBACK], mark)
+    spec = engine.spec_stats()
+    assert spec["spec_on"] is False, "valve never closed"
+    assert spec["draft_used_pages"] == 0, f"draft pages leaked: {spec}"
+    return {"requests": len(reqs),
+            "draft_peak_used_pages": spec["draft_peak_used_pages"]}
+
+
+def _run_compound(sim: ClusterSim, rng: random.Random) -> dict:
+    """The production-shaped rung: a registry promotion WHILE a replica
+    drains WHILE the prefix-holder dies, under same-prefix client load.
+    Each heal must fire in schedule order and the surviving replica
+    absorbs everything — zero errors in every window the contract
+    covers, byte-identity throughout, zero-leak census at the end."""
+    sim.warm()
+    prefix = [rng.randrange(1, 64) for _ in range(32)]
+    r0 = sim.replicas[0]
+    # Seed the shared prefix on r0 and advertise it (retiring slots
+    # donate; the next beat publishes the chain hashes).
+    r0.engine.submit(prefix + [9, 8], max_new=4, seed=1).result(timeout=300)
+    r0.registration.beat_once()
+    assert wait_for(
+        lambda: any(r.replica_id == "r0" and r.prefix_hashes
+                    for r in sim.table.replicas()), timeout=10), \
+        "prefix advertisement never reached the routing table"
+
+    waves = [_reqs(rng, 4, prefix=prefix, temps=(0.0,), prompt_len=(2, 4),
+                   max_new=(4, 6)) for _ in range(4)]
+    mark = sim.mark_faults()
+
+    # Wave 1 rides through the registry kill window.
+    sim.kill_registry_primary()
+    w1_results, w1_errors = sim.routed_load(waves[0])
+    sim.assert_byte_identity(waves[0], w1_results)
+    sim.wait_heal([events.REGISTRY_PROMOTION], mark)
+    assert wait_for(lambda: len(sim.table) == sim.n_replicas, timeout=15), \
+        "replicas never re-registered on the promoted standby"
+
+    # Wave 2 rides through r1's graceful drain (launched concurrently):
+    # the drain announcement + retry contract promise zero errors here.
+    drainer = threading.Thread(target=sim.replicas[1].drain, daemon=True)
+    drainer.start()
+    w2_results, w2_errors = sim.routed_load(waves[1])
+    drainer.join(timeout=60)
+    assert not w2_errors, \
+        f"drain window leaked a client error: {w2_errors[0]!r}"
+    sim.assert_byte_identity(waves[1], w2_results)
+    sim.wait_heal([events.REGISTRY_PROMOTION, events.REPLICA_DRAIN], mark)
+    assert wait_for(
+        lambda: all(r.replica_id != "r1" for r in sim.table.replicas()),
+        timeout=15), "drained replica never left the table"
+
+    # Wave 3: the prefix-holder dies; its row outlives it, so the
+    # router must retry off the corpse — zero errors promised.
+    sim.replicas[0].kill()
+    w3_results, w3_errors = sim.routed_load(waves[2])
+    assert not w3_errors, \
+        f"prefix-holder kill leaked a client error: {w3_errors[0]!r}"
+    sim.assert_byte_identity(waves[2], w3_results)
+    signature = sim.wait_heal(
+        [events.REGISTRY_PROMOTION, events.REPLICA_DRAIN,
+         events.ROUTER_MARK_FAILED, events.ROUTER_RETRY], mark)
+
+    # Wave 4: converged — the survivor serves everything, still
+    # byte-identical (prefix recomputed, not resurrected).
+    w4_results, w4_errors = sim.routed_load(waves[3])
+    assert not w4_errors, f"post-convergence errors: {w4_errors[0]!r}"
+    sim.assert_byte_identity(waves[3], w4_results)
+    survivor = sim.replicas[2]
+    assert survivor.completed() > 0, "survivor served nothing"
+    return {"waves": len(waves),
+            "during_promotion_errors": len(w1_errors),
+            "survivor_served": survivor.completed(),
+            "signature": signature}
+
+
+@dataclasses.dataclass(frozen=True)
+class Rung:
+    """One scripted fault schedule: its sim shape, its seeded driver,
+    and the heal-event signature that DEFINES convergence."""
+
+    name: str
+    expect: tuple[str, ...]
+    run: Callable[[ClusterSim, random.Random], dict]
+    sim_kwargs: dict
+    slow: bool = False
+
+
+RUNGS: tuple[Rung, ...] = (
+    Rung("replica_kill",
+         (events.ROUTER_MARK_FAILED, events.ROUTER_RETRY),
+         _run_replica_kill, dict(replicas=2)),
+    Rung("channel_blackhole",
+         (events.ROUTER_MARK_FAILED, events.ROUTER_RETRY),
+         _run_channel_blackhole, dict(replicas=2)),
+    Rung("pool_exhaustion",
+         (events.PAGE_POOL_EXHAUSTED,),
+         _run_pool_exhaustion,
+         dict(replicas=1, engine_kwargs=[dict(
+             max_batch=4, max_seq=64, queue_depth=32,
+             kv_pool_tokens=128, prefix_cache_bytes=0)])),
+    Rung("registry_promotion",
+         (events.REGISTRY_PROMOTION,),
+         _run_registry_promotion,
+         dict(replicas=2, registry_pair=True, primary_lease_s=0.5)),
+    Rung("feeder_failover",
+         (events.FEEDER_FAILOVER, events.VOLUME_HEALED),
+         _run_feeder_failover, dict(replicas=0, controllers=2)),
+    Rung("draft_collapse",
+         (events.SPEC_FALLBACK,),
+         _run_draft_collapse,
+         dict(replicas=1, engine_kwargs=[dict(
+             _draft=True, spec_tokens=4, spec_accept_floor=0.95,
+             spec_window_rounds=4, spec_reprobe_rounds=100_000,
+             max_batch=2, max_seq=64, queue_depth=16)])),
+    Rung("compound",
+         (events.REGISTRY_PROMOTION, events.REPLICA_DRAIN,
+          events.ROUTER_MARK_FAILED, events.ROUTER_RETRY),
+         _run_compound,
+         dict(replicas=3, registry_pair=True, primary_lease_s=0.5),
+         slow=True),
+)
+
+# The trimmed tier-1 set: no replication pair, no controllers, no spec
+# compile — the three rungs that exercise the serving tier's own heal
+# paths in seconds.
+SMOKE_RUNGS = ("replica_kill", "channel_blackhole", "pool_exhaustion")
+
+
+def run_ladder(seed: int = DEFAULT_SEED, include_slow: bool = True,
+               names=None) -> dict:
+    """Run the ladder. Each rung builds a fresh sim (isolation: a
+    rung's corpses never haunt the next), runs its scripted schedule
+    against its own seeded RNG, and must converge: observed heal
+    signature == declared ``expect`` (same order), plus the rung's own
+    zero-error / byte-identity assertions and the zero-leak census.
+    Returns the per-rung report; raises AssertionError on any
+    divergence."""
+    if names is not None:
+        unknown = set(names) - {r.name for r in RUNGS}
+        if unknown:
+            raise ValueError(f"unknown rung name(s) {sorted(unknown)}; "
+                             f"rungs: {[r.name for r in RUNGS]}")
+    selected = [r for r in RUNGS
+                if (names is None or r.name in names)
+                and (include_slow or not r.slow)]
+    if not selected:
+        # A gate that selects nothing must fail loudly, not pass empty.
+        raise ValueError(
+            f"no rungs selected (names={names}, "
+            f"include_slow={include_slow})")
+    rng_master = random.Random(seed)
+    backoff.use_rng(rng_master)  # every backoff draw rides the seed
+    report: dict = {"seed": seed, "rungs": [], "event_signature": []}
+    try:
+        for rung in selected:
+            rng = random.Random(f"{seed}:{rung.name}")
+            t0 = time.monotonic()
+            with ClusterSim(**rung.sim_kwargs) as sim:
+                details = rung.run(sim, rng)
+                # Scoped to the rung's own fault mark: pre-fault warm
+                # or baseline traffic must not pollute the declared
+                # first-occurrence heal order.
+                healed = sim.heal_signature(rung.expect, sim.fault_mark)
+                if healed != list(rung.expect):
+                    raise AssertionError(
+                        f"rung {rung.name!r} heal signature diverged: "
+                        f"expected {list(rung.expect)}, observed {healed}")
+                census = sim.leak_census()
+            report["rungs"].append({
+                "name": rung.name,
+                "healed": healed,
+                "wall_s": round(time.monotonic() - t0, 3),
+                "census": census,
+                "details": details,
+            })
+            report["event_signature"].append([rung.name, *healed])
+    finally:
+        backoff.use_rng(None)
+    return report
+
+
+def fault_overhead(rounds: int = 6, n_requests: int = 24,
+                   max_new: int = 12) -> dict:
+    """The no-op-when-unarmed guard for the serving tier's fault
+    points: serve throughput with the REAL (unarmed) ``fire`` vs a
+    stubbed no-op, paired per round with alternating order, median of
+    the paired ratios (the obs_overhead methodology — pairing cancels
+    box drift, the median cancels one disturbed round). An unarmed
+    ``fire`` is one dict lookup, so this ratio must sit at ~1.0."""
+    from oim_tpu.common import faultinject
+    from oim_tpu.serve import ServeEngine
+
+    params, cfg = model()
+    engine = ServeEngine(params, cfg, max_batch=4, max_seq=64,
+                         queue_depth=n_requests)
+    rng = np.random.RandomState(11)
+    reqs = [rng.randint(1, cfg.vocab, size=rng.randint(2, 8)).tolist()
+            for _ in range(n_requests)]
+    real_fire = faultinject.fire
+
+    def noop_fire(point, **ctx):
+        return None
+
+    walls: dict[str, list[float]] = {"real": [], "noop": []}
+    try:
+        engine.submit([1, 2, 3], max_new=2).result(timeout=300)  # warm
+
+        def one_round() -> float:
+            t0 = time.monotonic()
+            handles = [engine.submit(p, max_new=max_new, temperature=0.0,
+                                     seed=i)
+                       for i, p in enumerate(reqs)]
+            for h in handles:
+                h.result(timeout=300)
+            return time.monotonic() - t0
+
+        for i in range(rounds):
+            order = ("real", "noop") if i % 2 == 0 else ("noop", "real")
+            for mode in order:
+                faultinject.fire = (real_fire if mode == "real"
+                                    else noop_fire)
+                walls[mode].append(one_round())
+    finally:
+        faultinject.fire = real_fire
+        engine.stop(drain=False, timeout=30)
+    ratios = sorted(noop / real
+                    for real, noop in zip(walls["real"], walls["noop"]))
+    median = ratios[len(ratios) // 2]
+    return {
+        # noop/real throughput ratio: 1.0 = the unarmed fire is free.
+        "fault_overhead_ratio": round(median, 4),
+        "fault_overhead_pair_spread": [round(ratios[0], 4),
+                                       round(ratios[-1], 4)],
+        "fault_overhead_rounds": rounds,
+    }

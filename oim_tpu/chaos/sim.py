@@ -1,0 +1,643 @@
+"""In-process cluster simulator: the chaos ladder's substrate.
+
+bench.py grew the in-process cluster three times (router_cluster, the
+obs smoke, tests/test_router.py's live_cluster) — always as a one-shot
+context manager with no way to KILL anything mid-flight. This module
+factors that plumbing into a reusable fixture whose components carry
+per-component fault handles:
+
+* a **registry** — single node, or a replicated primary/standby pair
+  (``registry_pair=True``) with a short auto-promotion lease, killable
+  via :meth:`ClusterSim.kill_registry_primary`;
+* **N malloc-backed controllers** (``controllers=N``) running real
+  heartbeat loops at one mesh coordinate (the feeder-failover
+  replica-election shape), each with ``.kill()``;
+* **M serve replicas** behind an ``oim-router`` (``replicas=M``), each a
+  real engine + gRPC server + TTL-leased registration with ``kill()``
+  (SIGKILL semantics: row outlives the corpse), ``drain()`` (SIGTERM
+  semantics: announce, finish residents), ``kill_listener()`` /
+  ``restart_listener()`` (black-holed endpoint: the engine lives, the
+  socket dies — the channel-pool eviction path), and ``restart()``;
+* a **feeder** factory for publish/fetch_window traffic over the
+  controllers;
+* one **MetricsServer**, so convergence assertions read heal events the
+  way an operator would — ``GET /debug/events`` over HTTP — not by
+  peeking at in-process state.
+
+Everything lives in one process on localhost TCP; determinism comes
+from the ladder's seeded schedule (oim_tpu/chaos/ladder.py), not from
+mocking time. The model is the test suite's tiny llama, and jitted
+programs are shared across sims by the engine's program cache, so a
+fresh cluster per rung costs milliseconds after the first.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import tempfile
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+from oim_tpu.common import events, tlsutil
+from oim_tpu.common.channelpool import ChannelPool
+from oim_tpu.common.meshcoord import MeshCoord
+from oim_tpu.common.metrics import MetricsServer
+from oim_tpu.spec import ServeStub, pb
+
+# One mesh coordinate for every sim controller: the feeder's failover
+# elects replacements among same-coordinate replicas.
+MESH_COORD = "0,0,0"
+
+EVENTS_RING = 8192
+
+
+@functools.lru_cache(maxsize=1)
+def model():
+    """The sim's tiny target model (shared across every sim in the
+    process — engine program caches key on the config)."""
+    import jax
+
+    from oim_tpu.models import llama
+
+    cfg = llama.tiny(vocab=64, dim=32, n_layers=2)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+@functools.lru_cache(maxsize=1)
+def draft_model():
+    """A genuinely DIFFERENT draft (independent init): its proposals
+    disagree with the target often — the draft-collapse rung needs a
+    draft the valve will give up on."""
+    import jax
+
+    from oim_tpu.models import llama
+
+    cfg = llama.tiny(vocab=64, dim=32, n_layers=2)
+    params = llama.init(jax.random.PRNGKey(7), cfg)
+    return params, cfg
+
+
+def solo_tokens(prompt, n_new, temperature=0.0, seed=0, max_seq=64):
+    """The byte-identity reference: what a solo generate() emits for
+    this request (the same pin every serve smoke asserts against)."""
+    import jax
+
+    from oim_tpu.models import generate as gen
+
+    params, cfg = model()
+    out = gen.generate(
+        params, np.asarray([list(prompt)], np.int32), n_new, cfg,
+        temperature=temperature, rng=jax.random.PRNGKey(seed),
+        max_seq=max_seq)
+    return out[0, len(prompt):].tolist()
+
+
+def wait_for(predicate, timeout=30.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+class ReplicaHandle:
+    """One serve replica (engine + server + registration) with the
+    fault levers a chaos rung pulls."""
+
+    def __init__(self, sim: "ClusterSim", rid: str, engine_kwargs: dict):
+        self.sim = sim
+        self.rid = rid
+        self.engine_kwargs = dict(engine_kwargs)
+        self.engine = None
+        self.server = None
+        self.service = None
+        self.registration = None
+        self.alive = False
+
+    def boot(self, endpoint: str = "tcp://127.0.0.1:0") -> None:
+        from oim_tpu.serve import (
+            ServeEngine,
+            ServeRegistration,
+            ServeService,
+        )
+        from oim_tpu.serve.service import serve_server
+
+        kwargs = dict(self.engine_kwargs)
+        if kwargs.pop("_draft", False):
+            dparams, dcfg = draft_model()
+            kwargs.setdefault("draft_params", dparams)
+            kwargs.setdefault("draft_cfg", dcfg)
+        params, cfg = model()
+        self.engine = ServeEngine(params, cfg, name=self.rid, **kwargs)
+        self.service = ServeService(self.engine)
+        self.server = serve_server(endpoint, self.service)
+        self.registration = ServeRegistration(
+            self.rid, self.server.addr, self.engine,
+            self.sim.registry_address,
+            interval=self.sim.heartbeat_s, pool=self.sim.pool)
+        self.registration.beat_once()  # deterministic first registration
+        self.registration.start()
+        self.alive = True
+
+    # -- fault levers ------------------------------------------------------
+
+    def kill(self) -> None:
+        """SIGKILL semantics: heartbeats stop mid-lease, the listener
+        dies, nothing deregisters — the row outlives the corpse and the
+        router must retry its way off it. ``quiet``: a SIGKILLed
+        process emits no drain event either, and a spurious
+        REPLICA_DRAIN would pollute the heal signatures the ladder
+        asserts first-occurrence order on."""
+        self.registration.stop(deregister=False)
+        self.server.force_stop()
+        self.engine.stop(drain=False, timeout=30, quiet=True)
+        self.alive = False
+
+    def drain(self) -> None:
+        """SIGTERM semantics: announce ready:false so routers rotate
+        away, finish every resident stream, deregister, then stop the
+        listener (cli/oim_serve.py's shutdown order)."""
+        self.registration.announce_draining()
+        self.engine.stop(drain=True, timeout=60)
+        self.registration.stop(deregister=True)
+        self.server.stop(grace=5.0)
+        self.alive = False
+
+    def kill_listener(self) -> None:
+        """Black-hole the endpoint: the engine and its heartbeat stay
+        alive (the row keeps refreshing, ready:true) but the socket is
+        gone — established router channels ride a dead transport until
+        ``maybe_evict`` drops them."""
+        self.server.force_stop()
+
+    def restart_listener(self) -> None:
+        """Bring the SAME engine back on the SAME address: recovery
+        requires the router's next pick to re-dial a fresh channel."""
+        from oim_tpu.serve.service import serve_server
+
+        addr = self.server.addr
+        self.server = serve_server(f"tcp://{addr}", self.service)
+
+    def restart(self, endpoint: str | None = None) -> None:
+        """A fresh replica process at the same id (new engine, empty
+        caches) — the post-crash reboot."""
+        self.boot(endpoint or f"tcp://{self.server.addr}")
+
+    def completed(self) -> int:
+        """Lifetime requests this replica's engine has finished (any
+        reason) — the 'did traffic actually reach it' probe. Must be
+        MONOTONE: the engine's QPS window deque is not."""
+        return self.engine.finished_total
+
+    def shutdown(self) -> None:
+        if not self.alive:
+            return
+        try:
+            self.kill()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            self.alive = False
+
+
+class ControllerHandle:
+    """One malloc-backed controller daemon (service + server +
+    heartbeat loop)."""
+
+    def __init__(self, sim: "ClusterSim", cid: str):
+        from oim_tpu.controller.controller import (
+            Controller,
+            controller_server,
+        )
+        from oim_tpu.controller.malloc_backend import MallocBackend
+
+        self.cid = cid
+        self.controller = Controller(
+            controller_id=cid, backend=MallocBackend(),
+            controller_address="pending",
+            registry_address=sim.registry_address,
+            registry_delay=sim.controller_delay,
+            mesh_coord=MeshCoord.parse(MESH_COORD),
+            pool=sim.pool)
+        self.server = controller_server(
+            "tcp://localhost:0", self.controller.service)
+        self.controller.controller_address = self.server.addr
+        self.controller.start()
+        self.alive = True
+
+    def kill(self) -> None:
+        """SIGKILL semantics: heartbeats stop, the lease outlives the
+        corpse, data-plane RPCs go UNAVAILABLE."""
+        self.controller.stop()
+        self.server.force_stop()
+        self.alive = False
+
+    def shutdown(self) -> None:
+        if self.alive:
+            try:
+                self.kill()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                self.alive = False
+
+
+class ClusterSim:
+    """The parameterizable in-process cluster (see module docstring).
+
+    Use as a context manager; ``start()``/``stop()`` for manual
+    control. Component handles live in ``registries`` (list of
+    (service, server, manager) named tuples — manager None when
+    unreplicated), ``controllers`` and ``replicas``.
+    """
+
+    def __init__(
+        self,
+        *,
+        replicas: int = 2,
+        registry_pair: bool = False,
+        controllers: int = 0,
+        primary_lease_s: float = 0.5,
+        heartbeat_s: float = 0.3,
+        table_interval_s: float = 0.1,
+        controller_delay_s: float = 0.2,
+        max_batch: int = 2,
+        max_seq: int = 64,
+        queue_depth: int = 64,
+        engine_kwargs: list[dict] | None = None,
+    ):
+        self.n_replicas = replicas
+        self.registry_pair = registry_pair
+        self.n_controllers = controllers
+        self.primary_lease_s = primary_lease_s
+        self.heartbeat_s = heartbeat_s
+        self.table_interval_s = table_interval_s
+        self.controller_delay = controller_delay_s
+        self.engine_defaults = dict(
+            max_batch=max_batch, max_seq=max_seq, queue_depth=queue_depth)
+        self.engine_kwargs = engine_kwargs or []
+        self.pool = ChannelPool()
+        self.registry_address = ""
+        self.registries: list = []   # [(service, server, manager)]
+        self.controllers: list[ControllerHandle] = []
+        self.replicas: list[ReplicaHandle] = []
+        self.table = None
+        self.router = None
+        self.metrics_srv = None
+        self._router_channel = None
+        self.router_stub = None
+        self._feeders: list = []
+        self._tmpfiles: list[str] = []
+        self._started = False
+        # Set by mark_faults(): where this sim's fault schedule began.
+        self.fault_mark = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def __enter__(self) -> "ClusterSim":
+        try:
+            self.start()
+        except BaseException:
+            self.stop()
+            raise
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def start(self) -> None:
+        from oim_tpu.registry import MemRegistryDB, RegistryService
+        from oim_tpu.registry.registry import registry_server
+        from oim_tpu.registry.replication import (
+            PRIMARY,
+            STANDBY,
+            ReplicationManager,
+        )
+        from oim_tpu.router import ReplicaTable, RouterService, router_server
+        from oim_tpu.spec import RegistryStub
+
+        # A fresh flight-recorder ring per sim: convergence assertions
+        # must read THIS cluster's incidents, not an earlier test's.
+        events.configure(capacity=EVENTS_RING)
+        self.metrics_srv = MetricsServer(port=0).start()
+
+        if self.registry_pair:
+            p_svc = RegistryService(db=MemRegistryDB())
+            p_srv = registry_server("tcp://localhost:0", p_svc)
+            s_svc = RegistryService(db=MemRegistryDB())
+            s_srv = registry_server("tcp://localhost:0", s_svc)
+            p_mgr = ReplicationManager(
+                p_svc, peer=s_srv.addr, role=PRIMARY,
+                primary_lease_seconds=self.primary_lease_s,
+                boot_grace_seconds=5.0)
+            s_mgr = ReplicationManager(
+                s_svc, peer=p_srv.addr, role=STANDBY,
+                primary_lease_seconds=self.primary_lease_s,
+                boot_grace_seconds=5.0)
+            self.registries = [(p_svc, p_srv, p_mgr), (s_svc, s_srv, s_mgr)]
+            self.registry_address = f"{p_srv.addr},{s_srv.addr}"
+            p_mgr.start(initial_probe=False)
+            s_mgr.start(initial_probe=False)
+            # The standby must have a complete snapshot before any rung
+            # kills the primary (auto-promotion refuses without one) —
+            # fail the SETUP here rather than misattribute it later as
+            # a broken promotion heal path.
+            if not wait_for(lambda: s_mgr._may_auto_promote(),
+                            timeout=30):
+                raise AssertionError(
+                    "standby never completed its snapshot sync")
+        else:
+            svc = RegistryService(db=MemRegistryDB())
+            srv = registry_server("tcp://localhost:0", svc)
+            self.registries = [(svc, srv, None)]
+            self.registry_address = srv.addr
+
+        for i in range(self.n_controllers):
+            self.controllers.append(ControllerHandle(self, f"host-{i}"))
+        if self.controllers:
+            stub = RegistryStub(self.pool.get(
+                self.registries[0][1].addr, None, "component.registry"))
+
+            def registered():
+                rows = stub.GetValues(
+                    pb.GetValuesRequest(path=""), timeout=10.0).values
+                seen = {v.path.split("/")[0] for v in rows
+                        if v.path.endswith("/address")}
+                return len(seen) >= self.n_controllers
+
+            if not wait_for(registered, timeout=15):
+                raise AssertionError("controllers never registered")
+
+        for i in range(self.n_replicas):
+            kwargs = dict(self.engine_defaults)
+            if i < len(self.engine_kwargs):
+                kwargs.update(self.engine_kwargs[i])
+            handle = ReplicaHandle(self, f"r{i}", kwargs)
+            handle.boot()
+            self.replicas.append(handle)
+
+        if self.n_replicas:
+            self.table = ReplicaTable(
+                self.registry_address, interval=self.table_interval_s,
+                pool=self.pool)
+            self.table.refresh()
+            if len(self.table) != self.n_replicas:
+                raise AssertionError(
+                    f"routing table has {len(self.table)} of "
+                    f"{self.n_replicas} replicas")
+            self.table.start()
+            self.router = router_server(
+                "tcp://127.0.0.1:0",
+                RouterService(self.table, pool=self.pool))
+            self._router_channel = tlsutil.dial(self.router.addr, None)
+            self.router_stub = ServeStub(self._router_channel)
+        self._started = True
+
+    def stop(self) -> None:
+        self._feeders.clear()  # feeders ride the sim's pool; no close
+        if self._router_channel is not None:
+            self._router_channel.close()
+        if self.router is not None:
+            self.router.force_stop()
+        if self.table is not None:
+            self.table.stop()
+        for handle in self.replicas:
+            handle.shutdown()
+        for handle in self.controllers:
+            handle.shutdown()
+        for _, server, manager in self.registries:
+            if manager is not None:
+                try:
+                    manager.stop()
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
+            server.force_stop()
+        if self.metrics_srv is not None:
+            self.metrics_srv.stop()
+        self.pool.close()
+        for path in self._tmpfiles:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        events.configure()  # restore the default ring for later tests
+
+    # -- registry faults ---------------------------------------------------
+
+    def kill_registry_primary(self):
+        """SIGKILL the current PRIMARY registry node (pair mode): its
+        server and replication threads die; the standby's watchdog
+        auto-promotes after the primary lease lapses. Returns the killed
+        node's (service, server, manager) tuple."""
+        from oim_tpu.registry.replication import PRIMARY
+
+        for node in self.registries:
+            svc, server, manager = node
+            if manager is not None and manager.role == PRIMARY:
+                manager.stop()
+                server.force_stop()
+                return node
+        raise AssertionError("no live PRIMARY registry to kill")
+
+    # -- feeder ------------------------------------------------------------
+
+    def feeder(self, controller_id: str = "host-0", **kwargs):
+        from oim_tpu.feeder import Feeder
+
+        feeder = Feeder(registry_address=self.registry_address,
+                        controller_id=controller_id, pool=self.pool,
+                        **kwargs)
+        self._feeders.append(feeder)
+        return feeder
+
+    def tmpfile(self, data: bytes) -> str:
+        f = tempfile.NamedTemporaryFile(
+            prefix="oim-chaos-", suffix=".bin", delete=False)
+        f.write(data)
+        f.close()
+        self._tmpfiles.append(f.name)
+        return f.name
+
+    # -- client load -------------------------------------------------------
+
+    def warm(self) -> None:
+        """One tiny request per engine: jit warms outside any timed or
+        asserted window."""
+        handles = [r.engine.submit([1, 2, 3], max_new=2)
+                   for r in self.replicas if r.alive]
+        for h in handles:
+            h.result(timeout=300)
+
+    def routed_load(self, reqs, concurrency: int = 2, timeout: float = 120.0):
+        """Drive ``reqs`` = [(prompt, n_new, temp, seed), ...] through
+        the router from ``concurrency`` worker threads. Returns
+        (results, errors): results[i] is the token list or None when
+        request i failed."""
+        results: list[list[int] | None] = [None] * len(reqs)
+        errors: list[Exception] = []
+        lock = threading.Lock()
+        work = list(range(len(reqs)))
+
+        def worker():
+            while True:
+                with lock:
+                    if not work:
+                        return
+                    i = work.pop(0)
+                prompt, n_new, temp, seed = reqs[i]
+                try:
+                    toks: list[int] = []
+                    for delta in self.router_stub.Generate(
+                            pb.GenerateRequest(
+                                prompt=prompt, max_new_tokens=n_new,
+                                temperature=temp, seed=seed),
+                            timeout=timeout):
+                        toks.extend(delta.tokens)
+                    with lock:
+                        results[i] = toks
+                except Exception as err:  # noqa: BLE001 - tallied
+                    with lock:
+                        errors.append(err)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(max(1, concurrency))]
+        deadline = time.monotonic() + timeout
+        for t in threads:
+            t.start()
+        for t in threads:
+            # One SHARED deadline: sequential full-timeout joins would
+            # stretch worst-case detection to concurrency x timeout.
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        hung = sum(1 for t in threads if t.is_alive())
+        if hung:
+            # A wedged stream is exactly the failure class the ladder
+            # exists to catch — it must surface as an error, never pass
+            # a zero-error assertion vacuously (results stay None and
+            # assert_byte_identity skips None).
+            with lock:
+                errors.append(TimeoutError(
+                    f"{hung} load worker(s) hung past {timeout}s; "
+                    f"unfinished requests: "
+                    f"{[i for i, r in enumerate(results) if r is None]}"))
+        return results, errors
+
+    def assert_byte_identity(self, reqs, results) -> int:
+        """Every non-None result must equal its solo generate() run.
+        Returns how many results were checked."""
+        checked = 0
+        for (prompt, n_new, temp, seed), toks in zip(reqs, results):
+            if toks is None:
+                continue
+            expect = solo_tokens(prompt, n_new, temperature=temp, seed=seed)
+            if toks != expect:
+                raise AssertionError(
+                    f"routed output diverged from solo generate() for "
+                    f"prompt={prompt} temp={temp} seed={seed}: "
+                    f"{toks} != {expect}")
+            checked += 1
+        return checked
+
+    # -- convergence: /debug/events over HTTP ------------------------------
+
+    def debug_events(self, type_: str = "") -> list[dict]:
+        """The flight recorder as an operator reads it: ``GET
+        /debug/events`` on the sim's metrics server."""
+        url = f"http://127.0.0.1:{self.metrics_srv.port}/debug/events"
+        if type_:
+            url += f"?type={type_}"
+        doc = json.loads(urllib.request.urlopen(url, timeout=10).read())
+        return doc.get("events", [])
+
+    def event_mark(self) -> int:
+        """The newest event seq — rungs scope their convergence reads
+        to 'events after this point'."""
+        evs = self.debug_events()
+        return evs[-1]["seq"] if evs else 0
+
+    def mark_faults(self) -> int:
+        """Record 'the fault schedule starts HERE': the ladder scopes
+        the rung's final heal-signature check to events after this seq,
+        so pre-fault warm/baseline traffic can never pollute the
+        declared first-occurrence order. Returns the mark."""
+        self.fault_mark = self.event_mark()
+        return self.fault_mark
+
+    def heal_signature(self, expect, mark: int = 0) -> list[str]:
+        """First-occurrence order of the ``expect`` event types among
+        events with seq > mark — the rung's observed heal sequence."""
+        seen: list[str] = []
+        for ev in self.debug_events():
+            if ev["seq"] <= mark:
+                continue
+            if ev["type"] in expect and ev["type"] not in seen:
+                seen.append(ev["type"])
+        return seen
+
+    def wait_heal(self, expect, mark: int = 0,
+                  timeout: float = 30.0) -> list[str]:
+        """Block until every type in ``expect`` has fired since
+        ``mark``; returns (and the ladder asserts on) their
+        first-occurrence order."""
+        expect = list(expect)
+
+        def done():
+            return set(self.heal_signature(expect, mark)) >= set(expect)
+
+        if not wait_for(done, timeout=timeout):
+            raise AssertionError(
+                f"heal did not converge: wanted {expect}, saw "
+                f"{self.heal_signature(expect, mark)} in /debug/events")
+        return self.heal_signature(expect, mark)
+
+    # -- invariants --------------------------------------------------------
+
+    def leak_census(self) -> dict:
+        """Zero-leak census over every LIVE replica: no occupied slots,
+        no queued work, every page either free or held by the prefix
+        store (one store entry == one page ref), a drained draft pool,
+        and a bounded channel pool. Returns the census; raises on any
+        leak."""
+        leaks = []
+        census: dict = {"replicas": {}}
+        for handle in self.replicas:
+            if not handle.alive:
+                continue
+            engine = handle.engine
+            pool = engine.pool_stats()
+            prefix = engine.prefix_stats()
+            spec = engine.spec_stats()
+            row = {
+                "active_slots": engine.active_slots,
+                "queued": engine.queue_len,
+                "used_pages": pool["used_pages"],
+                "prefix_entries": prefix["entries"],
+                "draft_used_pages": spec["draft_used_pages"],
+            }
+            census["replicas"][handle.rid] = row
+            if row["active_slots"] or row["queued"]:
+                leaks.append(f"{handle.rid}: live work left "
+                             f"({row['active_slots']} slots, "
+                             f"{row['queued']} queued)")
+            if row["used_pages"] != row["prefix_entries"]:
+                leaks.append(
+                    f"{handle.rid}: {row['used_pages']} pages used but "
+                    f"only {row['prefix_entries']} prefix-store refs — "
+                    f"a retired slot leaked pages")
+            if row["draft_used_pages"]:
+                leaks.append(f"{handle.rid}: {row['draft_used_pages']} "
+                             f"draft pages leaked")
+        census["pooled_channels"] = len(self.pool)
+        # Every pooled channel must belong to a known target (registry
+        # nodes, replicas, controllers) — nothing dangling.
+        known = {server.addr for _, server, _ in self.registries}
+        known |= {h.server.addr for h in self.replicas}
+        known |= {h.server.addr for h in self.controllers}
+        strays = [t for t in self.pool.targets() if t not in known]
+        if strays:
+            leaks.append(f"channels pooled to unknown targets: {strays}")
+        if leaks:
+            raise AssertionError("leak census failed: " + "; ".join(leaks))
+        return census

@@ -111,8 +111,7 @@ class ChannelPool:
             channel = self._channels.get(key)
             keylock = (None if channel is not None
                        else self._dialing.setdefault(key, threading.Lock()))
-        for old in due:
-            old.close()
+        self._close_async(due)
         if channel is not None:
             return channel
         with keylock:
@@ -146,9 +145,21 @@ class ChannelPool:
             self._retired.extend((now, c) for c in evicted)
             due = self._reap_locked(now)
             M.CHANNEL_POOL_SIZE.inc(-len(evicted))
-        for old in due:
-            old.close()
+        self._close_async(due)
         return len(evicted)
+
+    @staticmethod
+    def _close_async(channels) -> None:
+        """Close reaped channels off-thread: closing a channel whose
+        event machinery is wedged (lost termination events — the reason
+        it was evicted) can block inside the core, and reap runs on
+        whatever caller happens by next, often a heal path that must
+        not pay that."""
+        if not channels:
+            return
+        threading.Thread(
+            target=lambda: [c.close() for c in channels],
+            daemon=True, name="oim-channel-reaper").start()
 
     # Transport-class statuses: the RPC never got an answer. UNAVAILABLE
     # is the endpoint refusing/dead; DEADLINE_EXCEEDED is the black-holed

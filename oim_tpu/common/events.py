@@ -47,6 +47,12 @@ REGISTRY_PROMOTION = "registry_promotion"
 REGISTRY_DEMOTION = "registry_demotion"
 ROUTER_RETRY = "router_retry"
 ROUTER_MARK_FAILED = "router_mark_failed"
+# The replica table aged past --max-stale (registry outage outlasting
+# the cached snapshot): the router is now REFUSING picks, which is
+# invisible from metrics alone. The recovery twin fires on the first
+# successful refresh after a stale episode.
+ROUTER_TABLE_STALE = "router_table_stale"
+ROUTER_TABLE_RECOVERED = "router_table_recovered"
 REPLICA_DRAIN = "replica_drain"
 STAGE_CACHE_EVICTION = "stage_cache_eviction"
 SLOT_EVICTED = "slot_evicted"

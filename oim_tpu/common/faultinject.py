@@ -25,6 +25,35 @@ Named points wired in this repo:
   replication stream record (ctx: kind). Arming it severs the stream
   mid-apply, deterministically: the follower reconnects and catches up.
 
+Serving-tier points (the chaos ladder's levers, oim_tpu/chaos):
+
+* ``router.pick``          — at the top of the router's replica pick
+  (ctx: tried). Arming it fails the pick itself.
+* ``router.stream``        — before the router opens the upstream
+  Generate stream (ctx: replica). Arm an ``InjectedRpcError`` to
+  exercise the pre-first-token retry contract without killing anything.
+* ``serve.admit``          — in ``ServeEngine.submit`` before the queue
+  (ctx: engine). Arm a ``QueueFull``/``Draining`` instance to simulate
+  admission refusal and the router's backpressure retry.
+* ``serve.decode``         — at the top of each decode round (ctx:
+  engine). Arming it wedges the engine: the loop's catch-all fails
+  every request and the replica stops admitting (a crashed-but-
+  listening replica).
+* ``serve.retire``         — before a retiring slot releases its pages
+  (ctx: engine, reason). Arming it crashes the engine AT retirement —
+  the census tests prove even that path leaks nothing.
+* ``spec.propose``         — in the draft-slot mapping (ctx: engine).
+  An armed ``InjectedFault`` is absorbed as a draft-pool allocation
+  failure: the request demotes to plain decode, never errors.
+* ``registry.promote``     — in the lease watchdog, before an
+  auto-promotion attempt (ctx: role). The watchdog absorbs an armed
+  ``InjectedFault`` and retries next tick (a promotion attempt lost
+  mid-flight); ``times=N`` delays convergence by exactly N ticks. The
+  admin ``--promote`` path never fires it.
+* ``prestage.fanout``      — before the feeder's warm-standby
+  PrestageVolume RPC (ctx: volume, target). Absorbed: warming is
+  advisory.
+
 All state is process-global (the fixture in tests resets it); a
 ``fire`` on an unarmed point costs one dict lookup.
 """
@@ -35,9 +64,31 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any
 
+import grpc
+
 
 class InjectedFault(Exception):
     """Raised at an armed fault point (when no custom exc is supplied)."""
+
+
+class InjectedRpcError(grpc.RpcError):
+    """An armable transport-class fault: carries a real
+    ``grpc.StatusCode`` so retry contracts and channel-pool eviction
+    (``ChannelPool.maybe_evict``) treat it exactly like the wire. Args
+    carry the full state, so per-fire re-instantiation (see ``fire``)
+    reproduces it faithfully."""
+
+    def __init__(self, code: grpc.StatusCode = grpc.StatusCode.UNAVAILABLE,
+                 details: str = "injected fault"):
+        super().__init__(code, details)
+        self._code = code
+        self._details = details
+
+    def code(self) -> grpc.StatusCode:
+        return self._code
+
+    def details(self) -> str:
+        return self._details
 
 
 @dataclass
@@ -85,7 +136,15 @@ def fired(point: str) -> int:
 
 def fire(point: str, **ctx: Any) -> None:
     """Production-code hook: raise if ``point`` is armed and ``ctx``
-    matches. No-op (one dict lookup) otherwise."""
+    matches. No-op (one dict lookup) otherwise.
+
+    A fault armed with an exception INSTANCE and ``times != 1`` is
+    re-instantiated per fire (``type(exc)(*exc.args)``): raising one
+    shared instance from several threads concurrently mutates its
+    ``__traceback__`` under every raiser at once. ``times=1`` keeps the
+    caller's exact object (tests assert identity on it); an exception
+    that cannot be rebuilt from its args falls back to the shared
+    instance."""
     with _lock:
         fault = _faults.get(point)
         if fault is None:
@@ -97,4 +156,12 @@ def fire(point: str, **ctx: Any) -> None:
                 return
         fault.fired += 1
         exc = fault.exc
-    raise exc if not isinstance(exc, type) else exc(point)
+        per_fire = not isinstance(exc, type) and fault.times != 1
+    if isinstance(exc, type):
+        raise exc(point)
+    if per_fire:
+        try:
+            exc = type(exc)(*exc.args)
+        except Exception:  # noqa: BLE001 - unreconstructable: shared
+            pass
+    raise exc

@@ -28,12 +28,12 @@ from __future__ import annotations
 
 import json
 import os
-import random
 import threading
 
 import grpc
 
 from oim_tpu.common import channelpool
+from oim_tpu.common.backoff import ExponentialBackoff
 from oim_tpu.common.endpoints import FAILOVER_CODES, RegistryEndpoints
 from oim_tpu.common.logging import from_context
 from oim_tpu.common.pathutil import REGISTRY_TELEMETRY
@@ -123,27 +123,27 @@ class RegistryRowPublisher:
     def start(self) -> None:
         def loop() -> None:
             log = from_context().with_fields(row=self.key)
-            failures = 0
+            # Same jittered-exponential discipline as the controller
+            # heartbeat loop, via the shared common/backoff.py copy.
+            backoff = ExponentialBackoff(
+                base=min(1.0, self.interval), cap=self.BACKOFF_MAX)
             while not self._stop.is_set():
                 try:
                     self.beat_once()
-                    failures = 0
+                    backoff.reset()
                     log.debug("row heartbeat",
                               registry=self._endpoints.current())
                 except grpc.RpcError as err:
-                    failures += 1
                     if (self._endpoints.multiple
                             and err.code() in FAILOVER_CODES):
                         target = self._endpoints.advance()
                         log.warning("failing over to peer registry",
                                     target=target)
-                    base = min(1.0, self.interval)
-                    delay = min(base * 2 ** (failures - 1), self.BACKOFF_MAX)
-                    delay *= 0.5 + random.random()  # noqa: S311 - jitter
+                    delay = backoff.next()
                     log.warning(
                         "registry unreachable; backing off",
                         error=err.details() or str(err.code()),
-                        attempt=failures, retry_s=round(delay, 3))
+                        attempt=backoff.failures, retry_s=round(delay, 3))
                     if self._stop.wait(delay):
                         return
                     continue

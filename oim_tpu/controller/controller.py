@@ -21,13 +21,13 @@
 
 from __future__ import annotations
 
-import random
 import threading
 import time
 
 import grpc
 
 from oim_tpu.common import channelpool, faultinject, metrics as M
+from oim_tpu.common.backoff import ExponentialBackoff
 from oim_tpu.common.endpoints import FAILOVER_CODES, RegistryEndpoints
 from oim_tpu.common.keymutex import KeyMutex
 from oim_tpu.common.logging import from_context
@@ -423,7 +423,12 @@ class Controller:
             log = from_context().with_fields(controller=self.controller_id)
             registered = False
             heartbeat_supported = True
-            failures = 0
+            # Jittered exponential backoff (common/backoff.py): a
+            # restarting registry must not be hit by the whole fleet in
+            # lockstep. The base scales down with registry_delay so
+            # short-interval test rigs retry promptly.
+            backoff = ExponentialBackoff(
+                base=min(1.0, self.registry_delay), cap=self.BACKOFF_MAX)
             while not self._stop.is_set():
                 try:
                     if not registered or not heartbeat_supported:
@@ -439,7 +444,7 @@ class Controller:
                             registered = False
                             continue
                         log.debug("heartbeat", registry=self.registry_address)
-                    failures = 0
+                    backoff.reset()
                 except (grpc.RpcError, faultinject.InjectedFault) as err:
                     if (isinstance(err, grpc.RpcError)
                             and err.code() == grpc.StatusCode.UNIMPLEMENTED
@@ -452,7 +457,6 @@ class Controller:
                             "periodic re-registration"
                         )
                         continue
-                    failures += 1
                     detail = (err.details() or str(err.code())
                               if isinstance(err, grpc.RpcError) else str(err))
                     if (self._endpoints.multiple
@@ -466,14 +470,10 @@ class Controller:
                         target = self._endpoints.advance()
                         log.warning("failing over to peer registry",
                                     target=target)
-                    # Jittered exponential backoff: a restarting registry
-                    # must not be hit by the whole fleet in lockstep.
-                    base = min(1.0, self.registry_delay)
-                    delay = min(base * 2 ** (failures - 1), self.BACKOFF_MAX)
-                    delay *= 0.5 + random.random()  # noqa: S311 - jitter
+                    delay = backoff.next()
                     log.warning(
                         "registry unreachable; backing off",
-                        error=detail, attempt=failures,
+                        error=detail, attempt=backoff.failures,
                         retry_s=round(delay, 3),
                     )
                     # Conservatively assume the lease may lapse during the

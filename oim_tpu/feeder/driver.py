@@ -20,7 +20,7 @@ feeder sees placement metadata and polls readiness).
 from __future__ import annotations
 
 import dataclasses
-import random
+import queue
 import threading
 import time
 import weakref
@@ -35,6 +35,7 @@ from oim_tpu.common import (
     metrics as M,
     tracing,
 )
+from oim_tpu.common.backoff import DecorrelatedJitter
 from oim_tpu.common.endpoints import RegistryEndpoints
 from oim_tpu.common.keymutex import KeyMutex
 from oim_tpu.common.logging import from_context
@@ -63,6 +64,24 @@ class DeadlineExceeded(PublishError):
     """Staging did not materialize before the deadline (the analog of the
     reference's device-wait hitting its context deadline,
     nodeserver.go:348-351)."""
+
+
+class _WindowStalled(grpc.RpcError):
+    """A window stream that delivered nothing for STALL_CANCEL_S: the
+    transport's termination event was lost (the endpoint died but the
+    blocked read never learned). Shaped as a transport-class
+    UNAVAILABLE so the existing fallback ladder — proxy, then
+    controller failover — heals it like any other dead endpoint."""
+
+    def __init__(self, details: str):
+        super().__init__(details)
+        self._details = details
+
+    def code(self) -> grpc.StatusCode:
+        return grpc.StatusCode.UNAVAILABLE
+
+    def details(self) -> str:
+        return self._details
 
 
 @dataclasses.dataclass
@@ -111,6 +130,13 @@ class Feeder:
     # (otherwise a tight-budget feed against a black-holed endpoint
     # would re-pay the probe hang on every single window).
     BACKOFF_MIN_DEADLINE_S = 1.0
+    # A ReadVolume stream that delivers NOTHING for this long is
+    # locally cancelled (see _read_window's stall belt): chunks arrive
+    # back-to-back from a healthy server, so two silent windows of this
+    # means the transport's termination event was lost, not that the
+    # stream is slow. Generous on purpose — a legitimately slow stream
+    # is bounded by the RPC deadline, not by this.
+    STALL_CANCEL_S = 10.0
 
     def __init__(
         self,
@@ -305,6 +331,8 @@ class Feeder:
             return None
         address = self._endpoints.current()
         try:
+            faultinject.fire("prestage.fanout",
+                             volume=request.volume_id, target=target)
             ControllerStub(self._registry_channel()).PrestageVolume(
                 request,
                 metadata=[(CONTROLLER_ID_META, target)],
@@ -315,6 +343,17 @@ class Feeder:
                 volume=request.volume_id, target=target,
             )
             return target
+        except (faultinject.InjectedFault, faultinject.InjectedRpcError):
+            # Warming is advisory: an injected fan-out failure (like a
+            # real one) must never fail the publish it rode along with.
+            # InjectedRpcError is caught HERE, not by the RpcError
+            # branch below: it never touched the wire, so it must not
+            # evict the healthy pooled registry channel.
+            from_context().warning(
+                "standby prestage fault-injected",
+                volume=request.volume_id, target=target,
+            )
+            return None
         except grpc.RpcError as err:
             self._pool.maybe_evict(err, address)
             from_context().warning(
@@ -478,14 +517,15 @@ class Feeder:
                     )
                 return rem
 
-            # Decorrelated-jitter backoff (capped well under any
-            # sane deadline): a fast stage is noticed in ~ms instead
-            # of a fixed 50 ms quantum, a long one is polled gently,
-            # and a fleet of feeders never beats on the controller in
-            # lockstep. The histogram makes publish latency spent in
-            # this loop attributable from /metrics alone.
+            # Decorrelated-jitter pacing (common/backoff.py; capped
+            # well under any sane deadline): a fast stage is noticed
+            # in ~ms instead of a fixed 50 ms quantum, a long one is
+            # polled gently, and a fleet of feeders never beats on the
+            # controller in lockstep. The histogram makes publish
+            # latency spent in this loop attributable from /metrics
+            # alone.
             wait_t0 = time.monotonic()
-            delay = self.POLL_BASE_S
+            poll = DecorrelatedJitter(self.POLL_BASE_S, self.POLL_CAP_S)
             try:
                 while True:
                     status = stub.StageStatus(
@@ -497,12 +537,7 @@ class Feeder:
                         raise PublishError(status.error)
                     if status.ready:
                         break
-                    delay = min(
-                        self.POLL_CAP_S,
-                        random.uniform(  # noqa: S311 - jitter
-                            self.POLL_BASE_S, delay * 3),
-                    )
-                    time.sleep(min(delay, remaining()))
+                    time.sleep(min(poll.next(), remaining()))
             finally:
                 M.STAGE_WAIT_SECONDS.observe(time.monotonic() - wait_t0)
             reply = stub.MapVolume(
@@ -738,32 +773,101 @@ class Feeder:
             metadata=[(CONTROLLER_ID_META, self.controller_id)],
             timeout=timeout,
         )
+        # Stall belt over the transport deadline: when the serving
+        # endpoint dies mid-stream, the C core's termination event
+        # (goaway / deadline-expired) is occasionally lost (seen under
+        # this gVisor sandbox) and a blocked read then waits forever —
+        # past any RPC deadline, and a local call.cancel() can itself
+        # block inside the wedged core. So the blocking iteration runs
+        # on an ABANDONABLE pump thread and the consumer takes chunks
+        # through a queue with a no-progress timeout: a silent stream
+        # becomes a transport-class UNAVAILABLE the fallback ladder
+        # already heals (proxy, then failover), while the abandoned
+        # daemon pump costs one parked thread in a case that previously
+        # hung the data path outright. Progress resets the clock, so a
+        # big window streaming slowly is bounded by the RPC deadline
+        # alone, never by STALL_CANCEL_S.
+        chunks: queue.Queue = queue.Queue(maxsize=2)
+        abandoned = threading.Event()
+        _EOS = object()
+
+        def _put(item) -> bool:
+            # Bounded-queue put that notices an abandoned consumer: a
+            # consumer that raised (stall, bad chunk) stops draining,
+            # and a plain put() would park this pump thread forever
+            # with the call — and its server-side stream — alive.
+            while not abandoned.is_set():
+                try:
+                    chunks.put(item, timeout=1.0)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def _pump() -> None:
+            try:
+                for item in call:
+                    if not _put(item):
+                        return
+                _put(_EOS)
+            except BaseException as err:  # noqa: BLE001 - relayed
+                _put(err)
+
+        def _abandon() -> None:
+            # Best-effort teardown off-thread (cancel may block in the
+            # same wedged core the stall belt exists to survive).
+            abandoned.set()
+            threading.Thread(target=call.cancel, daemon=True).start()
+
+        threading.Thread(
+            target=_pump, daemon=True, name="oim-window-pump").start()
         buf = None
         view = None
         spec = None
         total = 0
         end_rel = 0
         try:
-            for chunk in call:
+            while True:
+                try:
+                    chunk = chunks.get(timeout=self.STALL_CANCEL_S)
+                except queue.Empty:
+                    stalled = _WindowStalled(
+                        f"window stream of {volume_id!r} delivered "
+                        f"nothing for {self.STALL_CANCEL_S:.0f}s")
+                    stalled.oim_bytes_received = end_rel
+                    raise stalled from None
+                if chunk is _EOS:
+                    break
+                if isinstance(chunk, BaseException):
+                    # Annotate how far the stream got before failing:
+                    # the caller's deadline policy distinguishes "no
+                    # bytes ever arrived" (stalled endpoint) from "a
+                    # large window was still streaming fine when the
+                    # caller's budget ran out".
+                    chunk.oim_bytes_received = end_rel
+                    raise chunk
                 if spec is None and chunk.HasField("spec"):
                     spec = chunk.spec
                 if buf is None:
                     # First chunk: total_bytes bounds the window exactly
                     # the way the server computes it.
                     total = int(chunk.total_bytes)
-                    end = total if length == 0 else min(offset + length, total)
+                    end = total if length == 0 else min(
+                        offset + length, total)
                     buf = bytearray(max(end - offset, 0))
                     view = memoryview(buf)
                 if chunk.data:
                     rel = int(chunk.offset) - offset
                     view[rel:rel + len(chunk.data)] = chunk.data
                     end_rel = max(end_rel, rel + len(chunk.data))
-        except grpc.RpcError as err:
-            # Annotate how far the stream got before failing: the
-            # caller's deadline policy distinguishes "no bytes ever
-            # arrived" (stalled endpoint) from "a large window was still
-            # streaming fine when the caller's budget ran out".
-            err.oim_bytes_received = end_rel
+        except BaseException:
+            # EVERY consumer exit that leaves the pump running must
+            # abandon it (cancel the RPC, release the put loop) — a
+            # malformed chunk raising out of the copy above would
+            # otherwise leak the pump thread and its open server-side
+            # stream. Relayed pump errors and stalls included: cancel
+            # on a finished call is a no-op.
+            _abandon()
             raise
         if buf is None:  # stream yielded nothing (cancelled mid-setup)
             buf = bytearray()

@@ -47,13 +47,13 @@ from __future__ import annotations
 import http.server
 import json
 import os
-import random
 import threading
 import time
 
 import grpc
 
 from oim_tpu.common import faultinject, metrics as M
+from oim_tpu.common.backoff import jittered
 from oim_tpu.common.endpoints import RegistryEndpoints
 from oim_tpu.common.logging import from_context
 from oim_tpu.common.pathutil import REGISTRY_ADDRESS, REGISTRY_MESH
@@ -567,7 +567,11 @@ class ReplicationManager:
             lease = self._effective_primary_lease()
             cap = min(self.BACKOFF_MAX, lease / 2) if lease > 0 \
                 else self.BACKOFF_MAX
-            if self._pause(min(delay, cap) * (0.5 + random.random())):  # noqa: S311
+            # The cap is dynamic (lease/2, re-read each pass), so this
+            # loop keeps its own doubling — but the jitter draw rides
+            # common/backoff.py's shared source, so a seeded use_rng()
+            # (the chaos ladder) controls this clock too.
+            if self._pause(jittered(min(delay, cap))):
                 return
             delay = min(delay * 2, cap)
 
@@ -713,9 +717,20 @@ class ReplicationManager:
                 age = time.monotonic() - self._last_activity
             M.REPL_LAG_SECONDS.set(age)
             if lease > 0 and age > lease and self._may_auto_promote():
-                self.promote(
-                    reason=f"primary lease expired "
-                           f"({age:.1f}s > {lease:.1f}s since last record)")
+                try:
+                    # Chaos lever: an auto-promotion attempt lost
+                    # mid-flight. Fired HERE, not inside promote(), so
+                    # the admin --promote path never raises an injected
+                    # fault at an operator, and idempotent no-op calls
+                    # never consume an armed times=N budget — times=N
+                    # delays convergence by exactly N watchdog ticks.
+                    faultinject.fire("registry.promote", role=self.role)
+                    self.promote(
+                        reason=f"primary lease expired "
+                               f"({age:.1f}s > {lease:.1f}s since last "
+                               f"record)")
+                except faultinject.InjectedFault:
+                    pass  # armed registry.promote: retried next tick
 
 
 class HealthzServer:

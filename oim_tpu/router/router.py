@@ -62,6 +62,7 @@ import grpc
 from oim_tpu.common import (
     channelpool,
     events,
+    faultinject,
     metrics as M,
     prefixhash,
     tracing,
@@ -181,6 +182,7 @@ class RouterService:
         """(replica, was_affinity_pick); the one pick implementation.
         ``hash_cache`` is the per-request hash memo (block size ->
         chain hashes) — _route passes one dict across retry attempts."""
+        faultinject.fire("router.pick", tried=len(exclude))
         candidates = [r for r in self.table.replicas()
                       if r.replica_id not in exclude]
         if not candidates:
@@ -241,6 +243,14 @@ class RouterService:
     def _one_attempt(self, replica, request, context, span):
         """Open the upstream stream and yield ('delta', bytes) items;
         terminal items are ('done', finish_reason) / ('err', RpcError)."""
+        try:
+            # Armed with an InjectedRpcError, the fault takes the SAME
+            # path a refusing/dead upstream does: the retry contract and
+            # pool eviction run without a process to kill.
+            faultinject.fire("router.stream", replica=replica.replica_id)
+        except grpc.RpcError as err:
+            yield ("err", err)
+            return
         metadata = tracing.inject([], span.context)
         channel = self._pool.get(
             replica.endpoint, self.tls,

@@ -24,13 +24,13 @@ from __future__ import annotations
 
 import dataclasses
 import json
-import random
 import threading
 import time
 
 import grpc
 
 from oim_tpu.common import channelpool, events, metrics as M
+from oim_tpu.common.backoff import ExponentialBackoff, jittered
 from oim_tpu.common.endpoints import FAILOVER_CODES, RegistryEndpoints
 from oim_tpu.common.logging import from_context
 from oim_tpu.common.tlsutil import TLSConfig
@@ -133,6 +133,12 @@ class ReplicaTable:
         # re-admitting it would point most picks at a corpse for the
         # whole lease window.
         self._failed: dict[str, str | None] = {}
+        # True while the cached snapshot has aged past max_stale: the
+        # table is serving NOTHING. Guarded by _lock; the transition
+        # (not the steady state) emits the flight-recorder event — a
+        # router refusing picks must be visible in /debug/events, not
+        # only as client UNAVAILABLEs.
+        self._stale = False
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -172,7 +178,15 @@ class ReplicaTable:
                 if rid in raw and raw[rid] == val
             }
             count = sum(1 for rid in fresh if rid not in self._failed)
-        M.ROUTER_REPLICAS.set(count)
+            recovered, self._stale = self._stale, False
+            # Gauge + recovery event inside the lock: a concurrent
+            # replicas() entering stale mode serializes against this,
+            # so the flight recorder can never show recovered-before-
+            # stale and the gauge never reads a stale 0 after a fresh
+            # snapshot (emit is one deque append — cheap under a lock).
+            M.ROUTER_REPLICAS.set(count)
+            if recovered:
+                events.emit(events.ROUTER_TABLE_RECOVERED, replicas=count)
 
     def _refresh_if_due(self) -> None:
         with self._lock:
@@ -193,10 +207,22 @@ class ReplicaTable:
         if self._thread is None:
             self._refresh_if_due()
         with self._lock:
-            if time.monotonic() - self._refreshed_at > self.max_stale:
-                return []
-            return [r for r in self._replicas.values()
-                    if r.replica_id not in self._failed]
+            age = time.monotonic() - self._refreshed_at
+            if self._refreshed_at and age <= self.max_stale:
+                return [r for r in self._replicas.values()
+                        if r.replica_id not in self._failed]
+            # A table that never refreshed is EMPTY, not stale: no
+            # snapshot existed to age out, and a boot-race pick must
+            # not stamp the recorder with age_s = the host's monotonic
+            # uptime (the poll thread's first refresh is in flight).
+            if self._refreshed_at:
+                entered, self._stale = not self._stale, True
+                if entered:  # once per episode
+                    M.ROUTER_REPLICAS.set(0)
+                    events.emit(events.ROUTER_TABLE_STALE,
+                                age_s=round(age, 3),
+                                max_stale_s=self.max_stale)
+        return []
 
     def mark_failed(self, replica_id: str) -> None:
         """Data-path verdict: drop ``replica_id`` from the routable set
@@ -205,11 +231,18 @@ class ReplicaTable:
         frozen lease is still ticking."""
         with self._lock:
             self._failed[replica_id] = self._raw.get(replica_id)
-            count = sum(1 for r in self._replicas.values()
-                        if r.replica_id not in self._failed)
-        M.ROUTER_REPLICAS.set(count)
-        events.emit(events.ROUTER_MARK_FAILED, replica=replica_id,
-                    routable=count)
+            # During a stale episode the routable set is EMPTY whatever
+            # the expired snapshot says — the gauge and the event must
+            # not resurrect a positive count replicas() is refusing.
+            count = 0 if self._stale else sum(
+                1 for r in self._replicas.values()
+                if r.replica_id not in self._failed)
+            # Same in-lock discipline as refresh(): a gauge set that
+            # escapes the lock can overwrite a concurrent fresh
+            # snapshot's count with this stale one.
+            M.ROUTER_REPLICAS.set(count)
+            events.emit(events.ROUTER_MARK_FAILED, replica=replica_id,
+                        routable=count)
 
     def __len__(self) -> int:
         return len(self.replicas())
@@ -220,23 +253,25 @@ class ReplicaTable:
         """Begin the jittered background poll."""
         def loop() -> None:
             log = from_context()
-            failures = 0
+            # Shared backoff discipline (common/backoff.py): jitter
+            # spreads a router fleet's polls so the registry never sees
+            # them in lockstep, failures back off exponentially.
+            backoff = ExponentialBackoff(base=self.interval, cap=30.0)
             while not self._stop.is_set():
                 try:
                     self.refresh()
-                    failures = 0
+                    backoff.reset()
+                    delay = jittered(self.interval)
                 except grpc.RpcError as err:
-                    failures += 1
+                    # Hard 30s ceiling AFTER jitter: the poll is how a
+                    # stale (refuse-all-picks) table notices the
+                    # registry is back, so its worst-case gap must not
+                    # exceed the default --max-stale window.
+                    delay = min(backoff.next(), 30.0)
                     log.warning(
                         "replica table refresh failed",
                         registry=self._endpoints.current(),
-                        error=err.code().name, attempt=failures)
-                # Jitter spreads a router fleet's polls so the registry
-                # never sees them in lockstep (same stance as the
-                # controller heartbeat loop's backoff jitter).
-                delay = self.interval * (0.5 + random.random())  # noqa: S311
-                if failures:
-                    delay = min(delay * 2 ** (failures - 1), 30.0)
+                        error=err.code().name, attempt=backoff.failures)
                 if self._stop.wait(delay):
                     return
 

@@ -85,7 +85,13 @@ from typing import Any
 
 import numpy as np
 
-from oim_tpu.common import events, metrics as M, prefixhash, tracing
+from oim_tpu.common import (
+    events,
+    faultinject,
+    metrics as M,
+    prefixhash,
+    tracing,
+)
 from oim_tpu.common.logging import from_context
 from oim_tpu.models.llama import Config
 from oim_tpu.serve.pagepool import PagePool
@@ -354,6 +360,7 @@ class ServeEngine:
         spec_accept_floor: float = 0.3,
         spec_window_rounds: int = 64,
         spec_reprobe_rounds: int = 256,
+        name: str = "",
     ):
         import jax
         import jax.numpy as jnp
@@ -381,6 +388,11 @@ class ServeEngine:
                     f"target vocab ({cfg.vocab}): the acceptance ratio "
                     f"test compares distributions over one vocabulary")
         self._jax, self._jnp = jax, jnp
+        # The engine's name in fault-point context (ctx: engine=...): a
+        # multi-replica process (bench clusters, the chaos sim) arms a
+        # fault against ONE replica's engine by matching on it. "" for
+        # engines that never meet targeted faults.
+        self.name = str(name)
         self.cfg = cfg
         self.max_batch = max_batch
         self.max_seq = max_seq
@@ -531,6 +543,11 @@ class ServeEngine:
         self._stopping = False
         self._draining = False
         self._completions: collections.deque[float] = collections.deque()
+        # Lifetime finished-request count (any reason). _completions is
+        # a sliding QPS WINDOW — its length is not monotone — so "did
+        # traffic ever reach this engine" probes (the chaos sim) need
+        # their own counter.
+        self.finished_total = 0
         self._thread = threading.Thread(
             target=self._run, name="oim-serve-engine", daemon=True)
         self._thread.start()
@@ -559,6 +576,18 @@ class ServeEngine:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({max_new}) "
                 f"exceeds the engine's max_seq {self.max_seq}")
+        # Chaos lever: arm a QueueFull/Draining INSTANCE to simulate
+        # admission refusal (the service maps them to the wire statuses
+        # the router's retry contract covers).
+        try:
+            faultinject.fire("serve.admit", engine=self.name)
+        except QueueFull:
+            # A simulated refusal must be indistinguishable from a real
+            # one in /metrics (the real path below increments this; a
+            # Draining injection mirrors the real Draining path, which
+            # records nothing).
+            M.SERVE_REQUESTS_TOTAL.labels(outcome="rejected").inc()
+            raise
         need = self._blocks_needed(len(prompt), max_new)
         if need > self._pagepool.n_pages:
             # A request the whole pool can never hold would queue
@@ -589,19 +618,31 @@ class ServeEngine:
 
     # -- lifecycle ----------------------------------------------------------
 
-    def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
+    def stop(self, drain: bool = True, timeout: float = 60.0,
+             quiet: bool = False) -> None:
         """Shut the engine down. ``drain=True`` (graceful) finishes every
         RESIDENT request first; queued-but-unadmitted requests finish as
-        "drained" either way (their stream closes with no tokens)."""
+        "drained" either way (their stream closes with no tokens).
+        ``quiet`` suppresses the flight-recorder event — for harnesses
+        simulating a SIGKILL, where the real process would have emitted
+        nothing."""
+        with self._lock:
+            active = sum(s is not None for s in self._slots)
+            queued = len(self._pending)
+        # Emit BEFORE flipping the drain flag: the first thing a drain
+        # causes downstream is a Draining->UNAVAILABLE rejection, and
+        # the flight recorder must show its cause (this event) strictly
+        # before its effects (router_mark_failed/router_retry) — the
+        # chaos ladder asserts that order. The counts are a snapshot
+        # one instruction early, which is all they ever were.
+        if not quiet:
+            events.emit(events.REPLICA_DRAIN, graceful=drain,
+                        active_slots=active, queued=queued)
         with self._lock:
             self._draining = True
             if not drain:
                 self._stopping = True
-            active = sum(s is not None for s in self._slots)
-            queued = len(self._pending)
             self._work.notify()
-        events.emit(events.REPLICA_DRAIN, graceful=drain,
-                    active_slots=active, queued=queued)
         self._thread.join(timeout=timeout)
 
     @property
@@ -775,6 +816,7 @@ class ServeEngine:
         req.finish_reason = reason
         req.finished_at = time.monotonic()
         req.out.put(_DONE)
+        self.finished_total += 1
         M.SERVE_REQUESTS_TOTAL.labels(outcome=reason).inc()
         now = req.finished_at
         self._completions.append(now)
@@ -958,6 +1000,13 @@ class ServeEngine:
         already accepted."""
         if not self.spec_tokens or not self._valve.open:
             return False
+        try:
+            # Chaos lever: an armed InjectedFault IS a draft-pool
+            # allocation failure — the request demotes to plain decode
+            # (speculation is an accelerator, never a dependency).
+            faultinject.fire("spec.propose", engine=self.name)
+        except faultinject.InjectedFault:
+            return False
         need = self._blocks_needed(n, req.max_new)
         pages = self._draft_pagepool.alloc(need)
         if pages is None:
@@ -1069,6 +1118,10 @@ class ServeEngine:
             reason = "length"
         else:
             return False
+        # Chaos lever: a crash AT retirement, before any page returns —
+        # the hardest spot to leak from (the census tests prove the
+        # engine's failure teardown still zeroes the pools).
+        faultinject.fire("serve.retire", engine=self.name, reason=reason)
         self._release_slot(slot, req)
         with self._lock:
             self._slots[slot] = None
@@ -1088,6 +1141,10 @@ class ServeEngine:
         configured, the valve is open and any live slot holds a draft
         cache; one plain lockstep decode step otherwise (a closed
         valve's plain rounds tick the re-probe cooldown)."""
+        # Chaos lever: an armed fault here wedges the engine — the run
+        # loop's catch-all fails every request and stops admissions (a
+        # crashed-but-still-listening replica).
+        faultinject.fire("serve.decode", engine=self.name)
         if self.spec_tokens:
             if self._valve.open:
                 with self._lock:

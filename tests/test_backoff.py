@@ -1,0 +1,122 @@
+"""Unit tests for common/backoff.py — the consolidated retry pacing
+behind the controller heartbeat loop, the registry-row publisher, the
+router table poll, and the feeder's StageStatus poll. The chaos ladder
+fast-forwards these deterministically via ``use_rng``; these tests pin
+the arithmetic and the determinism hook so four loops can share one
+clock."""
+
+import random
+
+import pytest
+
+from oim_tpu.common import backoff
+from oim_tpu.common.backoff import (
+    DecorrelatedJitter,
+    ExponentialBackoff,
+    jittered,
+)
+
+
+@pytest.fixture(autouse=True)
+def _restore_uniform():
+    yield
+    backoff.use_rng(None)
+
+
+class TestExponentialBackoff:
+    def test_growth_cap_and_jitter_bounds(self):
+        b = ExponentialBackoff(base=1.0, cap=8.0)
+        for i in range(12):
+            delay = b.next()
+            raw = min(1.0 * 2 ** i, 8.0)
+            assert 0.5 * raw <= delay <= 1.5 * raw
+        assert b.failures == 12
+
+    def test_reset_restarts_the_ramp(self):
+        backoff.use_rng(random.Random(0))
+        b = ExponentialBackoff(base=2.0, cap=64.0, jitter=(1.0, 1.0))
+        assert [b.next(), b.next(), b.next()] == [2.0, 4.0, 8.0]
+        b.reset()
+        assert b.failures == 0
+        assert b.next() == 2.0
+
+    def test_deterministic_under_seeded_rng(self):
+        """use_rng is the chaos ladder's fast-forward hook: the same
+        seed must reproduce the same schedule exactly."""
+        def schedule():
+            backoff.use_rng(random.Random(42))
+            b = ExponentialBackoff(base=0.5, cap=30.0)
+            return [b.next() for _ in range(8)]
+
+        assert schedule() == schedule()
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ExponentialBackoff(base=0, cap=1)
+        with pytest.raises(ValueError):
+            ExponentialBackoff(base=1, cap=-1)
+        with pytest.raises(ValueError):
+            ExponentialBackoff(base=1, cap=1, factor=0.5)
+        with pytest.raises(ValueError):
+            ExponentialBackoff(base=1, cap=1, jitter=(2.0, 1.0))
+
+
+class TestDecorrelatedJitter:
+    def test_bounds_cap_and_reset(self):
+        d = DecorrelatedJitter(base=0.002, cap=0.25)
+        prev = 0.002
+        for _ in range(50):
+            delay = d.next()
+            # Each draw sits in [base, min(cap, prev * 3)].
+            assert 0.002 <= delay <= min(0.25, prev * 3) + 1e-12
+            prev = delay
+        d.reset()
+        assert d.next() <= 0.002 * 3
+
+    def test_deterministic_under_seeded_rng(self):
+        def schedule():
+            backoff.use_rng(random.Random(7))
+            d = DecorrelatedJitter(base=0.01, cap=1.0)
+            return [d.next() for _ in range(20)]
+
+        assert schedule() == schedule()
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            DecorrelatedJitter(base=0, cap=1)
+        with pytest.raises(ValueError):
+            DecorrelatedJitter(base=1, cap=0.5)
+        with pytest.raises(ValueError):
+            DecorrelatedJitter(base=0.1, cap=1, mult=1.0)
+
+
+class TestJittered:
+    def test_bounds_and_determinism(self):
+        for _ in range(20):
+            assert 1.0 <= jittered(2.0) <= 3.0
+        backoff.use_rng(random.Random(3))
+        a = jittered(10.0)
+        backoff.use_rng(random.Random(3))
+        assert jittered(10.0) == a
+
+
+class TestConsumersShareTheCopy:
+    """The three consolidated loops must actually draw through this
+    module (three copies meant three clocks to stub)."""
+
+    def test_controller_and_publisher_and_table_use_shared_backoff(self):
+        import inspect
+
+        from oim_tpu.common.telemetry import RegistryRowPublisher
+        from oim_tpu.controller.controller import Controller
+        from oim_tpu.feeder.driver import Feeder
+        from oim_tpu.router.table import ReplicaTable
+
+        for obj, needle in [
+            (Controller.start, "ExponentialBackoff"),
+            (RegistryRowPublisher.start, "ExponentialBackoff"),
+            (ReplicaTable.start, "backoff.next"),
+            (Feeder._publish_remote, "DecorrelatedJitter"),
+        ]:
+            src = inspect.getsource(obj)
+            assert needle in src, f"{obj} no longer uses common/backoff"

@@ -1,0 +1,441 @@
+"""Ring-1 tests for the chaos substrate: the faultinject fixes and the
+new serving-tier fault points (each proven both as a no-op when unarmed
+and as the documented failure when armed), plus the ladder's slow rungs.
+
+The fast ladder rungs themselves run in tier-1 via
+tests/test_chaos_smoke.py; here the individual levers are pulled in
+isolation so a broken fault point is attributable without reading a
+whole rung."""
+
+import threading
+
+import grpc
+import numpy as np
+import pytest
+
+from oim_tpu.common import events, faultinject
+from oim_tpu.common.channelpool import ChannelPool
+from oim_tpu.chaos.sim import wait_for
+from oim_tpu.chaos import sim
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+@pytest.fixture(scope="module")
+def model():
+    return sim.model()
+
+
+# ---------------------------------------------------------------------------
+# faultinject: per-fire instantiation + the armable transport fault.
+
+
+class TestPerFireInstantiation:
+    def test_shared_instance_with_times_gt_1_is_reinstantiated(self):
+        armed = ValueError("boom", 42)
+        faultinject.arm("p", exc=armed, times=3)
+        raised = []
+        for _ in range(3):
+            try:
+                faultinject.fire("p")
+            except ValueError as err:
+                raised.append(err)
+        assert len(raised) == 3
+        assert all(e is not armed for e in raised), \
+            "times>1 must not raise one shared instance repeatedly"
+        assert all(e.args == ("boom", 42) for e in raised)
+
+    def test_times_1_keeps_the_exact_object(self):
+        armed = ValueError("exact")
+        faultinject.arm("p", exc=armed, times=1)
+        with pytest.raises(ValueError) as err:
+            faultinject.fire("p")
+        assert err.value is armed
+
+    def test_default_exc_is_per_fire_too(self):
+        faultinject.arm("p", times=2)
+        errs = []
+        for _ in range(2):
+            try:
+                faultinject.fire("p")
+            except faultinject.InjectedFault as e:
+                errs.append(e)
+        assert errs[0] is not errs[1]
+
+    def test_unreconstructable_falls_back_to_shared(self):
+        class Weird(Exception):
+            def __init__(self):
+                super().__init__("weird")
+                self.args = ("weird", "extra")  # ctor takes no args
+
+        armed = Weird()
+        faultinject.arm("p", exc=armed, times=2)
+        with pytest.raises(Weird) as err:
+            faultinject.fire("p")
+        assert err.value is armed  # fallback, not a crash
+
+    def test_concurrent_fires_get_distinct_tracebacks(self):
+        """The bug this guards: a shared BaseException instance raised
+        from N threads concurrently mutates __traceback__ under every
+        raiser at once."""
+        faultinject.arm("p", exc=RuntimeError("shared"), times=None)
+        seen = []
+        lock = threading.Lock()
+
+        def raiser():
+            try:
+                faultinject.fire("p")
+            except RuntimeError as err:
+                with lock:
+                    seen.append(err)
+
+        threads = [threading.Thread(target=raiser) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(seen) == 8
+        assert len({id(e) for e in seen}) == 8, \
+            "concurrent fires shared one exception instance"
+
+    def test_injected_rpc_error_evicts_like_the_wire(self):
+        err = faultinject.InjectedRpcError(grpc.StatusCode.UNAVAILABLE)
+        assert err.code() is grpc.StatusCode.UNAVAILABLE
+        pool = ChannelPool(dial=lambda *a: DummyChannel())
+        pool.get("target:1")
+        assert pool.maybe_evict(err, "target:1") is True
+        # Reconstruction from args preserves the status code.
+        clone = type(err)(*err.args)
+        assert clone.code() is grpc.StatusCode.UNAVAILABLE
+
+
+class DummyChannel:
+    def close(self):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Serving-tier fault points, pulled in isolation.
+
+
+class TestServeFaultPoints:
+    def test_serve_admit_maps_armed_queuefull_to_refusal(self, model):
+        from oim_tpu.serve import ServeEngine
+        from oim_tpu.serve.engine import QueueFull
+
+        params, cfg = model
+        engine = ServeEngine(params, cfg, max_batch=2, max_seq=64,
+                             queue_depth=8, name="adm")
+        try:
+            from oim_tpu.common import metrics as M
+
+            rejected = M.SERVE_REQUESTS_TOTAL.labels(
+                outcome="rejected").value
+            faultinject.arm("serve.admit", exc=QueueFull("injected"),
+                            times=1, engine="adm")
+            with pytest.raises(QueueFull):
+                engine.submit([1, 2, 3], max_new=2)
+            # Metric-faithful: a simulated refusal is indistinguishable
+            # from a real one in /metrics.
+            assert M.SERVE_REQUESTS_TOTAL.labels(
+                outcome="rejected").value == rejected + 1
+            # One-shot: the next admission is clean and byte-identical
+            # machinery takes over untouched.
+            assert engine.submit([1, 2, 3], max_new=2).result(
+                timeout=300)
+        finally:
+            engine.stop(drain=False, timeout=30)
+
+    def test_engine_name_scopes_the_fault(self, model):
+        """ctx matching on engine= is what lets a multi-replica process
+        (the sim) fault ONE replica."""
+        from oim_tpu.serve import ServeEngine
+        from oim_tpu.serve.engine import QueueFull
+
+        params, cfg = model
+        a = ServeEngine(params, cfg, max_batch=2, max_seq=64,
+                        queue_depth=8, name="a")
+        b = ServeEngine(params, cfg, max_batch=2, max_seq=64,
+                        queue_depth=8, name="b")
+        try:
+            faultinject.arm("serve.admit", exc=QueueFull("injected"),
+                            engine="a")
+            with pytest.raises(QueueFull):
+                a.submit([1, 2], max_new=2)
+            assert b.submit([1, 2], max_new=2).result(timeout=300)
+        finally:
+            a.stop(drain=False, timeout=30)
+            b.stop(drain=False, timeout=30)
+
+    def test_serve_retire_crash_leaks_no_pages(self, model):
+        """A crash AT retirement (before any page returns) is the
+        hardest leak spot: the engine's failure teardown must still
+        zero the pool and fail every request loudly."""
+        from oim_tpu.serve import ServeEngine
+
+        params, cfg = model
+        engine = ServeEngine(params, cfg, max_batch=2, max_seq=64,
+                             queue_depth=8, name="ret")
+        try:
+            faultinject.arm("serve.retire", times=1, engine="ret")
+            handle = engine.submit([1, 2, 3], max_new=3)
+            handle.result(timeout=300)
+            assert handle.finish_reason == "error"
+            assert wait_for(
+                lambda: engine.pool_stats()["used_pages"] == 0)
+            # The wedged engine admits nothing new.
+            from oim_tpu.serve.engine import Draining
+
+            assert wait_for(lambda: engine._stopping)
+            with pytest.raises(Draining):
+                engine.submit([4, 5], max_new=2)
+        finally:
+            engine.stop(drain=False, timeout=30)
+
+    def test_serve_decode_wedges_and_fails_loudly(self, model):
+        from oim_tpu.serve import ServeEngine
+
+        params, cfg = model
+        engine = ServeEngine(params, cfg, max_batch=2, max_seq=64,
+                             queue_depth=8, name="dec")
+        try:
+            faultinject.arm("serve.decode", times=1, engine="dec")
+            handle = engine.submit([1, 2, 3], max_new=4)
+            handle.result(timeout=300)
+            assert handle.finish_reason == "error"
+            assert engine.pool_stats()["used_pages"] == 0
+        finally:
+            engine.stop(drain=False, timeout=30)
+
+
+class TestRouterFaultPoints:
+    def test_router_stream_injected_unavailable_takes_retry_path(self):
+        """An armed InjectedRpcError at router.stream exercises the
+        pre-first-token retry contract with no process to kill: the
+        faulted replica is marked failed, the retry lands on the peer,
+        the client sees nothing."""
+        from oim_tpu.router.router import RouterService
+        from oim_tpu.router.table import Replica
+
+        class _Table:
+            def __init__(self):
+                self.failed = []
+                self.rows = [
+                    Replica("ra", "127.0.0.1:1", free_slots=9),
+                    Replica("rb", "127.0.0.1:2", free_slots=1),
+                ]
+
+            def replicas(self):
+                return [r for r in self.rows
+                        if r.replica_id not in self.failed]
+
+            def mark_failed(self, rid):
+                self.failed.append(rid)
+                events.emit(events.ROUTER_MARK_FAILED, replica=rid,
+                            routable=len(self.replicas()))
+
+        table = _Table()
+        service = RouterService(table, affinity=False)
+        # ra scores best; the armed fault fails its stream open.
+        faultinject.arm(
+            "router.stream",
+            exc=faultinject.InjectedRpcError(
+                grpc.StatusCode.UNAVAILABLE, "blackhole"),
+            times=1, replica="ra")
+        picked = service.pick()
+        assert picked.replica_id == "ra"
+        attempts = list(service._one_attempt(picked, b"", None, None))
+        assert len(attempts) == 1
+        kind, err = attempts[0]
+        assert kind == "err"
+        assert err.code() is grpc.StatusCode.UNAVAILABLE
+
+    def test_router_pick_point_unarmed_is_noop_armed_raises(self):
+        from oim_tpu.router.router import RouterService
+
+        class _Empty:
+            def replicas(self):
+                return []
+
+        service = RouterService(_Empty(), affinity=False)
+        assert service.pick() is None  # unarmed: plain behavior
+        faultinject.arm("router.pick", times=1)
+        with pytest.raises(faultinject.InjectedFault):
+            service.pick()
+
+
+class TestRegistryPromoteFaultPoint:
+    def test_watchdog_retries_a_lost_promotion(self):
+        """registry.promote armed with times=N delays convergence by N
+        watchdog ticks — the promotion still happens, deterministically
+        later."""
+        from oim_tpu.registry import MemRegistryDB, RegistryService
+        from oim_tpu.registry.registry import registry_server
+        from oim_tpu.registry.replication import (
+            PRIMARY,
+            STANDBY,
+            ReplicationManager,
+        )
+
+        p_svc = RegistryService(db=MemRegistryDB())
+        p_srv = registry_server("tcp://localhost:0", p_svc)
+        s_svc = RegistryService(db=MemRegistryDB())
+        s_srv = registry_server("tcp://localhost:0", s_svc)
+        p_mgr = ReplicationManager(
+            p_svc, peer=s_srv.addr, role=PRIMARY,
+            primary_lease_seconds=0.3, boot_grace_seconds=5.0)
+        s_mgr = ReplicationManager(
+            s_svc, peer=p_srv.addr, role=STANDBY,
+            primary_lease_seconds=0.3, boot_grace_seconds=5.0)
+        try:
+            p_mgr.start(initial_probe=False)
+            s_mgr.start(initial_probe=False)
+            assert wait_for(s_mgr._may_auto_promote, timeout=15)
+            faultinject.arm("registry.promote", times=2, role=STANDBY)
+            p_mgr.stop()
+            p_srv.force_stop()
+            assert wait_for(lambda: s_mgr.role == PRIMARY, timeout=15), \
+                "promotion never converged past the injected losses"
+            assert faultinject.fired("registry.promote") == 2
+        finally:
+            for mgr in (p_mgr, s_mgr):
+                try:
+                    mgr.stop()
+                except Exception:  # noqa: BLE001 - teardown
+                    pass
+            for srv in (p_srv, s_srv):
+                srv.force_stop()
+
+
+class TestPrestageFaultPoint:
+    def test_injected_fanout_failure_never_fails_the_publish(self,
+                                                             tmp_path):
+        from oim_tpu.feeder import Feeder
+        from oim_tpu.registry import MemRegistryDB, RegistryService
+        from oim_tpu.registry.registry import registry_server
+        from oim_tpu.controller.controller import (
+            ControllerService,
+            controller_server,
+        )
+        from oim_tpu.controller.malloc_backend import MallocBackend
+        from oim_tpu.spec import pb
+
+        db = MemRegistryDB()
+        registry = registry_server("tcp://localhost:0",
+                                   RegistryService(db=db))
+        servers = []
+        try:
+            for i in range(2):
+                svc = ControllerService(MallocBackend())
+                servers.append(controller_server("tcp://localhost:0", svc))
+                db.set(f"host-{i}/address", servers[i].addr)
+                db.set(f"host-{i}/mesh", "0,0,0")
+            data = np.random.RandomState(5).bytes(10_000)
+            path = tmp_path / "v.bin"
+            path.write_bytes(data)
+            feeder = Feeder(registry_address=registry.addr,
+                            controller_id="host-0")
+            request = pb.MapVolumeRequest(
+                volume_id="v",
+                file=pb.FileParams(path=str(path), format="raw"))
+            faultinject.arm("prestage.fanout", volume="v")
+            pub = feeder.publish(request, timeout=30)
+            assert pub.bytes == len(data)
+            # The armed fault is absorbed, not propagated.
+            assert feeder.prestage_replica(request) is None
+            assert faultinject.fired("prestage.fanout") >= 1
+            # An injected TRANSPORT-class fault absorbs too — and must
+            # not evict the healthy pooled registry channel (it never
+            # touched the wire): the dial census is unchanged across
+            # the fault AND the next clean fan-out.
+            dials_before = dict(feeder._pool.stats())
+            faultinject.arm("prestage.fanout",
+                            exc=faultinject.InjectedRpcError(),
+                            times=1, volume="v")
+            assert feeder.prestage_replica(request) is None
+            assert feeder.prestage_replica(request) == "host-1"
+            assert dict(feeder._pool.stats()) == dials_before
+        finally:
+            for s in servers:
+                s.force_stop()
+            registry.force_stop()
+
+
+# ---------------------------------------------------------------------------
+# The ladder's slow rungs (the full ladder is `make chaos`; tier-1 runs
+# the trimmed variant via tests/test_chaos_smoke.py).
+
+
+@pytest.mark.slow
+class TestSlowRungs:
+    def test_compound_rung_converges(self):
+        from oim_tpu import chaos
+
+        report = chaos.run_ladder(names=["compound"])
+        [rung] = report["rungs"]
+        assert rung["healed"] == [
+            events.REGISTRY_PROMOTION, events.REPLICA_DRAIN,
+            events.ROUTER_MARK_FAILED, events.ROUTER_RETRY]
+        assert rung["details"]["survivor_served"] > 0
+
+    def test_restart_after_kill_rejoins_and_serves(self):
+        """The remaining per-replica fault lever: ``restart()`` boots a
+        fresh replica process at the same id (new engine, empty caches,
+        same address). It must rebind the force-stopped listener's
+        port, re-publish a CHANGED row that clears the router's
+        failure mark, and serve byte-identical output."""
+        import random
+        import time
+
+        from oim_tpu.chaos.ladder import _reqs
+
+        with sim.ClusterSim(replicas=2) as s:
+            s.warm()
+            r1 = s.replicas[1]
+            r1.kill()
+            reqs = _reqs(random.Random("restart"), 4)
+            results, errors = s.routed_load(reqs)
+            assert not errors, f"client saw errors across the kill: " \
+                               f"{errors[0]!r}"
+            s.assert_byte_identity(reqs, results)
+
+            r1.restart()
+            assert wait_for(
+                lambda: any(r.replica_id == "r1"
+                            for r in s.table.replicas()),
+                timeout=10), "restarted replica never re-entered the table"
+            served_before = r1.completed()
+            deadline = time.monotonic() + 30
+            while r1.completed() == served_before:
+                assert time.monotonic() < deadline, \
+                    "no request reached the restarted replica"
+                more = _reqs(random.Random("restart-2"), 2)
+                results, errors = s.routed_load(more)
+                assert not errors
+                s.assert_byte_identity(more, results)
+
+    def test_ladder_converges_across_seeds(self):
+        """Same-seed signature equality is pinned INSIDE run_ladder
+        (observed heal events must equal the rung's declared signature
+        or it raises), so comparing two same-seed runs proves nothing.
+        What that assertion cannot pin: that convergence isn't one
+        lucky workload. Different seeds drive genuinely different
+        request batches through the rung and must still converge."""
+        import random
+
+        from oim_tpu import chaos
+        from oim_tpu.chaos.ladder import _reqs
+
+        # The seed is threaded into the workload, not ignored: the
+        # rung's request stream differs between seeds.
+        assert (_reqs(random.Random("7:registry_promotion"), 8)
+                != _reqs(random.Random("11:registry_promotion"), 8))
+        # ...and the heal path converges under both workloads (each
+        # call asserts its observed signature internally).
+        chaos.run_ladder(seed=7, names=["registry_promotion"])
+        chaos.run_ladder(seed=11, names=["registry_promotion"])

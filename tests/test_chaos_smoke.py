@@ -1,0 +1,33 @@
+"""Tier-1 wiring of `make chaos-smoke`: the trimmed chaos ladder runs
+inside the normal (non-slow) test pass — the three fast serving-tier
+rungs (replica SIGKILL -> retry-before-first-token, black-holed channel
+-> pool eviction + redial, page-pool exhaustion -> backpressure-not-
+OOM), each converging on its declared /debug/events heal signature with
+zero client-visible errors, byte-identical routed outputs, and a
+zero-leak census (bench.chaos_smoke() itself raises on any divergence).
+The compound rung and the rest of the ladder run under `make chaos` /
+`pytest -m slow` (tests/test_chaos.py)."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def test_chaos_smoke_rungs_converge_and_fault_points_are_free():
+    import bench
+
+    extras = bench.chaos_smoke()  # raises AssertionError on divergence
+    assert extras["chaos_rung_names"] == [
+        "replica_kill", "channel_blackhole", "pool_exhaustion"]
+    assert extras["chaos_event_signature"] == [
+        ["replica_kill", "router_mark_failed", "router_retry"],
+        ["channel_blackhole", "router_mark_failed", "router_retry"],
+        ["pool_exhaustion", "page_pool_exhausted"],
+    ]
+    for rung in extras["chaos_report"]:
+        assert rung["census"]["replicas"], rung  # census actually ran
+    # The unarmed-fault-point overhead gate (>= 0.90, the
+    # obs_overhead_ratio stance) is enforced inside bench.chaos_ladder
+    # itself; here we only pin that the smoke recorded it.
+    assert "fault_overhead_ratio" in extras, extras

@@ -293,6 +293,47 @@ class TestMockOIM:
         assert len({id(r) for r in results}) == 1  # all saw the same publish
 
 
+class TestWindowPumpHygiene:
+    """_read_window's pump thread: a consumer-side failure (malformed
+    chunk) must abandon the pump AND cancel the RPC — not park the pump
+    forever on its bounded queue with the server-side stream open."""
+
+    def test_malformed_chunk_abandons_pump_and_cancels(self):
+        import time
+
+        class _MalformedController(ControllerService):
+            def ReadVolume(self, request, context):
+                # total_bytes says 10 but the first chunk carries 100:
+                # the consumer's window copy raises. Keep streaming so
+                # an unabandoned pump would fill the bounded queue and
+                # park forever.
+                yield pb.ReadVolumeChunk(total_bytes=10, offset=0,
+                                         data=b"x" * 100)
+                while context.is_active():
+                    yield pb.ReadVolumeChunk(offset=0, data=b"y")
+
+        db = MemRegistryDB()
+        registry = registry_server(
+            "tcp://localhost:0", RegistryService(db=db))
+        ctrl = controller_server(
+            "tcp://localhost:0", _MalformedController(MallocBackend()))
+        db.set("host-0/address", ctrl.addr)
+        try:
+            feeder = Feeder(registry_address=registry.addr,
+                            controller_id="host-0")
+            with pytest.raises(ValueError):
+                feeder.fetch_window("vol-m", 0, 0)
+            deadline = time.monotonic() + 10
+            while any(t.name == "oim-window-pump" and t.is_alive()
+                      for t in threading.enumerate()):
+                assert time.monotonic() < deadline, \
+                    "pump thread leaked after a consumer-side error"
+                time.sleep(0.05)
+        finally:
+            ctrl.force_stop()
+            registry.force_stop()
+
+
 class TestEmulation:
     def test_ceph_csi_translation(self):
         req = map_volume_params(

@@ -283,6 +283,59 @@ class TestReplicaTable:
         assert table.replicas() == []
         pool.close()
 
+    def test_stale_mode_emits_flight_recorder_event(self, registry):
+        """Entering --max-stale UNAVAILABLE mode used to be invisible in
+        /debug/events: a router refusing every pick must leave a
+        router_table_stale incident (once per episode, not per pick),
+        and the first successful refresh after it must leave the
+        recovery twin."""
+        from oim_tpu.common import events
+
+        server, stub = registry
+        self._set(stub, "a")
+        addr = server.addr
+        pool = ChannelPool()
+        table = ReplicaTable(addr, interval=30.0, max_stale=0.2,
+                             pool=pool)
+        table.refresh()
+        server.force_stop()
+        stale_before = len(events.recorder().events(
+            type_=events.ROUTER_TABLE_STALE))
+        rec_before = len(events.recorder().events(
+            type_=events.ROUTER_TABLE_RECOVERED))
+        time.sleep(0.3)
+        assert table.replicas() == []
+        assert table.replicas() == []  # second pick: same episode
+        stale_events = events.recorder().events(
+            type_=events.ROUTER_TABLE_STALE)
+        assert len(stale_events) == stale_before + 1, \
+            "stale mode must emit exactly one event per episode"
+        assert stale_events[-1].attrs["max_stale_s"] == 0.2
+        assert stale_events[-1].attrs["age_s"] > 0.2
+        # The registry returns at the same address: the next successful
+        # refresh ends the episode with the recovery twin. Retry like
+        # the poll loop does — the pooled channel may fast-fail
+        # UNAVAILABLE (no wait-for-ready) before it redials the revived
+        # listener; maybe_evict drops it so the next attempt succeeds.
+        revived = registry_server(
+            f"tcp://{addr}", RegistryService(db=MemRegistryDB()))
+        try:
+            deadline = time.monotonic() + 10
+            while True:
+                try:
+                    table.refresh()
+                    break
+                except grpc.RpcError:
+                    assert time.monotonic() < deadline, \
+                        "revived registry never became reachable"
+                    time.sleep(0.05)
+        finally:
+            revived.force_stop()
+        recovered = events.recorder().events(
+            type_=events.ROUTER_TABLE_RECOVERED)
+        assert len(recovered) == rec_before + 1
+        pool.close()
+
     def test_background_poll_picks_up_new_replicas(self, registry):
         server, stub = registry
         table = ReplicaTable(server.addr, interval=0.05, pool=ChannelPool())

@@ -423,6 +423,59 @@ class TestSpecEngine:
         finally:
             eng.stop(drain=False, timeout=30)
 
+    def test_draft_alloc_failure_races_valve_close_same_request(
+            self, model, draft_model):
+        """The compound case PR 12 never covered: request X's draft
+        allocation fails (the new spec.propose fault point — X demotes
+        to plain decode at admission) while its batch-mate A's low
+        acceptance CLOSES the valve mid-flight. The fallback's draft
+        release must skip X (it holds no draft pages), both streams
+        stay byte-identical, and neither pool leaks."""
+        from oim_tpu.common import faultinject
+
+        params, cfg = model
+        dparams, dcfg = draft_model
+        eng = ServeEngine(params, cfg, max_batch=2, max_seq=64,
+                          queue_depth=8, draft_params=dparams,
+                          draft_cfg=dcfg, spec_tokens=4,
+                          spec_accept_floor=0.999,
+                          spec_window_rounds=6,
+                          spec_reprobe_rounds=10_000, name="race")
+        try:
+            # X goes FIRST, with the fault pre-armed: the very first
+            # spec.propose call is X's admission, which consumes the
+            # times=1 fault deterministically — no window in which A's
+            # rounds can close the valve and short-circuit
+            # _map_draft_slot before the fault point is reached.
+            faultinject.arm("spec.propose", times=1, engine="race")
+            h_x = eng.submit([5, 9, 2], max_new=12, seed=9)
+            assert wait_for(
+                lambda: faultinject.fired("spec.propose") == 1)
+            # A admits after the fault is exhausted, takes the draft
+            # slot, and its collapsing acceptance closes the valve
+            # while demoted-X is still a plain row in the batch.
+            h_a = eng.submit([3, 1, 4], max_new=28, seed=0)
+            assert wait_for(
+                lambda: eng.spec_stats()["draft_used_pages"] > 0)
+            got_a = h_a.result(timeout=300)
+            got_x = h_x.result(timeout=300)
+            assert faultinject.fired("spec.propose") == 1, \
+                "the draft-alloc fault never hit the admission"
+            assert got_a == solo_tokens(params, cfg, [3, 1, 4], 28,
+                                        seed=0)
+            assert got_x == solo_tokens(params, cfg, [5, 9, 2], 12,
+                                        seed=9)
+            # The race actually happened: the valve closed while X (a
+            # plain row by injected alloc failure) was in the batch.
+            assert eng.stats()["spec_fallbacks"] >= 1
+            assert eng.spec_stats()["spec_on"] is False
+            assert eng.spec_stats()["draft_used_pages"] == 0
+            assert eng.pool_stats()["used_pages"] == \
+                eng.prefix_stats()["entries"]
+        finally:
+            faultinject.disarm("spec.propose")
+            eng.stop(drain=False, timeout=30)
+
     def test_eos_mid_round_truncates_like_solo(self, model,
                                                spec_engine):
         """A verify round can emit several tokens at once; the engine
