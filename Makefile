@@ -9,7 +9,7 @@
 PY ?= python
 RUFF := $(shell command -v ruff 2>/dev/null)
 
-.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke prefix-smoke paged-smoke spec-smoke chaos chaos-smoke
+.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke prefix-smoke paged-smoke spec-smoke chaos chaos-smoke quorum-smoke control-plane-bench
 
 # drift and tsan are standalone conveniences; the full pytest target
 # already runs both (SpecDrift + the TSAN stream test build in-fixture).
@@ -122,12 +122,31 @@ obs-smoke:
 chaos:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --chaos
 
-# The trimmed 3-rung tier-1 variant (seconds): the fast serving-tier
-# rungs only, plus the fault_overhead_ratio guard that every fault
-# point is free when unarmed. Also runs in tier-1 as
-# tests/test_chaos_smoke.py.
+# The trimmed tier-1 variant (seconds): the fast serving-tier rungs
+# plus the serve-free quorum rungs (symmetric partition -> minority
+# step-down + split-brain census 0; rolling restart -> writes resume
+# per hop, one Watch stream survives), plus the fault_overhead_ratio
+# guard that every fault point is free when unarmed. Also runs in
+# tier-1 as tests/test_chaos_smoke.py.
 chaos-smoke:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --chaos --smoke
+
+# Quorum-registry acceptance loop (seconds): 3 in-process members
+# elect a leader, a quorum-committed write is readable on a follower
+# and refused BY a follower, the leader is SIGKILLed and writes resume
+# on the survivors' new leader with zero human intervention, and a
+# Watch stream opened before the kill survives it (re-targets, resume
+# token honored or snapshot-resynced, no missed rows). Also runs in
+# tier-1 as tests/test_quorum_smoke.py.
+quorum-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_quorum_smoke.py -q
+
+# Control-plane load columns (seconds): GetValues QPS at 1k simulated
+# publishers measured poll-mode vs watch-mode on the same in-process
+# registry (gated >= 10x drop), plus a full-fleet lease-renewal sweep
+# re-publish vs batched Heartbeat.
+control-plane-bench:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --control-plane
 
 demo:
 	bash scripts/demo_cluster.sh demo

@@ -113,6 +113,14 @@ def main(argv=None) -> int:
                         help="with --chaos: the ladder's deterministic "
                              "seed (same seed -> same heal-event "
                              "sequence)")
+    parser.add_argument("--control-plane", action="store_true",
+                        help="control-plane load columns: GetValues "
+                             "QPS at 1k simulated publishers measured "
+                             "poll-mode vs watch-mode on the same "
+                             "in-process registry (the Watch-stream "
+                             "win), plus a full-fleet lease-renewal "
+                             "sweep as value re-publish vs batched "
+                             "Heartbeat")
     parser.add_argument("--obs-smoke", action="store_true",
                         help="observability-plane acceptance run: one "
                              "trace_id traced from a /metrics exemplar "
@@ -126,6 +134,16 @@ def main(argv=None) -> int:
     if args.obs_smoke:
         print(json.dumps({"metric": "obs_smoke", "value": 1,
                           "unit": "ok", "extras": obs_smoke()}))
+        return 0
+
+    if args.control_plane:
+        extras = control_plane_bench()
+        print(json.dumps({
+            "metric": "getvalues_drop_x",
+            "value": extras["getvalues_drop_x"],
+            "unit": "x",
+            "extras": extras,
+        }))
         return 0
 
     if args.chaos:
@@ -2078,6 +2096,123 @@ def chaos_smoke(seed=None) -> dict:
 
     return chaos_ladder(seed, include_slow=False,
                         names=chaos.SMOKE_RUNGS)
+
+
+def control_plane_bench(publishers: int = 1000, consumers: int = 6,
+                        window_s: float = 2.0,
+                        poll_interval: float = 0.25) -> dict:
+    """Control-plane load at 1k simulated publishers: the ROADMAP item
+    3 before/after. One in-process registry holds ``publishers``
+    serve/<id> rows; ``consumers`` replica tables read them poll-mode
+    (GetValues every ``poll_interval``) vs watch-mode (one Watch stream
+    each, the poll idling) over the same ``window_s`` wall window, with
+    the registry's own ``oim_registry_getvalues_total`` counter as the
+    meter. Lease churn: a full-fleet renewal sweep as value re-publish
+    (one SetValue per row — the pre-batch behavior) vs batched
+    Heartbeats at 2 rows per daemon (serve + telemetry shape). The
+    acceptance bar: GetValues QPS drops >= 10x in watch-mode."""
+    import json as _json
+
+    from oim_tpu.common import metrics as M, tlsutil
+    from oim_tpu.registry import MemRegistryDB, RegistryService
+    from oim_tpu.registry.registry import registry_server
+    from oim_tpu.router.table import ReplicaTable
+    from oim_tpu.spec import RegistryStub, pb
+
+    service = RegistryService(db=MemRegistryDB())
+    server = registry_server("tcp://127.0.0.1:0", service)
+    channel = tlsutil.dial(server.addr, None)
+    stub = RegistryStub(channel)
+
+    def row(i: int, beat: int) -> str:
+        return _json.dumps({
+            "beat": beat, "endpoint": f"10.0.{i // 250}.{i % 250}:9000",
+            "free_slots": 1, "max_batch": 2, "queue_depth": 0,
+            "ready": True}, sort_keys=True)
+
+    lease_s = 600.0
+    t0 = time.monotonic()
+    for i in range(publishers):
+        stub.SetValue(pb.SetValueRequest(value=pb.Value(
+            path=f"serve/sim-{i}", value=row(i, 1),
+            lease_seconds=lease_s)), timeout=30)
+    publish_wall = time.monotonic() - t0
+
+    def read_load(watch_mode: bool) -> dict:
+        tables = [ReplicaTable(server.addr, interval=poll_interval,
+                               watch=watch_mode)
+                  for _ in range(consumers)]
+        for table in tables:
+            table.start()
+        # Settle: every consumer holds the complete view — and in
+        # watch-mode, a SYNCED stream — before the measured window
+        # opens (snapshot/warm-up reads must not count).
+        deadline = time.monotonic() + 60
+        while any(len(t.replicas()) < publishers for t in tables) \
+                or (watch_mode
+                    and not all(t._watch_live() for t in tables)):
+            if time.monotonic() > deadline:
+                raise AssertionError("consumer tables never synced")
+            time.sleep(0.05)
+        before = M.REGISTRY_GETVALUES.value
+        time.sleep(window_s)
+        reads = M.REGISTRY_GETVALUES.value - before
+        complete = all(len(t.replicas()) == publishers for t in tables)
+        for table in tables:
+            table.stop()
+        return {"getvalues": reads, "qps": reads / window_s,
+                "view_complete": complete}
+
+    poll = read_load(watch_mode=False)
+    watch = read_load(watch_mode=True)
+    assert poll["view_complete"] and watch["view_complete"], \
+        "a consumer lost its view mid-window"
+
+    # Lease churn: one full-fleet renewal sweep, both disciplines.
+    t0 = time.monotonic()
+    for i in range(publishers):
+        stub.SetValue(pb.SetValueRequest(value=pb.Value(
+            path=f"serve/sim-{i}", value=row(i, 2),
+            lease_seconds=lease_s)), timeout=30)
+    republish_wall = time.monotonic() - t0
+    t0 = time.monotonic()
+    batch = 2  # rows per daemon: its serve/<id> + telemetry/<id> shape
+    for start in range(0, publishers, batch):
+        keys = [f"serve/sim-{i}"
+                for i in range(start, min(start + batch, publishers))]
+        reply = stub.Heartbeat(pb.HeartbeatRequest(
+            keys=keys, lease_seconds=lease_s), timeout=30)
+        assert list(reply.keys_known) == [True] * len(keys), \
+            f"batch renewal lost rows: {keys}"
+    batch_wall = time.monotonic() - t0
+
+    channel.close()
+    server.force_stop()
+    drop = poll["qps"] / max(watch["qps"], 1.0 / window_s)
+    # The ROADMAP item 3 acceptance bar, enforced where it is measured:
+    # watch-mode must take at least 10x the GetValues read load off the
+    # registry at 1k publishers.
+    if drop < 10.0:
+        raise AssertionError(
+            f"watch-mode GetValues drop only {drop:.1f}x "
+            f"(poll {poll['qps']:.1f}/s vs watch {watch['qps']:.1f}/s); "
+            f"the Watch stream is not carrying the consumers")
+    return {
+        "control_publishers": publishers,
+        "control_consumers": consumers,
+        "control_window_s": window_s,
+        "control_poll_interval_s": poll_interval,
+        "control_publish_wall_s": round(publish_wall, 3),
+        "poll_getvalues_qps": round(poll["qps"], 2),
+        "watch_getvalues_qps": round(watch["qps"], 2),
+        "getvalues_drop_x": round(drop, 1),
+        "lease_sweep_republish_s": round(republish_wall, 3),
+        "lease_sweep_batch_s": round(batch_wall, 3),
+        "lease_renews_per_s_republish":
+            round(publishers / republish_wall, 1),
+        "lease_renews_per_s_batch": round(publishers / batch_wall, 1),
+        "lease_batch_speedup_x": round(republish_wall / batch_wall, 2),
+    }
 
 
 def obs_overhead(params, cfg, rounds: int = 8, n_requests: int = 48,

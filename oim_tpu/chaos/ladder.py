@@ -30,6 +30,16 @@ pool_exhaustion     long-prompt burst > page pool      backpressure, not
                                                        OOM or error
 registry_promotion  SIGKILL the PRIMARY registry       standby auto-
                                                        promotion
+quorum_leader_kill  SIGKILL the quorum LEADER under    majority election,
+                    routed serve load                  writes resume,
+                                                       zero human steps
+quorum_partition    symmetric partition of the 3-node  minority steps
+                    quorum, leader in the minority     down + rejects;
+                                                       majority elects;
+                                                       split-brain = 0
+registry_rolling_   restart every member, leader       writes resume per
+restart             last                               hop; ONE Watch
+                                                       stream survives
 feeder_failover     SIGKILL the pinned controller      feeder failover +
                                                        warm cache hit
 draft_collapse      a draft that stops predicting      valve fallback,
@@ -353,6 +363,147 @@ def _run_compound(sim: ClusterSim, rng: random.Random) -> dict:
             "signature": signature}
 
 
+def _run_quorum_leader_kill(sim: ClusterSim, rng: random.Random) -> dict:
+    """SIGKILL the quorum LEADER under live routed serve load: the
+    surviving majority elects with ZERO human intervention, writes
+    resume through the endpoint list, and the client contract holds —
+    zero visible errors, byte-identical outputs (the serve data path
+    and the table's cached/pushed view never depended on the corpse)."""
+    sim.warm()
+    reqs = _reqs(rng, 10)
+    results, errors = sim.routed_load(reqs[:2])
+    assert not errors, f"pre-fault load failed: {errors[0]!r}"
+    assert sim.registry_write("chaos/pre-kill", "1"), \
+        "pre-fault write failed"
+    mark = sim.mark_faults()
+    sim.kill_registry_leader()
+    # Load straight THROUGH the leaderless window: zero client errors
+    # promised — routing never touches the registry on the data path.
+    results, errors = sim.routed_load(reqs[2:])
+    assert not errors, \
+        f"client saw errors across the leader kill: {errors[0]!r}"
+    checked = sim.assert_byte_identity(reqs[2:], results)
+    healed = sim.wait_heal(
+        [events.REGISTRY_ELECTION, events.REGISTRY_PROMOTION], mark)
+    # Writes resume with no human in the loop.
+    assert wait_for(lambda: sim.registry_write("chaos/post-kill", "1"),
+                    timeout=15), "writes never resumed post-election"
+    # The routing view converges on the survivors' registry.
+    assert wait_for(lambda: len(sim.table) == sim.n_replicas,
+                    timeout=15), \
+        "replica rows never converged on the new leader"
+    promo = [e for e in sim.debug_events(events.REGISTRY_PROMOTION)
+             if e["seq"] > mark]
+    return {"requests": len(reqs), "byte_identical": checked,
+            "election_term": promo[-1]["attrs"]["epoch"],
+            "signature": healed}
+
+
+def _run_quorum_partition(sim: ClusterSim, rng: random.Random) -> dict:
+    """Symmetric partition, the PR 2 pair's unsolvable case: the
+    minority-side leader steps down and REJECTS writes, the majority
+    elects, and heal re-syncs by snapshot — with the split-brain write
+    census pinned at 0 (no key acknowledged on both sides, ever)."""
+    import grpc
+
+    from oim_tpu.spec import RegistryStub, pb
+
+    assert sim.registry_write("chaos/pre-partition", "1")
+    watcher = sim.registry_watcher("chaos")
+    assert wait_for(lambda: watcher.get("chaos/pre-partition") == "1",
+                    timeout=10), "watch stream never synced"
+    leader = sim.registry_leader()
+    assert leader is not None
+    old_mgr = leader[2]
+    mark = sim.mark_faults()
+    sim.partition_registry([old_mgr.node_id])
+
+    # The majority elects first (step-down grace > election window)...
+    sim.wait_heal([events.REGISTRY_ELECTION, events.REGISTRY_PROMOTION],
+                  mark, timeout=20)
+    # ...then the minority leader notices majority silence and demotes.
+    sim.wait_heal([events.REGISTRY_STEPDOWN], mark, timeout=20)
+
+    # Split-brain write census: distinct keys offered to both sides.
+    acked_minority, acked_majority = set(), set()
+    minority_stub = RegistryStub(sim.pool.get(
+        leader[1].addr, None, "component.registry"))
+    try:
+        minority_stub.SetValue(pb.SetValueRequest(value=pb.Value(
+            path="chaos/split-minority", value="m")), timeout=5.0)
+        acked_minority.add("chaos/split-minority")
+    except grpc.RpcError as err:
+        assert err.code() in (grpc.StatusCode.FAILED_PRECONDITION,
+                              grpc.StatusCode.UNAVAILABLE), err
+    new_leader = next(
+        (n for n in sim.registries
+         if n[2] is not None and n[2] is not old_mgr
+         and n[2].role == "LEADER"), None)
+    assert new_leader is not None, "majority side never elected"
+    RegistryStub(sim.pool.get(
+        new_leader[1].addr, None, "component.registry")).SetValue(
+        pb.SetValueRequest(value=pb.Value(
+            path="chaos/split-majority", value="M")), timeout=10.0)
+    acked_majority.add("chaos/split-majority")
+    census = acked_minority & acked_majority
+    assert not census, f"split-brain: acked on both sides: {census}"
+    assert not acked_minority, \
+        "the partitioned minority leader acknowledged a write"
+
+    # Heal: the old leader rejoins as follower and resyncs — the
+    # majority's write appears on it, the never-acked one nowhere.
+    sim.heal_registry_partition()
+    assert wait_for(
+        lambda: old_mgr.role == "FOLLOWER"
+        and old_mgr.db.get("chaos/split-majority") == "M", timeout=20), \
+        "healed minority never resynced the majority's writes"
+    assert old_mgr.db.get("chaos/split-minority") == "", \
+        "a never-acknowledged minority write survived the heal"
+    # The watch stream rode the partition out (re-targeted as needed).
+    assert wait_for(
+        lambda: watcher.get("chaos/split-majority") == "M", timeout=15), \
+        "watch stream never observed the majority write"
+    return {"census_acked_both": len(census),
+            "minority_acks": len(acked_minority),
+            "watch_resyncs": watcher.resyncs}
+
+
+def _run_registry_rolling_restart(sim: ClusterSim,
+                                  rng: random.Random) -> dict:
+    """Rolling restart of every quorum member, followers first and the
+    leader last: writes resume after each hop (follower restarts lose
+    no availability; the leader restart costs one election) and ONE
+    Watch stream survives the whole roll with every marker row
+    delivered — zero missed deltas across three snapshot/token
+    resumes."""
+    assert sim.registry_write("chaos/roll-0", "ok", lease_seconds=0)
+    watcher = sim.registry_watcher("chaos")
+    assert wait_for(lambda: watcher.get("chaos/roll-0") == "ok",
+                    timeout=10), "watch stream never synced"
+    mark = sim.mark_faults()
+    leader = sim.registry_leader()
+    order = [i for i, node in enumerate(sim.registries)
+             if node is not leader] + [sim.registries.index(leader)]
+    for hop, index in enumerate(order, start=1):
+        sim.restart_registry_node(index)
+        marker = f"chaos/roll-{hop}"
+        assert wait_for(lambda m=marker: sim.registry_write(m, "ok"),
+                        timeout=20), f"writes never resumed after hop {hop}"
+        assert wait_for(lambda m=marker: watcher.get(m) == "ok",
+                        timeout=20), \
+            f"watch stream missed {marker} across the restart"
+    # Every marker still visible on every live member's committed view.
+    for i, (svc, _, mgr) in enumerate(sim.registries):
+        for hop in range(len(order) + 1):
+            assert wait_for(
+                lambda s=svc, h=hop: s.db.get(f"chaos/roll-{h}") == "ok",
+                timeout=15), f"member {i} missing chaos/roll-{hop}"
+    healed = sim.wait_heal(
+        [events.REGISTRY_ELECTION, events.REGISTRY_PROMOTION], mark)
+    return {"hops": len(order), "watch_resyncs": watcher.resyncs,
+            "puts_seen": watcher.puts_seen, "signature": healed}
+
+
 @dataclasses.dataclass(frozen=True)
 class Rung:
     """One scripted fault schedule: its sim shape, its seeded driver,
@@ -382,6 +533,19 @@ RUNGS: tuple[Rung, ...] = (
          (events.REGISTRY_PROMOTION,),
          _run_registry_promotion,
          dict(replicas=2, registry_pair=True, primary_lease_s=0.5)),
+    Rung("quorum_leader_kill",
+         (events.REGISTRY_ELECTION, events.REGISTRY_PROMOTION),
+         _run_quorum_leader_kill,
+         dict(replicas=2, registry_quorum=3)),
+    Rung("quorum_partition",
+         (events.REGISTRY_ELECTION, events.REGISTRY_PROMOTION,
+          events.REGISTRY_STEPDOWN),
+         _run_quorum_partition,
+         dict(replicas=0, registry_quorum=3)),
+    Rung("registry_rolling_restart",
+         (events.REGISTRY_ELECTION, events.REGISTRY_PROMOTION),
+         _run_registry_rolling_restart,
+         dict(replicas=0, registry_quorum=3)),
     Rung("feeder_failover",
          (events.FEEDER_FAILOVER, events.VOLUME_HEALED),
          _run_feeder_failover, dict(replicas=0, controllers=2)),
@@ -402,8 +566,11 @@ RUNGS: tuple[Rung, ...] = (
 
 # The trimmed tier-1 set: no replication pair, no controllers, no spec
 # compile — the three rungs that exercise the serving tier's own heal
-# paths in seconds.
-SMOKE_RUNGS = ("replica_kill", "channel_blackhole", "pool_exhaustion")
+# paths in seconds, plus the serve-free fast variants of the quorum
+# rungs (partition and rolling restart over 3 registries only; the
+# full leader-kill-under-load rung runs in `make chaos`).
+SMOKE_RUNGS = ("replica_kill", "channel_blackhole", "pool_exhaustion",
+               "quorum_partition", "registry_rolling_restart")
 
 
 def run_ladder(seed: int = DEFAULT_SEED, include_slow: bool = True,

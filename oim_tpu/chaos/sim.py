@@ -245,6 +245,95 @@ class ControllerHandle:
                 self.alive = False
 
 
+class _SimWatcher:
+    """A registry Watch consumer with endpoint failover: maintains a
+    live dict of rows under ``prefix``, reconnecting (resume token
+    first, RESET snapshot when a restarted node cannot honor it) across
+    whatever the rung does to the quorum. ``deletes`` counts
+    DELETE/EXPIRED deltas observed — the missed/duplicated-delta
+    assertions read ``rows`` + ``puts_seen``."""
+
+    def __init__(self, sim: "ClusterSim", prefix: str):
+        self.sim = sim
+        self.prefix = prefix
+        self.rows: dict[str, str] = {}
+        self.puts_seen = 0
+        self.deletes_seen = 0
+        self.resyncs = 0
+        self.lock = threading.Lock()
+        self.synced = threading.Event()
+        self._stop = threading.Event()
+        self._call = None
+        self._token = ""
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        import grpc
+
+        from oim_tpu.registry.watch import WatchConsumer
+        from oim_tpu.spec import RegistryStub
+
+        consumer = WatchConsumer()
+
+        def install(rows: dict) -> None:
+            self.puts_seen += len(rows)
+            with self.lock:
+                self.rows = dict(rows)
+
+        def put(path: str, value: str) -> None:
+            self.puts_seen += 1
+            with self.lock:
+                self.rows[path] = value
+
+        def delete(path: str, expired: bool) -> None:
+            self.deletes_seen += 1
+            with self.lock:
+                self.rows.pop(path, None)
+
+        def on_reset() -> None:
+            self.resyncs += 1
+
+        while not self._stop.is_set():
+            progressed = [False]
+            for _, server, manager in list(self.sim.registries):
+                if self._stop.is_set():
+                    return
+                try:
+                    stub = RegistryStub(self.sim.pool.get(
+                        server.addr, None, "component.registry"))
+                    call = stub.Watch(pb.WatchRequest(
+                        path=self.prefix,
+                        resume_token=consumer.resume_token))
+                    self._call = call
+
+                    def on_sync() -> None:
+                        progressed[0] = True
+                        self.synced.set()
+
+                    consumer.run(
+                        call, install=install, put=put, delete=delete,
+                        on_reset=on_reset, on_sync=on_sync,
+                        is_stopped=self._stop.is_set)
+                except grpc.RpcError as err:
+                    self.sim.pool.maybe_evict(err, server.addr)
+                finally:
+                    self._call = None
+            if not progressed[0] and self._stop.wait(0.05):
+                return
+
+    def get(self, path: str) -> str | None:
+        with self.lock:
+            return self.rows.get(path)
+
+    def stop(self) -> None:
+        self._stop.set()
+        call = self._call
+        if call is not None:
+            call.cancel()
+        self._thread.join(timeout=5.0)
+
+
 class ClusterSim:
     """The parameterizable in-process cluster (see module docstring).
 
@@ -259,8 +348,10 @@ class ClusterSim:
         *,
         replicas: int = 2,
         registry_pair: bool = False,
+        registry_quorum: int = 0,
         controllers: int = 0,
         primary_lease_s: float = 0.5,
+        election_timeout_s: float = 0.4,
         heartbeat_s: float = 0.3,
         table_interval_s: float = 0.1,
         controller_delay_s: float = 0.2,
@@ -271,8 +362,12 @@ class ClusterSim:
     ):
         self.n_replicas = replicas
         self.registry_pair = registry_pair
+        # N >= 3 raft-style members (registry/quorum.py) instead of the
+        # pair; mutually exclusive with registry_pair.
+        self.registry_quorum = registry_quorum
         self.n_controllers = controllers
         self.primary_lease_s = primary_lease_s
+        self.election_timeout_s = election_timeout_s
         self.heartbeat_s = heartbeat_s
         self.table_interval_s = table_interval_s
         self.controller_delay = controller_delay_s
@@ -290,6 +385,7 @@ class ClusterSim:
         self._router_channel = None
         self.router_stub = None
         self._feeders: list = []
+        self._watchers: list = []
         self._tmpfiles: list[str] = []
         self._started = False
         # Set by mark_faults(): where this sim's fault schedule began.
@@ -324,7 +420,34 @@ class ClusterSim:
         events.configure(capacity=EVENTS_RING)
         self.metrics_srv = MetricsServer(port=0).start()
 
-        if self.registry_pair:
+        if self.registry_quorum:
+            from oim_tpu.registry.quorum import QuorumManager
+
+            services, servers = [], []
+            for _ in range(self.registry_quorum):
+                svc = RegistryService(db=MemRegistryDB())
+                srv = registry_server("tcp://localhost:0", svc)
+                services.append(svc)
+                servers.append(srv)
+            addrs = [srv.addr for srv in servers]
+            managers = []
+            for i, svc in enumerate(services):
+                managers.append(QuorumManager(
+                    svc, node_id=addrs[i],
+                    peers=[a for a in addrs if a != addrs[i]],
+                    election_timeout_s=self.election_timeout_s,
+                    # Past the election window: a partitioned majority
+                    # elects BEFORE the minority leader's step-down —
+                    # the deterministic heal-signature order.
+                    stepdown_grace_s=3 * self.election_timeout_s))
+            self.registries = list(zip(services, servers, managers))
+            self.registry_address = ",".join(addrs)
+            for mgr in managers:
+                mgr.start()
+            if not wait_for(lambda: self.registry_leader() is not None,
+                            timeout=30):
+                raise AssertionError("quorum never elected a leader")
+        elif self.registry_pair:
             p_svc = RegistryService(db=MemRegistryDB())
             p_srv = registry_server("tcp://localhost:0", p_svc)
             s_svc = RegistryService(db=MemRegistryDB())
@@ -397,6 +520,9 @@ class ClusterSim:
         self._started = True
 
     def stop(self) -> None:
+        for watcher in self._watchers:
+            watcher.stop()
+        self._watchers.clear()
         self._feeders.clear()  # feeders ride the sim's pool; no close
         if self._router_channel is not None:
             self._router_channel.close()
@@ -441,6 +567,106 @@ class ClusterSim:
                 server.force_stop()
                 return node
         raise AssertionError("no live PRIMARY registry to kill")
+
+    # -- quorum faults -----------------------------------------------------
+
+    def registry_leader(self):
+        """The current LEADER's (service, server, manager) tuple, or
+        None while an election is in flight (quorum mode)."""
+        from oim_tpu.registry.quorum import LEADER
+
+        for node in self.registries:
+            if node[2] is not None and node[2].role == LEADER:
+                return node
+        return None
+
+    def kill_registry_leader(self):
+        """SIGKILL the quorum LEADER: threads and listener die
+        mid-term, nothing steps down gracefully — the surviving
+        majority must elect on its own. Returns the killed node."""
+        node = self.registry_leader()
+        if node is None:
+            raise AssertionError("no live LEADER registry to kill")
+        _, server, manager = node
+        manager.stop()
+        server.force_stop()
+        return node
+
+    def partition_registry(self, minority_ids) -> None:
+        """Symmetric partition of the quorum by member id (address):
+        members in ``minority_ids`` and the rest cannot exchange any
+        registry-to-registry traffic in either direction. Client
+        traffic is NOT cut — the point is what each side ANSWERS."""
+        minority = set(minority_ids)
+        member_ids = [m.node_id for _, _, m in self.registries
+                      if m is not None]
+        for _, _, manager in self.registries:
+            if manager is None:
+                continue
+            if manager.node_id in minority:
+                manager.set_unreachable(
+                    [a for a in member_ids if a not in minority])
+            else:
+                manager.set_unreachable(minority)
+
+    def heal_registry_partition(self) -> None:
+        for _, _, manager in self.registries:
+            if manager is not None:
+                manager.set_unreachable([])
+
+    def restart_registry_node(self, index: int) -> None:
+        """Restart quorum member ``index`` in place: SIGKILL (threads +
+        listener), then a FRESH process-equivalent — empty DB, term 0 —
+        on the SAME address. The rejoin must resync by snapshot."""
+        from oim_tpu.registry import MemRegistryDB, RegistryService
+        from oim_tpu.registry.registry import registry_server
+        from oim_tpu.registry.quorum import QuorumManager
+
+        _, old_server, old_manager = self.registries[index]
+        addr = old_server.addr
+        old_manager.stop()
+        old_server.force_stop()
+        peers = [m.node_id for i, (_, _, m) in enumerate(self.registries)
+                 if i != index and m is not None]
+        svc = RegistryService(db=MemRegistryDB())
+        srv = registry_server(f"tcp://{addr}", svc)
+        mgr = QuorumManager(svc, node_id=addr, peers=peers,
+                            election_timeout_s=self.election_timeout_s,
+                            stepdown_grace_s=3 * self.election_timeout_s)
+        mgr.start()
+        self.registries[index] = (svc, srv, mgr)
+
+    def registry_write(self, path: str, value: str,
+                       lease_seconds: float = 0.0) -> bool:
+        """One admin SetValue, rotating across every registry endpoint
+        (the oimctl failover shape). True when some member accepted —
+        i.e. a leader exists and committed it."""
+        import grpc
+
+        from oim_tpu.spec import RegistryStub
+
+        for _, server, manager in self.registries:
+            if manager is not None and not manager._threads:
+                continue  # killed node: don't hang on its corpse
+            try:
+                RegistryStub(self.pool.get(
+                    server.addr, None, "component.registry")).SetValue(
+                    pb.SetValueRequest(value=pb.Value(
+                        path=path, value=value,
+                        lease_seconds=lease_seconds)),
+                    timeout=5.0)
+                return True
+            except grpc.RpcError:
+                continue
+        return False
+
+    def registry_watcher(self, prefix: str = "") -> "_SimWatcher":
+        """A push-fed view of the registry under ``prefix``, riding one
+        Watch stream with endpoint failover — how a rung proves a
+        stream SURVIVES kills, partitions and rolling restarts."""
+        watcher = _SimWatcher(self, prefix)
+        self._watchers.append(watcher)
+        return watcher
 
     # -- feeder ------------------------------------------------------------
 

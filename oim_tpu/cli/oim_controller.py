@@ -110,6 +110,13 @@ def main(argv: list[str] | None = None) -> int:
         )
     )
     coord = MeshCoord.parse(args.mesh_coord) if args.mesh_coord else None
+    # The daemon's telemetry/<id> row rides the controller heartbeat as
+    # a batched key: one round-trip renews every row this daemon owns
+    # (a pre-batch registry ignores it; the row's own publisher loop
+    # still maintains it either way).
+    telemetry_id = args.telemetry_id or args.controller_id
+    extra_keys = ([f"telemetry/{telemetry_id}"]
+                  if telemetry_id != "none" else [])
     controller = Controller(
         controller_id=args.controller_id,
         backend=backend,
@@ -119,6 +126,7 @@ def main(argv: list[str] | None = None) -> int:
         lease_seconds=args.lease_seconds,
         mesh_coord=coord,
         tls=tls,
+        extra_lease_keys=extra_keys,
     )
     server = controller_server(args.endpoint, controller.service, tls=tls)
     controller.start()

@@ -1,9 +1,13 @@
 """oim-registry daemon (reference cmd/oim-registry/main.go).
 
-Runs standalone (the reference's shape) or as half of a replicated
-primary/standby pair (``--peer`` + ``--role``; registry/replication.py):
-the primary streams its journal to the standby, the standby serves reads
-and auto-promotes when the primary's self-lease expires. ``--healthz-port``
+Runs standalone (the reference's shape), as half of a replicated
+primary/standby pair (``--peer`` + ``--role``; registry/replication.py)
+— the primary streams its journal to the standby, the standby serves
+reads and auto-promotes when the primary's self-lease expires — or as
+one member of a raft-style 3+ node quorum (``--quorum`` +
+``--advertise``; registry/quorum.py): randomized-timeout leader
+election, writes acknowledged only once a majority holds them, and
+partition failover with no human in the loop. ``--healthz-port``
 serves ``GET /healthz`` for k8s liveness/readiness probes.
 """
 
@@ -46,8 +50,11 @@ def _local_telemetry_row(service, manager, telemetry_id: str,
                 value = telemetry_snapshot("registry", metrics_endpoint,
                                            beat=beats)
                 with service._write_lock:
-                    service.db.set(key, value)
-                    service.leases.grant(key, lease)
+                    # Through the committed-mutation funnel (Watch
+                    # streams see the registry's own row too); in
+                    # quorum mode record_kv journals it fire-and-forget
+                    # and the commit re-applies idempotently.
+                    service.apply_kv(key, value, lease)
                     if service.replication is not None:
                         service.replication.record_kv(key, value, lease)
             if stop.wait(interval):
@@ -88,6 +95,25 @@ def main(argv: list[str] | None = None) -> int:
              "promotion epoch (a rejoining old primary demotes itself)",
     )
     parser.add_argument(
+        "--quorum", default="",
+        help="comma-separated FULL member list (3+ addresses, this "
+             "node included) for raft-style quorum replication: leader "
+             "election, majority-acknowledged writes, automatic "
+             "partition failover (registry/quorum.py); mutually "
+             "exclusive with --peer",
+    )
+    parser.add_argument(
+        "--advertise", default="",
+        help="with --quorum: this node's own entry in the member list "
+             "(its advertised host:port)",
+    )
+    parser.add_argument(
+        "--election-timeout-seconds", type=float, default=1.0,
+        help="with --quorum: base leader-election timeout; followers "
+             "campaign after a randomized [T, 2T) silence, the leader "
+             "steps down after 2T without majority contact",
+    )
+    parser.add_argument(
         "--primary-lease-seconds", type=float, default=10.0,
         help="the primary's self-lease over the replication stream: the "
              "standby auto-promotes when no record arrives for this long; "
@@ -111,13 +137,41 @@ def main(argv: list[str] | None = None) -> int:
     obs = start_observability(args, "oim-registry")
     if args.role == "standby" and not args.peer:
         raise SystemExit("--role standby requires --peer")
+    if args.quorum and args.peer:
+        raise SystemExit("--quorum and --peer are mutually exclusive "
+                         "(pair mode vs raft mode)")
+    if args.quorum:
+        from oim_tpu.common.endpoints import parse_endpoint_list
+
+        members = parse_endpoint_list(args.quorum)
+        if len(members) < 3:
+            raise SystemExit(
+                f"--quorum needs 3+ members (a 2-node deployment is the "
+                f"--peer pair), got {len(members)}")
+        if not args.advertise:
+            raise SystemExit("--quorum requires --advertise (this "
+                             "node's entry in the member list)")
+        if args.advertise not in members:
+            raise SystemExit(
+                f"--advertise {args.advertise!r} is not in the "
+                f"--quorum member list {members}")
     db = FileRegistryDB(args.db_file) if args.db_file else MemRegistryDB()
     service = RegistryService(
         db=db, tls=load_tls_flags(args),
         boot_grace_seconds=args.boot_grace_seconds if args.db_file else 0.0,
     )
     manager = None
-    if args.peer:
+    if args.quorum:
+        from oim_tpu.registry.quorum import QuorumManager
+
+        manager = QuorumManager(
+            service,
+            node_id=args.advertise,
+            peers=[m for m in members if m != args.advertise],
+            election_timeout_s=args.election_timeout_seconds,
+            state_file=f"{args.db_file}.quorum" if args.db_file else "",
+        )
+    elif args.peer:
         manager = ReplicationManager(
             service,
             peer=args.peer,

@@ -505,27 +505,147 @@ def render_top(rows: list[dict]) -> str:
         for row in table)
 
 
+class TelemetryWatch:
+    """``--top --watch N`` rides ONE ``Watch("telemetry")`` stream: the
+    row set is maintained push-style in a background thread and every
+    refresh renders from it, instead of re-issuing two GetValues reads
+    per period. EXPIRED rows flip to STALE (the poll path's
+    include_stale view) rather than vanishing; DELETE removes. Against
+    a pre-Watch registry the stream dies UNIMPLEMENTED and the caller
+    degrades to the poll path — the PAGES/ACCEPT mixed-version
+    stance."""
+
+    def __init__(self, with_failover):
+        import threading
+
+        self._with_failover = with_failover
+        self._lock = threading.Lock()
+        self._rows: dict[str, tuple[str, str, str]] = {}
+        self._synced = threading.Event()
+        self._unsupported = threading.Event()
+        self._stop = threading.Event()
+        self._token = ""
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    @staticmethod
+    def _parse(value: str) -> tuple[str, str]:
+        import json
+
+        try:
+            snap = json.loads(value)
+        except ValueError:
+            snap = {}
+        if not isinstance(snap, dict):
+            snap = {}
+        return str(snap.get("role", "?")), str(snap.get("metrics", ""))
+
+    def _consume(self, stub) -> None:
+        # The shared Watch-client state machine (registry/watch.py):
+        # RESET batching + resume-token discipline live in ONE place.
+        from oim_tpu.registry.watch import WatchConsumer
+
+        consumer = WatchConsumer()
+        consumer.resume_token = self._token
+
+        def entry(path: str, value: str) -> tuple[str, str, str, str]:
+            rid = path.partition("/")[2]
+            role, metrics = self._parse(value)
+            return (rid, "ALIVE", role, metrics)
+
+        def install(rows: dict) -> None:
+            with self._lock:
+                self._rows = {path.partition("/")[2]: entry(path, value)
+                              for path, value in rows.items()}
+
+        def put(path: str, value: str) -> None:
+            with self._lock:
+                self._rows[path.partition("/")[2]] = entry(path, value)
+
+        def delete(path: str, expired: bool) -> None:
+            rid = path.partition("/")[2]
+            with self._lock:
+                if expired and rid in self._rows:
+                    # The poll path's include_stale view: an expired
+                    # row flips STALE instead of vanishing.
+                    _, _, role, metrics = self._rows[rid]
+                    self._rows[rid] = (rid, "STALE", role, metrics)
+                elif not expired:
+                    self._rows.pop(rid, None)
+
+        try:
+            call = stub.Watch(pb.WatchRequest(
+                path="telemetry", resume_token=self._token))
+            consumer.run(call, install=install, put=put, delete=delete,
+                         on_sync=self._synced.set,
+                         is_stopped=self._stop.is_set)
+        finally:
+            self._token = consumer.resume_token
+
+    def _loop(self) -> None:
+        import time
+
+        while not self._stop.is_set():
+            try:
+                self._with_failover(self._consume)
+            except grpc.RpcError as err:
+                if err.code() == grpc.StatusCode.UNIMPLEMENTED:
+                    self._unsupported.set()
+                    return
+            except Exception:  # noqa: BLE001 - keep the CLI rendering
+                pass
+            self._synced.clear()
+            time.sleep(0.5)
+
+    def usable(self, timeout: float = 0.0) -> bool:
+        if self._unsupported.is_set():
+            return False
+        return self._synced.wait(timeout)
+
+    def rows(self) -> list[tuple[str, str, str, str]]:
+        with self._lock:
+            return [self._rows[k] for k in sorted(self._rows)]
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
 def print_top(with_failover, watch: float = 0.0) -> None:
     """Poll every advertised telemetry endpoint and render one cluster
-    table; ``watch`` > 0 refreshes on that period until interrupted."""
+    table; ``watch`` > 0 refreshes on that period until interrupted —
+    discovering rows over one Watch stream when the registry supports
+    it (one stream for the whole session, not two GetValues reads per
+    refresh), degrading to the GetValues poll otherwise."""
     import time
 
-    while True:
-        rows = [top_row(*entry)
-                for entry in with_failover(telemetry_rows)]
-        if watch > 0:
-            print("\033[2J\033[H", end="")  # clear + home, like top(1)
-        if rows:
-            print(render_top(rows))
-        else:
-            print("no telemetry/<id> rows registered (daemons publish "
-                  "them when run with --metrics-port and --registry)")
-        if watch <= 0:
-            return
-        try:
-            time.sleep(watch)
-        except KeyboardInterrupt:
-            return
+    watcher = TelemetryWatch(with_failover) if watch > 0 else None
+    first = True
+    try:
+        while True:
+            if watcher is not None and watcher.usable(
+                    timeout=5.0 if first else 0.0):
+                entries = watcher.rows()
+            else:
+                entries = with_failover(telemetry_rows)
+            first = False
+            rows = [top_row(*entry) for entry in entries]
+            if watch > 0:
+                print("\033[2J\033[H", end="")  # clear + home, like top(1)
+            if rows:
+                print(render_top(rows))
+            else:
+                print("no telemetry/<id> rows registered (daemons "
+                      "publish them when run with --metrics-port and "
+                      "--registry)")
+            if watch <= 0:
+                return
+            try:
+                time.sleep(watch)
+            except KeyboardInterrupt:
+                return
+    finally:
+        if watcher is not None:
+            watcher.stop()
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -602,7 +722,10 @@ def main(argv: list[str] | None = None) -> int:
         default=0.0,
         metavar="SECONDS",
         help="with --top: refresh the table on this period until "
-             "interrupted (0 = render once)",
+             "interrupted (0 = render once). Row discovery rides one "
+             "registry Watch stream when available (push deltas, no "
+             "per-refresh GetValues); degrades to polling against a "
+             "pre-Watch registry",
     )
     add_common_flags(parser)
     args = parser.parse_args(argv)

@@ -1,29 +1,46 @@
-"""Registry endpoint lists: client-side failover across a replicated pair.
+"""Registry endpoint lists: client-side failover across a replicated
+pair or quorum.
 
 Every ``--registry`` flag accepts a comma-separated endpoint list
-(``primary:9421,standby:9421``). Clients dial ``current()`` and, on the
-two failover statuses — ``UNAVAILABLE`` (endpoint dead/unreachable) and
-``FAILED_PRECONDITION`` (endpoint is an unpromoted standby refusing
-writes) — ``advance()`` to the next endpoint and retry through whatever
-retry machinery the call site already has (the controller heartbeat
-loop's jittered backoff, the feeder's heal loop, bootstrap's poll loop).
-Rotation is intentionally dumb: with at most a handful of endpoints, a
-wrong rotation costs one extra round trip and self-corrects on the next
-failure.
+(``primary:9421,standby:9421`` — or all 3+ quorum members). Clients
+dial ``current()`` and, on the two failover statuses — ``UNAVAILABLE``
+(endpoint dead/unreachable) and ``FAILED_PRECONDITION`` (endpoint is an
+unpromoted standby / quorum follower refusing writes) — ``advance()``
+to the next endpoint and retry through whatever retry machinery the
+call site already has (the controller heartbeat loop's jittered
+backoff, the feeder's heal loop, bootstrap's poll loop). Rotation is
+intentionally dumb: with at most a handful of endpoints, a wrong
+rotation costs one extra round trip and self-corrects on the next
+failure. Quorum followers do better than rotation: their rejection
+detail names the leader (``... leader=<addr>``), and ``apply_hint``
+jumps the cursor straight there when the address is in the list.
 """
 
 from __future__ import annotations
 
+import re
 import threading
 
 import grpc
 
 # Statuses that mean "try the other registry endpoint": the endpoint is
-# down, or it is a standby that cannot serve this call until promoted.
+# down, or it is a standby/follower that cannot serve this call.
 FAILOVER_CODES = (
     grpc.StatusCode.UNAVAILABLE,
     grpc.StatusCode.FAILED_PRECONDITION,
 )
+
+_LEADER_HINT = re.compile(r"\bleader=([^\s,]+)")
+
+
+def leader_hint(err: grpc.RpcError) -> str:
+    """The leader address a quorum follower's rejection named, or ""."""
+    try:
+        detail = err.details() or ""
+    except Exception:  # noqa: BLE001 - non-RpcError shims in tests
+        return ""
+    m = _LEADER_HINT.search(detail)
+    return m.group(1) if m else ""
 
 
 def parse_endpoint_list(spec: str) -> list[str]:
@@ -71,3 +88,21 @@ class RegistryEndpoints:
         with self._lock:
             self._index = (self._index + 1) % len(self._endpoints)
             return self._endpoints[self._index]
+
+    def prefer(self, endpoint: str) -> bool:
+        """Jump the cursor to ``endpoint`` when it is in the list
+        (quorum leader hint); returns whether it was."""
+        with self._lock:
+            try:
+                self._index = self._endpoints.index(endpoint)
+            except ValueError:
+                return False
+            return True
+
+    def apply_hint(self, err: grpc.RpcError) -> bool:
+        """Jump to the leader a FAILED_PRECONDITION rejection named
+        (``... leader=<addr>``); returns whether the cursor moved. The
+        caller still calls ``advance()`` when this returns False —
+        hint-less rejections keep the dumb-rotation behavior."""
+        hint = leader_hint(err)
+        return bool(hint) and self.prefer(hint)

@@ -45,6 +45,18 @@ FEEDER_FAILOVER = "feeder_failover"
 VOLUME_HEALED = "volume_healed"
 REGISTRY_PROMOTION = "registry_promotion"
 REGISTRY_DEMOTION = "registry_demotion"
+# Quorum mode (registry/quorum.py): a follower opened an election
+# (term++ campaign); a leader that lost majority contact stepped down
+# WITHOUT a successor having claimed a higher term yet. The winner of
+# an election emits REGISTRY_PROMOTION (the pair-mode event, so
+# dashboards and oimctl keep working), a member adopting a higher term
+# emits REGISTRY_DEMOTION.
+REGISTRY_ELECTION = "registry_election"
+REGISTRY_STEPDOWN = "registry_stepdown"
+# A watch consumer lost its stream/token and fell back to a full
+# snapshot resync (or to GetValues polling against a pre-Watch
+# registry).
+WATCH_RESYNC = "watch_resync"
 ROUTER_RETRY = "router_retry"
 ROUTER_MARK_FAILED = "router_mark_failed"
 # The replica table aged past --max-stale (registry outage outlasting

@@ -410,7 +410,31 @@ REGISTRY_PROMOTIONS = DEFAULT.counter(
     "(admin --promote or primary self-lease expiry)")
 REGISTRY_ROLE = DEFAULT.gauge(
     "oim_registry_role",
-    "replication role of this registry: 1 = PRIMARY, 0 = STANDBY")
+    "replication role of this registry: 1 = PRIMARY/LEADER, "
+    "0 = STANDBY/FOLLOWER/CANDIDATE")
+# Quorum registry (registry/quorum.py) + Watch streams (registry/watch.py).
+REGISTRY_TERM = DEFAULT.gauge(
+    "oim_registry_term",
+    "current raft-style election term of this quorum registry member "
+    "(the promotion-epoch analog; 0 on an unreplicated or pair-mode "
+    "registry)")
+REGISTRY_COMMIT_INDEX = DEFAULT.gauge(
+    "oim_registry_commit_index",
+    "journal offset below which records are quorum-acknowledged on this "
+    "member (writes are client-visible only once committed)")
+REGISTRY_GETVALUES = DEFAULT.counter(
+    "oim_registry_getvalues_total",
+    "GetValues reads served by this registry — the poll load Watch "
+    "streams exist to remove (bench.py --control-plane measures the "
+    "drop at 1k publishers)")
+WATCH_STREAMS = DEFAULT.gauge(
+    "oim_watch_streams",
+    "Watch streams currently attached to this registry")
+WATCH_EVENTS = DEFAULT.counter(
+    "oim_watch_events_total",
+    "Watch events delivered to consumers, by kind "
+    "(put/delete/expired/sync)",
+    labelnames=("kind",))
 # Direct data path (feeder/driver.py + common/channelpool.py): windows
 # served controller-direct vs through the registry proxy, per-window
 # throughput, and the pooled-channel census.

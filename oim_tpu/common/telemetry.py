@@ -52,10 +52,16 @@ class RegistryRowPublisher:
     """Publish-and-renew loop for one TTL-leased registry row.
 
     ``start()`` runs the loop in a daemon thread; ``beat_once()`` is the
-    unit the loop (and tests) drive: one SetValue of ``snapshot()`` with
-    ``lease_seconds``. ``stop(deregister=True)`` deletes the key so
-    consumers drop the row without waiting out the lease. Subclasses
-    implement ``snapshot() -> dict``.
+    unit the loop (and tests) drive. When the snapshot CHANGED (or every
+    ``republish_every``-th beat, as the resync bound) it is one SetValue
+    of ``snapshot()`` with ``lease_seconds``; between those, an
+    unchanged row renews by a batched ``Heartbeat(keys=[row])`` — no
+    value payload, no journal record on the registry, the ROADMAP
+    "batch heartbeats" item. A pre-batch registry leaves ``keys_known``
+    empty, which degrades this publisher back to re-publishing every
+    beat — the mixed-version stance. ``stop(deregister=True)`` deletes
+    the key so consumers drop the row without waiting out the lease.
+    Subclasses implement ``snapshot() -> dict``.
     """
 
     # Same TTL posture as the controller heartbeat: one lost beat must
@@ -72,6 +78,7 @@ class RegistryRowPublisher:
         lease_seconds: float = 0.0,
         tls: TLSConfig | None = None,
         pool: channelpool.ChannelPool | None = None,
+        republish_every: int = 4,
     ):
         self.key = key
         self._endpoints = RegistryEndpoints(registry_address)
@@ -87,6 +94,17 @@ class RegistryRowPublisher:
         # tell a fresh heartbeat from the frozen row of a dead
         # publisher whose lease has not lapsed yet.
         self._beats = 0
+        # Batch-renewal state: every Nth beat re-publishes in full even
+        # when unchanged, so a consumer's row-changed freshness check
+        # (mark_failed re-admission) is bounded by N x interval, not
+        # forever; <= 1 disables renewal (always publish).
+        self.republish_every = max(int(republish_every), 1)
+        self._renews_since_publish = 0
+        self._last_body: dict | None = None  # last published, sans beat
+        self._last_snapshot: dict | None = None
+        # None = unknown (probe on the first renewable beat); False =
+        # the registry ignored `keys` (pre-batch) — publish every beat.
+        self._batch_supported: bool | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -99,26 +117,83 @@ class RegistryRowPublisher:
             self._endpoints.current(), self.tls, "component.registry")
 
     def _set(self, value: str, lease_seconds: float) -> None:
+        # One in-call failover hop: a write that lands on a standby /
+        # quorum follower jumps to the leader its rejection named (or
+        # rotates) and retries immediately — direct beat_once() callers
+        # (first registration, the draining announcement) must not fail
+        # just because the list's first endpoint is not the leader.
+        for attempt in (0, 1):
+            try:
+                RegistryStub(self._registry_channel()).SetValue(
+                    pb.SetValueRequest(value=pb.Value(
+                        path=self.key, value=value,
+                        lease_seconds=lease_seconds)),
+                    timeout=10.0,
+                )
+                return
+            except grpc.RpcError as err:
+                self._pool.maybe_evict(err, self._endpoints.current())
+                if (attempt == 0 and self._endpoints.multiple
+                        and err.code() in FAILOVER_CODES):
+                    if not self._endpoints.apply_hint(err):
+                        self._endpoints.advance()
+                    continue
+                raise
+
+    def beat_once(self, **overrides) -> dict:
+        """One heartbeat: renew the unchanged row by batched Heartbeat
+        when the registry supports it, else (or when the snapshot
+        changed, or at the republish bound) publish it in full. Returns
+        the row's current snapshot."""
+        snap = self.snapshot()
+        snap.update(overrides)
+        if (self._batch_supported is not False
+                and self._last_body == snap
+                and self._renews_since_publish + 1 < self.republish_every
+                and self._renew_once()):
+            self._renews_since_publish += 1
+            return self._last_snapshot
+        self._beats += 1
+        self._renews_since_publish = 0
+        body = dict(snap)
+        snap["beat"] = self._beats
+        self._set(json.dumps(snap, sort_keys=True), self.lease_seconds)
+        self._last_body = body
+        self._last_snapshot = snap
+        return snap
+
+    def _renew_once(self) -> bool:
+        """One batched lease renewal of this row. False = fall through
+        to a full publish (pre-batch registry, or the registry lost the
+        row). Transport/role errors raise for the loop's failover+
+        backoff handling, exactly like a failed publish."""
         try:
-            RegistryStub(self._registry_channel()).SetValue(
-                pb.SetValueRequest(value=pb.Value(
-                    path=self.key, value=value,
-                    lease_seconds=lease_seconds)),
+            reply = RegistryStub(self._registry_channel()).Heartbeat(
+                pb.HeartbeatRequest(
+                    keys=[self.key], lease_seconds=self.lease_seconds),
                 timeout=10.0,
             )
         except grpc.RpcError as err:
+            if err.code() in (grpc.StatusCode.UNIMPLEMENTED,
+                              grpc.StatusCode.INVALID_ARGUMENT):
+                # UNIMPLEMENTED: no Heartbeat RPC at all (pre-lease
+                # registry). INVALID_ARGUMENT ("empty controller_id"):
+                # a pre-batch registry that parsed the request but
+                # knows nothing of `keys`. Either way: publish every
+                # beat, the era this publisher already handles.
+                self._batch_supported = False
+                return False
             self._pool.maybe_evict(err, self._endpoints.current())
             raise
-
-    def beat_once(self, **overrides) -> dict:
-        """One heartbeat: publish the current snapshot (plus
-        ``overrides``) with the lease. Returns the published snapshot."""
-        snap = self.snapshot()
-        snap.update(overrides)
-        self._beats += 1
-        snap["beat"] = self._beats
-        self._set(json.dumps(snap, sort_keys=True), self.lease_seconds)
-        return snap
+        if len(reply.keys_known) != 1:
+            # The registry parsed the request but ignored `keys`: a
+            # pre-batch build. Degrade to publish-every-beat.
+            self._batch_supported = False
+            return False
+        self._batch_supported = True
+        # keys_known[0] False = the registry no longer holds the row
+        # (restart, swept lease): re-publish in full NOW.
+        return bool(reply.keys_known[0])
 
     def start(self) -> None:
         def loop() -> None:
@@ -136,7 +211,9 @@ class RegistryRowPublisher:
                 except grpc.RpcError as err:
                     if (self._endpoints.multiple
                             and err.code() in FAILOVER_CODES):
-                        target = self._endpoints.advance()
+                        if not self._endpoints.apply_hint(err):
+                            self._endpoints.advance()
+                        target = self._endpoints.current()
                         log.warning("failing over to peer registry",
                                     target=target)
                     delay = backoff.next()

@@ -317,6 +317,7 @@ class Controller:
         mesh_coord: MeshCoord | None = None,
         tls: TLSConfig | None = None,
         pool: channelpool.ChannelPool | None = None,
+        extra_lease_keys: list[str] | None = None,
     ):
         if registry_address and not controller_address:
             raise ValueError("registration requires a controller address")
@@ -341,6 +342,13 @@ class Controller:
         self.mesh_coord = mesh_coord
         self.tls = tls
         self._pool = pool if pool is not None else channelpool.shared()
+        # Extra leased rows this daemon owns (its telemetry/<id> row),
+        # renewed in the SAME Heartbeat round-trip — the batch-heartbeat
+        # path. A pre-batch registry silently ignores them (their own
+        # publisher loops keep them alive); a batch-aware registry
+        # makes one controller heartbeat renew every row the daemon
+        # holds.
+        self.extra_lease_keys = list(extra_lease_keys or [])
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -404,6 +412,7 @@ class Controller:
                 pb.HeartbeatRequest(
                     controller_id=self.controller_id,
                     lease_seconds=self.lease_seconds,
+                    keys=self.extra_lease_keys,
                 ),
                 timeout=10.0,
             )
@@ -463,11 +472,13 @@ class Controller:
                             and isinstance(err, grpc.RpcError)
                             and err.code() in FAILOVER_CODES):
                         # Replicated registry: UNAVAILABLE (endpoint dead)
-                        # or FAILED_PRECONDITION (unpromoted standby) —
-                        # rotate to the peer endpoint and let the backoff
-                        # below pace the retry. The pair converges once
-                        # the standby promotes.
-                        target = self._endpoints.advance()
+                        # or FAILED_PRECONDITION (unpromoted standby /
+                        # quorum follower) — jump to the leader the
+                        # rejection named, else rotate, and let the
+                        # backoff below pace the retry.
+                        if not self._endpoints.apply_hint(err):
+                            self._endpoints.advance()
+                        target = self._endpoints.current()
                         log.warning("failing over to peer registry",
                                     target=target)
                     delay = backoff.next()

@@ -63,6 +63,29 @@ class LeaseTable:
         with self._lock:
             self._leases.pop(path, None)
 
+    def renew_path(self, path: str, ttl_seconds: float = 0.0) -> int:
+        """Extend exactly one path's lease — O(1), no prefix scan. The
+        batched-Heartbeat row renewal: a fleet of 1k rows renewing by
+        key must not pay a 1k-entry scan PER KEY (the O(N^2) cliff the
+        prefix form hits at production fan-in). Returns 1 when a lease
+        was renewed, 0 when the path carries none."""
+        now = self._clock()
+        with self._lock:
+            lease = self._leases.get(path)
+            if lease is None:
+                return 0
+            ttl = ttl_seconds if ttl_seconds > 0 else lease.ttl
+            lease.deadline = now + ttl
+            lease.ttl = ttl
+            lease.expiry_counted = False
+            return 1
+
+    def has_lease(self, path: str) -> bool:
+        """Whether the path carries a lease at all (live or expired) —
+        O(1), the quorum write path's pre-propose existence check."""
+        with self._lock:
+            return path in self._leases
+
     def renew(self, prefix: str, ttl_seconds: float = 0.0) -> int:
         """Extend every lease on ``prefix`` or nested under it
         (component-wise, matching the DB's prefix semantics). ttl 0 keeps
@@ -122,3 +145,30 @@ class LeaseTable:
             if lease is None:
                 return None
             return lease.deadline - self._clock()
+
+    def count(self, prefix: str) -> int:
+        """Leases on ``prefix`` or nested under it (component-wise) —
+        what a renew of that prefix would touch. The quorum write path
+        computes a Heartbeat's ``known`` verdict from this BEFORE
+        proposing the renewal (the leader's lease table is committed
+        state)."""
+        from oim_tpu.common.pathutil import path_has_prefix
+
+        parts = prefix.split("/")
+        with self._lock:
+            return sum(1 for path in self._leases
+                       if path_has_prefix(path, parts))
+
+    def leased_paths(self) -> list[str]:
+        """Every path currently carrying a lease (live or expired)."""
+        with self._lock:
+            return list(self._leases)
+
+    def sweep_expired(self) -> list[str]:
+        """Paths whose lease is past its deadline, each counted/emitted
+        through the same once-per-transition accounting as a lazy read
+        (``expired_for``). The Watch hub's sweeper calls this so expiry
+        becomes a PUSH signal — watchers get a deletion the moment a
+        sweep observes the lapse, instead of every consumer polling."""
+        return [path for path in self.leased_paths()
+                if self.expired_for(path) is not None]

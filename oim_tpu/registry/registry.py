@@ -67,10 +67,18 @@ class RegistryService(RegistryServicer):
         # lease_seconds stay visible only while heartbeats renew them.
         self.leases = leases if leases is not None else LeaseTable()
         # Set by ReplicationManager when this registry is half of a
-        # primary/standby pair (registry/replication.py): standbys refuse
-        # writes, mutations feed the replication journal, and the virtual
-        # "registry/..." status keys appear in GetValues.
+        # primary/standby pair (registry/replication.py) or by
+        # QuorumManager for a raft-style 3+ member (registry/quorum.py):
+        # standbys/followers refuse writes, mutations feed the journal,
+        # and the virtual "registry/..." status keys appear in GetValues.
         self.replication = None
+        # The Watch hub: every COMMITTED mutation (apply_kv below — the
+        # legacy write path, a quorum commit, a standby's replication
+        # apply) fans out as a prefix-scoped delta to attached Watch
+        # streams (registry/watch.py).
+        from oim_tpu.registry.watch import WatchHub
+
+        self.watch = WatchHub(self)
         # Serializes a write's state mutation WITH its journal append:
         # without it, two racing writes to one key could journal in the
         # opposite order they were applied and diverge the standby.
@@ -147,15 +155,66 @@ class RegistryService(RegistryServicer):
             return serve_id == host_id or serve_id.startswith(host_id + ".")
         return False
 
+    # -- committed-state mutation (every apply path funnels here) ----------
+
+    def apply_kv(self, path: str, value: str, lease_seconds: float) -> None:
+        """Apply one committed KV mutation: DB, lease overlay, Watch
+        fan-out. Callers serialize (the write lock, the replication
+        apply thread, or the quorum commit loop)."""
+        self.db.set(path, value)
+        if value == "":
+            # Deleted entries carry no lease; a later permanent
+            # re-write must not inherit a stale deadline.
+            self.leases.drop(path)
+        else:
+            # lease_seconds > 0 grants/refreshes; 0 (proto default)
+            # writes a permanent entry — the pre-lease behavior and
+            # the admin override path (oimctl --set pins a key past
+            # lease filtering).
+            self.leases.grant(path, lease_seconds)
+        self.watch.publish_kv(path, value, lease_seconds)
+
+    def apply_renew(self, prefix: str, ttl: float) -> int:
+        """Apply one committed lease renewal. An exact leased row (the
+        batched-Heartbeat shape) renews O(1); anything else falls back
+        to the component-wise prefix scan (the controller-id shape —
+        the bare id itself is never a leased path). No Watch delta —
+        the value did not change; a renewal that resurrects a
+        swept-expired row is re-announced by the hub's sweeper."""
+        renewed = self.leases.renew_path(prefix, ttl)
+        if renewed:
+            return renewed
+        return self.leases.renew(prefix, ttl)
+
     # -- service methods --------------------------------------------------
 
     def _reject_if_standby(self, context) -> None:
         repl = self.replication
         if repl is not None and not repl.is_primary:
+            hint = repl.leader_hint()
             context.abort(
                 grpc.StatusCode.FAILED_PRECONDITION,
-                f"standby (epoch {repl.epoch}): writes go to the primary",
+                f"standby (epoch {repl.epoch}): writes go to the primary"
+                + (f" leader={hint}" if hint else ""),
             )
+
+    def _propose(self, context, propose, *args):
+        """Run a quorum proposal, mapping its failures to statuses: a
+        leader that lost the majority answers UNAVAILABLE (the write was
+        never acknowledged anywhere), a step-down mid-flight answers
+        FAILED_PRECONDITION like any other non-leader."""
+        from oim_tpu.registry import quorum as Q
+
+        try:
+            return propose(*args)
+        except Q.NotLeader as err:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"not the quorum leader: writes go to the leader"
+                + (f" leader={err.hint}" if err.hint else ""),
+            )
+        except Q.QuorumUnavailable as err:
+            context.abort(grpc.StatusCode.UNAVAILABLE, str(err))
 
     def SetValue(self, request, context):
         from oim_tpu.registry import replication as R
@@ -201,21 +260,21 @@ class RegistryService(RegistryServicer):
                 grpc.StatusCode.PERMISSION_DENIED,
                 f"{peer!r} may not set {request.value.path!r}",
             )
+        repl = self.replication
+        if repl is not None and repl.quorum:
+            # Quorum mode: the write is a journal proposal; it applies
+            # (and becomes GetValues/Watch-visible) only once a majority
+            # of members hold the record — the proposal blocks until
+            # that commit or fails without ever acknowledging.
+            self._propose(
+                context, repl.propose_kv, request.value.path,
+                request.value.value, request.value.lease_seconds)
+            return pb.SetValueReply()
         with self._write_lock:
-            self.db.set(request.value.path, request.value.value)
-            if request.value.value == "":
-                # Deleted entries carry no lease; a later permanent
-                # re-write must not inherit a stale deadline.
-                self.leases.drop(request.value.path)
-            else:
-                # lease_seconds > 0 grants/refreshes; 0 (proto default)
-                # writes a permanent entry — the pre-lease behavior and
-                # the admin override path (oimctl --set pins a key past
-                # lease filtering).
-                self.leases.grant(
-                    request.value.path, request.value.lease_seconds)
-            if self.replication is not None:
-                self.replication.record_kv(
+            self.apply_kv(request.value.path, request.value.value,
+                          request.value.lease_seconds)
+            if repl is not None:
+                repl.record_kv(
                     request.value.path, request.value.value,
                     request.value.lease_seconds)
         return pb.SetValueReply()
@@ -225,6 +284,7 @@ class RegistryService(RegistryServicer):
         # (registry.go:129-144). Lease-expired entries are invisible unless
         # the caller opts into stale reads (oimctl debugging).
         self._peer(context)
+        M.REGISTRY_GETVALUES.inc()
         if request.path:
             try:
                 split_registry_path(request.path)
@@ -257,53 +317,103 @@ class RegistryService(RegistryServicer):
 
     def Heartbeat(self, request, context):
         """Renew the leases on every ``<controller_id>/...`` key (the
-        etcd-KeepAlive analog). Authorization mirrors SetValue: a
-        controller may heartbeat only itself."""
+        etcd-KeepAlive analog), plus any explicitly listed ``keys`` —
+        the batch path that lets a daemon renew ALL its leased rows
+        (serve/<id>, telemetry/<id>, controller keys) in one round-trip.
+        Authorization mirrors SetValue: a caller may renew only what it
+        could write."""
+        from oim_tpu.registry import replication as R
+
         peer = self._peer(context)
-        if not request.controller_id:
+        if not request.controller_id and not request.keys:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "empty controller_id")
-        try:
-            parts = split_registry_path(request.controller_id)
-        except ValueError as err:
-            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(err))
-        if len(parts) != 1:
-            context.abort(
-                grpc.StatusCode.INVALID_ARGUMENT,
-                f"controller_id {request.controller_id!r} is a path, not an id",
-            )
-        if request.controller_id in (REGISTRY_SERVE, REGISTRY_TELEMETRY):
-            # Renewal is prefix-scoped: a "serve"/"telemetry" heartbeat
-            # would renew EVERY row's lease in that namespace at once.
-            # Those rows renew by re-publishing their snapshot
-            # (common/telemetry.py RegistryRowPublisher).
-            context.abort(
-                grpc.StatusCode.INVALID_ARGUMENT,
-                f"{request.controller_id!r} is a reserved namespace, not "
-                "a controller id",
-            )
-        if not (peer == "user.admin"
-                or peer == f"controller.{request.controller_id}"):
-            context.abort(
-                grpc.StatusCode.PERMISSION_DENIED,
-                f"{peer!r} may not heartbeat {request.controller_id!r}",
-            )
+        if request.controller_id:
+            try:
+                parts = split_registry_path(request.controller_id)
+            except ValueError as err:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(err))
+            if len(parts) != 1:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"controller_id {request.controller_id!r} is a path, "
+                    f"not an id",
+                )
+            if request.controller_id in (REGISTRY_SERVE, REGISTRY_TELEMETRY):
+                # Renewal is prefix-scoped: a "serve"/"telemetry"
+                # heartbeat would renew EVERY row's lease in that
+                # namespace at once. Those rows renew individually via
+                # the batch `keys` list (or by re-publishing).
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"{request.controller_id!r} is a reserved namespace, "
+                    "not a controller id",
+                )
+            if not (peer == "user.admin"
+                    or peer == f"controller.{request.controller_id}"):
+                context.abort(
+                    grpc.StatusCode.PERMISSION_DENIED,
+                    f"{peer!r} may not heartbeat "
+                    f"{request.controller_id!r}",
+                )
+        keys = list(request.keys)
+        for key in keys:
+            try:
+                key_parts = split_registry_path(key)
+            except ValueError as err:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(err))
+            if key_parts[0] == R.RESERVED_REGISTRY_ID:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"{R.RESERVED_REGISTRY_ID}/ keys are never leased",
+                )
+            if not self._may_set(peer, key_parts):
+                context.abort(
+                    grpc.StatusCode.PERMISSION_DENIED,
+                    f"{peer!r} may not renew {key!r}",
+                )
         self._reject_if_standby(context)
-        with self._write_lock:
-            renewed = self.leases.renew(
-                request.controller_id, request.lease_seconds)
-            if renewed > 0 and self.replication is not None:
-                # Renewals ship as logical records: the standby re-bases
-                # the deadline on its own monotonic clock.
-                self.replication.record_renew(
-                    request.controller_id, request.lease_seconds)
+        prefixes = ([request.controller_id] if request.controller_id
+                    else []) + keys
+        repl = self.replication
+        if repl is not None and repl.quorum:
+            # Quorum mode: the renewals are journal proposals; the
+            # known/keys_known verdicts are computed from the leader's
+            # (committed) lease table up front — renewing never creates
+            # a lease, so pre-propose existence equals the post-commit
+            # verdict. Exact rows check O(1); only the controller-id
+            # prefix pays a scan.
+            counts = {p: (1 if self.leases.has_lease(p)
+                          else self.leases.count(p))
+                      for p in prefixes}
+            self._propose(
+                context, repl.propose_renews,
+                [p for p in prefixes if counts[p] > 0],
+                request.lease_seconds)
+        else:
+            counts = {}
+            with self._write_lock:
+                for prefix in prefixes:
+                    counts[prefix] = self.apply_renew(
+                        prefix, request.lease_seconds)
+                    if counts[prefix] > 0 and repl is not None:
+                        # Renewals ship as logical records: the standby
+                        # re-bases the deadline on its own monotonic
+                        # clock.
+                        repl.record_renew(prefix, request.lease_seconds)
         # known == False tells the controller to re-register in full. Two
         # causes: the registry has no address for it (restart, lost soft
         # state), or the address exists WITHOUT a lease to renew (journal
         # replay after a --db-file restart) — re-registering re-grants the
         # lease from the controller, the source of truth for its TTL.
-        known = renewed > 0 and bool(
-            self.db.get(f"{request.controller_id}/{REGISTRY_ADDRESS}"))
-        return pb.HeartbeatReply(known=known)
+        known = bool(
+            request.controller_id
+            and counts[request.controller_id] > 0
+            and self.db.get(f"{request.controller_id}/{REGISTRY_ADDRESS}"))
+        # keys_known parallels keys: the row exists AND its lease
+        # renewed. A pre-batch registry never sets this field at all —
+        # the caller's degrade-to-republish signal.
+        keys_known = [counts[k] > 0 and bool(self.db.get(k)) for k in keys]
+        return pb.HeartbeatReply(known=known, keys_known=keys_known)
 
     def Replicate(self, request, context):
         """Stream the journal to a standby registry (or answer a probe).
@@ -323,6 +433,50 @@ class RegistryService(RegistryServicer):
                 "replication not configured on this registry (--peer)",
             )
         return self.replication.serve(request, context)
+
+    def Watch(self, request, context):
+        """Stream prefix-scoped KV deltas (registry/watch.py). Reads
+        need any authenticated identity, like GetValues; served by
+        leader/primary and followers/standbys alike from committed
+        state."""
+        self._peer(context)
+        if request.path:
+            try:
+                split_registry_path(request.path)
+            except ValueError as err:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(err))
+        return self.watch.serve(request, context)
+
+    def _quorum_or_abort(self, context):
+        repl = self.replication
+        if repl is None or not repl.quorum:
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "not a quorum registry member (--quorum)",
+            )
+        return repl
+
+    def Vote(self, request, context):
+        """Quorum leader election (registry/quorum.py). Authorization as
+        Replicate: the peer registries or an admin."""
+        peer = self._peer(context)
+        if peer not in ("component.registry", "user.admin"):
+            context.abort(
+                grpc.StatusCode.PERMISSION_DENIED,
+                f"{peer!r} may not vote in registry elections",
+            )
+        return self._quorum_or_abort(context).on_vote(request, context)
+
+    def Ack(self, request, context):
+        """Quorum follower -> leader replication acknowledgement
+        (registry/quorum.py). Authorization as Replicate."""
+        peer = self._peer(context)
+        if peer not in ("component.registry", "user.admin"):
+            context.abort(
+                grpc.StatusCode.PERMISSION_DENIED,
+                f"{peer!r} may not ack registry replication",
+            )
+        return self._quorum_or_abort(context).on_ack(request, context)
 
 
 _IDENTITY = lambda b: b  # noqa: E731 - bytes pass-through serdes for proxying
@@ -501,7 +655,11 @@ def registry_server(
 
     # The proxy's pooled controller channels live exactly as long as the
     # registry serves (a test process running several registries must not
-    # accumulate channels across their lifetimes).
+    # accumulate channels across their lifetimes); same for the Watch
+    # hub's sweeper thread and attached streams.
     server.add_cleanup(proxy.close)
+    hub = getattr(service, "watch", None)
+    if hub is not None:  # mixed-version test doubles predate the hub
+        server.add_cleanup(hub.stop)
     server.start(register)
     return server

@@ -163,7 +163,16 @@ class _StaleEpoch(Exception):
 class ReplicationManager:
     """Role, epoch, journal, and the standby follower threads of one
     registry process. Attaches itself to the ``RegistryService`` it is
-    constructed with (``service.replication = self``)."""
+    constructed with (``service.replication = self``).
+
+    This is the 2-node legacy mode: one primary, one standby, failover
+    by watchdog lease (auto) or ``oimctl --promote`` (manual). The
+    3+ member raft-style mode lives in registry/quorum.py and shares
+    this module's journal/snapshot machinery."""
+
+    # Distinguishes the write path: the legacy pair applies-then-
+    # journals; quorum mode proposes-and-waits (registry.py SetValue).
+    quorum = False
 
     BACKOFF_BASE = 0.2
     BACKOFF_MAX = 5.0
@@ -255,6 +264,12 @@ class ReplicationManager:
     @property
     def is_primary(self) -> bool:
         return self.role == PRIMARY
+
+    def leader_hint(self) -> str:
+        """Where writes should go instead, when known. The pair mode has
+        no authoritative view of the peer's role — clients rotate their
+        endpoint list on FAILED_PRECONDITION — so no hint is offered."""
+        return ""
 
     def record_kv(self, path: str, value: str, lease_seconds: float) -> None:
         if self.role == PRIMARY:
@@ -631,13 +646,14 @@ class ReplicationManager:
             self._snapshot_seen = set()
         elif rec.kind == KIND_KV:
             value = rec.value
-            self.db.set(value.path, value.value)
-            if value.value == "":
-                self.leases.drop(value.path)
-            else:
-                self.leases.grant(value.path, value.lease_seconds)
-                if self._in_snapshot:
-                    self._snapshot_seen.add(value.path)
+            # Through the service's committed-mutation funnel, so a
+            # standby's Watch streams see the delta too (watch-across-
+            # failover: a watcher re-targeting the promoted standby
+            # resumes against the same state its primary stream left).
+            self.service.apply_kv(
+                value.path, value.value, value.lease_seconds)
+            if value.value != "" and self._in_snapshot:
+                self._snapshot_seen.add(value.path)
             if not self._in_snapshot:
                 with self._lock:
                     self._applied = rec.offset + 1
@@ -647,8 +663,7 @@ class ReplicationManager:
             # on the primary while we were disconnected.
             for path in set(get_registry_entries(self.db, "")) \
                     - self._snapshot_seen:
-                self.db.set(path, "")
-                self.leases.drop(path)
+                self.service.apply_kv(path, "", 0.0)
             self._in_snapshot = False
             self._snapshot_seen = set()
             with self._lock:
@@ -662,7 +677,7 @@ class ReplicationManager:
                 compact()
             M.REPL_RECORDS_APPLIED.inc()
         elif rec.kind == KIND_RENEW:
-            self.leases.renew(rec.renew_prefix, rec.renew_ttl)
+            self.service.apply_renew(rec.renew_prefix, rec.renew_ttl)
             with self._lock:
                 self._applied = rec.offset + 1
             M.REPL_RECORDS_APPLIED.inc()
@@ -783,7 +798,7 @@ class HealthzServer:
             return True, {"role": PRIMARY, "replicated": False}
         status = self.manager.status()
         ok = (
-            status["role"] == PRIMARY
+            status["role"] in (PRIMARY, "LEADER")
             or status["lag_seconds"] <= self.max_lag_seconds
         )
         return ok, status

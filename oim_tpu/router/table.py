@@ -2,19 +2,26 @@
 registry's ``serve/<id>`` rows.
 
 Routing decisions must stay off the control plane's hot path (OIM's
-premise: control traffic is short-lived and infrequent). The table polls
-``GetValues("serve")`` on a jittered interval and answers every routing
-decision from that cached snapshot — a registry round trip per INTERVAL,
-not per request. Liveness comes for free: the registry's lease filter
-already hides replicas that stopped heartbeating, and a draining replica
-publishes ``ready: false`` (serve/registration.py), which the table
-treats as absent. Between polls the router overlays its own signals:
-``mark_failed`` drops a replica the data path just proved dead (the
-next successful poll re-admits it if it recovered — by then its lease
-either lapsed or it is genuinely back).
+premise: control traffic is short-lived and infrequent). The table is
+PUSH-fed by default: one ``Watch("serve")`` stream delivers row deltas
+the moment they commit — a replica drain, re-register, or lease expiry
+reaches the routing view in one event instead of waiting out a poll
+tick, and a ``mark_failed`` replica re-admits the moment its row
+CHANGES (a fresh heartbeat re-publish) rather than at the next poll.
+The GetValues poll survives as the mixed-version and resync fallback:
+against a pre-Watch registry (UNIMPLEMENTED) the table degrades to the
+original jittered poll transparently, and while a watch stream is live
+the poll idles unless the cached view goes silent (a black-holed stream
+must not wedge the table — the poll thread cancels it and re-resolves).
+
+Liveness comes for free either way: the registry's lease filter (poll)
+or pushed EXPIRED deletions (watch) hide replicas that stopped
+heartbeating, and a draining replica publishes ``ready: false``
+(serve/registration.py), which the table treats as absent.
 
 Registry outages degrade gracefully, feeder-style: endpoint rotation on
-UNAVAILABLE / FAILED_PRECONDITION (replicated pair), pooled channels
+UNAVAILABLE / FAILED_PRECONDITION (replicated pair or quorum, with the
+follower's ``leader=`` hint fast-pathing the cursor), pooled channels
 with transport-failure eviction, and the last good snapshot keeps
 serving until ``max_stale`` — a registry blip must not take the whole
 serving tier down with it.
@@ -108,9 +115,14 @@ class ReplicaTable:
         max_stale: float = 30.0,
         tls: TLSConfig | None = None,
         pool: channelpool.ChannelPool | None = None,
+        watch: bool = True,
     ):
         self._endpoints = RegistryEndpoints(registry_address)
         self.interval = interval
+        # Push invalidation (Watch stream) with the poll as fallback;
+        # False = the pre-Watch pure-poll behavior (bench comparisons,
+        # conservative deployments).
+        self.watch_enabled = watch
         # How long the last good snapshot keeps serving through a
         # registry outage before the table reports itself empty: bounded
         # by how stale a routing decision may be — replicas that died in
@@ -142,6 +154,18 @@ class ReplicaTable:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._watch_thread: threading.Thread | None = None
+        # Watch-stream state: the in-flight call (cancellable by stop()
+        # and by the poll thread's silence guard), the resume token of
+        # the last delivered event, and whether a stream is attached
+        # AND synced (the poll idles only then).
+        self._watch_call = None
+        self._watch_synced = False
+        self._resume_token = ""
+        # A stream that goes silent longer than this is presumed
+        # black-holed: the poll thread cancels it and refreshes. The
+        # hub keepalives every ~2s, so silence means a dead transport.
+        self._watch_silence = max(4 * interval, 8.0)
 
     # -- refresh ----------------------------------------------------------
 
@@ -156,7 +180,8 @@ class ReplicaTable:
                 pb.GetValuesRequest(path=SERVE_PREFIX), timeout=10.0)
         except grpc.RpcError as err:
             self._pool.maybe_evict(err, address)
-            if self._endpoints.multiple and err.code() in FAILOVER_CODES:
+            if self._endpoints.multiple and err.code() in FAILOVER_CODES \
+                    and not self._endpoints.apply_hint(err):
                 self._endpoints.advance()
             raise
         fresh = {}
@@ -166,6 +191,11 @@ class ReplicaTable:
             if replica is not None and replica.ready:
                 fresh[replica.replica_id] = replica
                 raw[replica.replica_id] = value.value
+        self._install(fresh, raw)
+
+    def _install(self, fresh: dict, raw: dict) -> None:
+        """Replace the cached replica set with a complete snapshot (a
+        GetValues poll, or a Watch RESET..SYNC rebuild)."""
         with self._lock:
             self._replicas = fresh
             self._raw = raw
@@ -187,6 +217,148 @@ class ReplicaTable:
             M.ROUTER_REPLICAS.set(count)
             if recovered:
                 events.emit(events.ROUTER_TABLE_RECOVERED, replicas=count)
+
+    def _apply_delta(self, rid: str, value: str | None) -> None:
+        """Patch one replica row from a Watch delta. ``None`` = the row
+        was deleted or its lease expired."""
+        with self._lock:
+            if value is None:
+                self._replicas.pop(rid, None)
+                self._raw.pop(rid, None)
+                self._failed.pop(rid, None)
+            else:
+                if rid in self._failed and self._failed[rid] != value:
+                    # The row CHANGED: the replica heartbeat again —
+                    # instant re-admission, no poll tick to wait out.
+                    del self._failed[rid]
+                replica = Replica.parse(f"{SERVE_PREFIX}/{rid}", value)
+                if replica is not None and replica.ready:
+                    self._replicas[rid] = replica
+                    self._raw[rid] = value
+                else:
+                    # Draining (ready: false) or unparseable: absent
+                    # from the routable set, same as the poll filter.
+                    self._replicas.pop(rid, None)
+                    self._raw.pop(rid, None)
+            self._refreshed_at = time.monotonic()
+            # A delta only arrives on a live, synced stream: the view
+            # is complete again, so a stale episode ends here.
+            count = sum(1 for r in self._replicas
+                        if r not in self._failed)
+            recovered, self._stale = self._stale, False
+            M.ROUTER_REPLICAS.set(count)
+            if recovered:
+                events.emit(events.ROUTER_TABLE_RECOVERED, replicas=count)
+
+    # -- the Watch stream --------------------------------------------------
+
+    def _watch_once(self) -> None:
+        """One Watch-stream lifetime: open (resuming from the last
+        token when the server still retains it), rebuild on RESET..SYNC,
+        patch deltas in place after — the shared ``WatchConsumer``
+        state machine owns the reset batching and token discipline.
+        Returns when the stream ends; raises grpc.RpcError on failure
+        (including UNIMPLEMENTED from a pre-Watch registry — the
+        caller's degrade signal)."""
+        from oim_tpu.registry.watch import WatchConsumer
+
+        address = self._endpoints.current()
+        stub = RegistryStub(self._pool.get(
+            address, self.tls, "component.registry"))
+        consumer = WatchConsumer()
+        consumer.resume_token = self._resume_token
+
+        def rid_of(path: str) -> str | None:
+            parts = path.split("/")
+            return parts[1] if len(parts) == 2 else None
+
+        def install(rows: dict) -> None:
+            fresh, raw = {}, {}
+            for path, value in rows.items():
+                rid = rid_of(path)
+                replica = Replica.parse(path, value)
+                if rid and replica is not None and replica.ready:
+                    fresh[rid] = replica
+                    raw[rid] = value
+            self._install(fresh, raw)
+
+        def put(path: str, value: str) -> None:
+            rid = rid_of(path)
+            if rid:
+                self._apply_delta(rid, value)
+
+        def delete(path: str, expired: bool) -> None:
+            rid = rid_of(path)
+            if rid:
+                self._apply_delta(rid, None)
+
+        def on_sync() -> None:
+            with self._lock:
+                self._refreshed_at = time.monotonic()
+            self._watch_synced = True
+
+        def on_reset() -> None:
+            self._watch_synced = False
+
+        try:
+            call = stub.Watch(pb.WatchRequest(
+                path=SERVE_PREFIX, resume_token=self._resume_token))
+            self._watch_call = call
+            consumer.run(call, install=install, put=put, delete=delete,
+                         on_reset=on_reset, on_sync=on_sync,
+                         is_stopped=self._stop.is_set)
+        except grpc.RpcError as err:
+            self._pool.maybe_evict(err, address)
+            if self._endpoints.multiple and err.code() in FAILOVER_CODES \
+                    and not self._endpoints.apply_hint(err):
+                self._endpoints.advance()
+            raise
+        finally:
+            self._resume_token = consumer.resume_token
+            self._watch_call = None
+            self._watch_synced = False
+
+    def _watch_loop(self) -> None:
+        """Retry Watch streams forever; one UNIMPLEMENTED (pre-Watch
+        registry) retires this thread and leaves the poll in charge."""
+        log = from_context()
+        backoff = ExponentialBackoff(
+            base=max(self.interval / 2, 0.05), cap=10.0)
+        while not self._stop.is_set():
+            try:
+                self._watch_once()
+                backoff.reset()
+                delay = jittered(max(self.interval / 2, 0.05))
+            except grpc.RpcError as err:
+                if err.code() == grpc.StatusCode.UNIMPLEMENTED:
+                    events.emit(events.WATCH_RESYNC,
+                                consumer="router_table",
+                                reason="pre-watch registry: poll mode")
+                    log.warning(
+                        "registry has no Watch RPC; replica table "
+                        "degrades to GetValues polling")
+                    return
+                delay = backoff.next()
+                log.debug("replica watch stream failed; backing off",
+                          registry=self._endpoints.current(),
+                          error=err.code().name,
+                          retry_s=round(delay, 2))
+            if self._stop.wait(delay):
+                return
+
+    def _watch_live(self) -> bool:
+        """A synced stream delivered something recently: the poll can
+        idle. Silence past the guard presumes a black-holed transport —
+        cancel the stream so the watch loop re-dials."""
+        call = self._watch_call
+        if call is None or not self._watch_synced:
+            return False
+        with self._lock:
+            age = time.monotonic() - self._refreshed_at
+        if age > self._watch_silence:
+            call.cancel()
+            return False
+        return True
 
     def _refresh_if_due(self) -> None:
         with self._lock:
@@ -250,7 +422,9 @@ class ReplicaTable:
     # -- lifecycle --------------------------------------------------------
 
     def start(self) -> None:
-        """Begin the jittered background poll."""
+        """Begin the background feeds: the Watch stream (push) and the
+        jittered poll, which idles while a synced stream is live and
+        carries the table alone against a pre-Watch registry."""
         def loop() -> None:
             log = from_context()
             # Shared backoff discipline (common/backoff.py): jitter
@@ -258,6 +432,12 @@ class ReplicaTable:
             # them in lockstep, failures back off exponentially.
             backoff = ExponentialBackoff(base=self.interval, cap=30.0)
             while not self._stop.is_set():
+                if self._watch_live():
+                    # Push is carrying the table: skip the poll tick
+                    # (this is the GetValues load the Watch removes).
+                    if self._stop.wait(jittered(self.interval)):
+                        return
+                    continue
                 try:
                     self.refresh()
                     backoff.reset()
@@ -278,9 +458,19 @@ class ReplicaTable:
         self._thread = threading.Thread(
             target=loop, name="oim-router-table", daemon=True)
         self._thread.start()
+        if self.watch_enabled:
+            self._watch_thread = threading.Thread(
+                target=self._watch_loop, name="oim-router-watch",
+                daemon=True)
+            self._watch_thread.start()
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
+        call = self._watch_call
+        if call is not None:
+            call.cancel()
+        for attr in ("_thread", "_watch_thread"):
+            thread = getattr(self, attr)
+            if thread is not None:
+                thread.join(timeout=5.0)
+                setattr(self, attr, None)

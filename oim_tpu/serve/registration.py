@@ -88,10 +88,16 @@ class ServeRegistration(RegistryRowPublisher):
         tls: TLSConfig | None = None,
         pool: channelpool.ChannelPool | None = None,
     ):
+        # republish_every=1: the load row PUBLISHES every beat, never
+        # batch-renews — the snapshot is the advertisement (load, prefix
+        # hashes), and the router's mark_failed re-admission contract is
+        # "the row CHANGED" (a renewal would freeze a failed-but-alive
+        # replica out for the whole renewal window). The batch path is
+        # for value-stable rows (telemetry/<id>).
         super().__init__(
             serve_key(serve_id), registry_address,
             interval=interval, lease_seconds=lease_seconds,
-            tls=tls, pool=pool)
+            tls=tls, pool=pool, republish_every=1)
         self.serve_id = serve_id
         self.endpoint = endpoint
         self.engine = engine

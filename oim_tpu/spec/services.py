@@ -23,10 +23,13 @@ REGISTRY_METHODS = {
     "SetValue": (pb.SetValueRequest, pb.SetValueReply),
     "GetValues": (pb.GetValuesRequest, pb.GetValuesReply),
     "Heartbeat": (pb.HeartbeatRequest, pb.HeartbeatReply),
+    "Vote": (pb.VoteRequest, pb.VoteReply),
+    "Ack": (pb.AckRequest, pb.AckReply),
 }
 
 REGISTRY_STREAM_METHODS = {
     "Replicate": (pb.ReplicateRequest, pb.ReplicateRecord),
+    "Watch": (pb.WatchRequest, pb.WatchEvent),
 }
 
 CONTROLLER_METHODS = {
@@ -138,6 +141,15 @@ class RegistryServicer:
 
     def Replicate(self, request, context):
         context.abort(grpc.StatusCode.UNIMPLEMENTED, "Replicate not implemented")
+
+    def Watch(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "Watch not implemented")
+
+    def Vote(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "Vote not implemented")
+
+    def Ack(self, request, context):
+        context.abort(grpc.StatusCode.UNIMPLEMENTED, "Ack not implemented")
 
 
 class ControllerServicer:

@@ -383,6 +383,20 @@ class TestSlowRungs:
             events.ROUTER_MARK_FAILED, events.ROUTER_RETRY]
         assert rung["details"]["survivor_served"] > 0
 
+    def test_quorum_leader_kill_rung_converges(self):
+        """The acceptance rung: SIGKILL the 3-node quorum LEADER under
+        live routed serve load — a new leader elected with zero human
+        intervention, writes resume, zero client-visible errors,
+        byte-identical outputs."""
+        from oim_tpu import chaos
+
+        report = chaos.run_ladder(names=["quorum_leader_kill"])
+        [rung] = report["rungs"]
+        assert rung["healed"] == [
+            events.REGISTRY_ELECTION, events.REGISTRY_PROMOTION]
+        assert rung["details"]["byte_identical"] > 0
+        assert rung["details"]["election_term"] >= 2
+
     def test_restart_after_kill_rejoins_and_serves(self):
         """The remaining per-replica fault lever: ``restart()`` boots a
         fresh replica process at the same id (new engine, empty caches,

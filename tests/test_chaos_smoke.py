@@ -2,11 +2,15 @@
 inside the normal (non-slow) test pass — the three fast serving-tier
 rungs (replica SIGKILL -> retry-before-first-token, black-holed channel
 -> pool eviction + redial, page-pool exhaustion -> backpressure-not-
-OOM), each converging on its declared /debug/events heal signature with
-zero client-visible errors, byte-identical routed outputs, and a
-zero-leak census (bench.chaos_smoke() itself raises on any divergence).
-The compound rung and the rest of the ladder run under `make chaos` /
-`pytest -m slow` (tests/test_chaos.py)."""
+OOM) plus the serve-free quorum-registry rungs (symmetric partition ->
+minority step-down + majority election + split-brain census 0; rolling
+restart of all 3 members -> writes resume per hop with ONE Watch stream
+surviving), each converging on its declared /debug/events heal
+signature with zero client-visible errors, byte-identical routed
+outputs, and a zero-leak census (bench.chaos_smoke() itself raises on
+any divergence). The compound rung, the leader-kill-under-load rung and
+the rest of the ladder run under `make chaos` / `pytest -m slow`
+(tests/test_chaos.py)."""
 
 import sys
 from pathlib import Path
@@ -19,14 +23,25 @@ def test_chaos_smoke_rungs_converge_and_fault_points_are_free():
 
     extras = bench.chaos_smoke()  # raises AssertionError on divergence
     assert extras["chaos_rung_names"] == [
-        "replica_kill", "channel_blackhole", "pool_exhaustion"]
+        "replica_kill", "channel_blackhole", "pool_exhaustion",
+        "quorum_partition", "registry_rolling_restart"]
     assert extras["chaos_event_signature"] == [
         ["replica_kill", "router_mark_failed", "router_retry"],
         ["channel_blackhole", "router_mark_failed", "router_retry"],
         ["pool_exhaustion", "page_pool_exhausted"],
+        ["quorum_partition", "registry_election", "registry_promotion",
+         "registry_stepdown"],
+        ["registry_rolling_restart", "registry_election",
+         "registry_promotion"],
     ]
+    serve_free = {"quorum_partition", "registry_rolling_restart"}
     for rung in extras["chaos_report"]:
-        assert rung["census"]["replicas"], rung  # census actually ran
+        if rung["name"] in serve_free:
+            # Registry-only rungs: the census still ran (it checks the
+            # channel pool), there are just no engines to audit.
+            assert "pooled_channels" in rung["census"], rung
+        else:
+            assert rung["census"]["replicas"], rung  # census actually ran
     # The unarmed-fault-point overhead gate (>= 0.90, the
     # obs_overhead_ratio stance) is enforced inside bench.chaos_ladder
     # itself; here we only pin that the smoke recorded it.

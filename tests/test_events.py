@@ -9,6 +9,7 @@ from __future__ import annotations
 import argparse
 import json
 import threading
+import time
 import urllib.request
 
 import grpc
@@ -513,8 +514,22 @@ class TestTelemetryRegistration:
         assert stored == snap
         assert service.leases.remaining("telemetry/host-0") == \
             pytest.approx(12.5, abs=1.0)
-        # Beat counter advances -> the row VALUE changes every beat.
+        # The snapshot is value-stable, so the next beats RENEW by
+        # batched Heartbeat instead of re-publishing: the stored value
+        # (and its beat stamp) stay put while the lease refreshes.
+        time.sleep(0.05)
+        before = service.leases.remaining("telemetry/host-0")
+        assert reg.beat_once()["beat"] == 1
+        assert json.loads(
+            service.db.get("telemetry/host-0"))["beat"] == 1
+        assert service.leases.remaining("telemetry/host-0") > before
+        # ...and the republish bound still forces a full publish (every
+        # 4th beat), so row-changed freshness checks stay bounded.
+        reg.beat_once()
+        reg.beat_once()
         assert reg.beat_once()["beat"] == 2
+        assert json.loads(
+            service.db.get("telemetry/host-0"))["beat"] == 2
 
     def test_stop_deregisters(self, registry):
         from oim_tpu.common.telemetry import TelemetryRegistration

@@ -1,0 +1,172 @@
+"""Tier-1 wiring of `make quorum-smoke`: the 3-member raft-style
+registry quorum (registry/quorum.py) proves its acceptance contract in
+seconds, in-process —
+
+1. three members elect exactly ONE leader (randomized timeouts);
+2. a write is acknowledged only after quorum commit, is readable on a
+   follower, and a follower REFUSES writes with a leader hint;
+3. SIGKILL the leader: the surviving majority elects a new leader with
+   zero human intervention and writes resume through endpoint
+   failover;
+4. a Watch stream opened before the kill survives it — it re-targets a
+   survivor (resume token honored or snapshot-resynced) and delivers
+   both the pre-kill and post-kill rows, no rows missed.
+
+The chaos ladder runs the same machinery under routed serve load and
+under symmetric partition (`make chaos` / tests/test_chaos_smoke.py);
+this file is the fast always-on gate.
+"""
+
+import threading
+import time
+
+import grpc
+import pytest
+
+from oim_tpu.common import tlsutil
+from oim_tpu.common.endpoints import leader_hint
+from oim_tpu.registry import MemRegistryDB, RegistryService
+from oim_tpu.registry.quorum import FOLLOWER, LEADER, QuorumManager
+from oim_tpu.registry.registry import registry_server
+from oim_tpu.spec import RegistryStub, pb
+
+
+def wait_for(predicate, timeout=20.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+@pytest.fixture
+def cluster():
+    services, servers = [], []
+    for _ in range(3):
+        svc = RegistryService(db=MemRegistryDB())
+        servers.append(registry_server("tcp://127.0.0.1:0", svc))
+        services.append(svc)
+    addrs = [srv.addr for srv in servers]
+    managers = [
+        QuorumManager(services[i], node_id=addrs[i],
+                      peers=[a for a in addrs if a != addrs[i]],
+                      election_timeout_s=0.4)
+        for i in range(3)
+    ]
+    for mgr in managers:
+        mgr.start()
+    channels = [tlsutil.dial(a, None) for a in addrs]
+    stubs = [RegistryStub(ch) for ch in channels]
+    try:
+        yield services, servers, managers, stubs, addrs
+    finally:
+        for mgr in managers:
+            mgr.stop()
+        for ch in channels:
+            ch.close()
+        for srv in servers:
+            srv.force_stop()
+
+
+def _leader_index(managers) -> int | None:
+    leaders = [i for i, m in enumerate(managers) if m.role == LEADER]
+    return leaders[0] if len(leaders) == 1 else None
+
+
+def test_quorum_smoke(cluster):
+    services, servers, managers, stubs, addrs = cluster
+
+    # 1. exactly one leader.
+    assert wait_for(lambda: _leader_index(managers) is not None), \
+        "no single leader elected"
+    li = _leader_index(managers)
+
+    # A Watch stream on a FOLLOWER, opened before any fault: it must
+    # survive the leader kill below.
+    fi = (li + 1) % 3
+    seen: dict[str, str] = {}
+    synced = threading.Event()
+    stop = threading.Event()
+
+    def watch_loop():
+        from oim_tpu.registry import watch as W
+
+        token = ""
+        while not stop.is_set():
+            for i in range(3):
+                if stop.is_set():
+                    return
+                try:
+                    for ev in stubs[(fi + i) % 3].Watch(
+                            pb.WatchRequest(path="smoke",
+                                            resume_token=token)):
+                        if stop.is_set():
+                            return
+                        token = ev.resume_token or token
+                        if ev.kind == W.KIND_PUT:
+                            seen[ev.value.path] = ev.value.value
+                        elif ev.kind in (W.KIND_DELETE, W.KIND_EXPIRED):
+                            seen.pop(ev.value.path, None)
+                        elif ev.kind == W.KIND_SYNC:
+                            synced.set()
+                except grpc.RpcError:
+                    continue
+
+    watcher = threading.Thread(target=watch_loop, daemon=True)
+    watcher.start()
+    assert synced.wait(10), "watch stream never synced"
+
+    # 2. quorum-committed write: visible on a follower, refused BY a
+    # follower (with the leader named in the rejection).
+    stubs[li].SetValue(pb.SetValueRequest(value=pb.Value(
+        path="smoke/pre-kill", value="1")), timeout=10)
+    assert wait_for(lambda: any(
+        v.path == "smoke/pre-kill"
+        for v in stubs[fi].GetValues(
+            pb.GetValuesRequest(path="smoke"), timeout=5).values)), \
+        "committed write never reached the follower"
+    with pytest.raises(grpc.RpcError) as err:
+        stubs[fi].SetValue(pb.SetValueRequest(value=pb.Value(
+            path="smoke/follower-write", value="x")), timeout=5)
+    assert err.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+    assert leader_hint(err.value) == addrs[li], \
+        f"rejection named {leader_hint(err.value)!r}, not the leader"
+
+    # 3. SIGKILL the leader: majority elects, writes resume unaided.
+    managers[li].stop()
+    servers[li].force_stop()
+    survivors = [m for i, m in enumerate(managers) if i != li]
+    assert wait_for(
+        lambda: sum(1 for m in survivors if m.role == LEADER) == 1), \
+        "no new leader after SIGKILL"
+    assert all(m.role in (LEADER, FOLLOWER) for m in survivors)
+    new_leader = next(m for m in survivors if m.role == LEADER)
+    assert new_leader.term > managers[li].term - 1, "term never advanced"
+
+    def write_resumes():
+        for i in range(3):
+            if i == li:
+                continue
+            try:
+                stubs[i].SetValue(pb.SetValueRequest(value=pb.Value(
+                    path="smoke/post-kill", value="2")), timeout=5)
+                return True
+            except grpc.RpcError:
+                continue
+        return False
+
+    assert wait_for(write_resumes, timeout=15), \
+        "writes never resumed after the leader kill"
+    # Pre-kill state survived the failover on the survivors.
+    ni = managers.index(new_leader)
+    values = {v.path: v.value for v in stubs[ni].GetValues(
+        pb.GetValuesRequest(path="smoke"), timeout=5).values}
+    assert values.get("smoke/pre-kill") == "1"
+    assert values.get("smoke/post-kill") == "2"
+
+    # 4. the Watch stream survived: both rows delivered, none missed.
+    assert wait_for(lambda: seen.get("smoke/pre-kill") == "1"
+                    and seen.get("smoke/post-kill") == "2", timeout=15), \
+        f"watch stream missed rows across the failover: {seen}"
+    stop.set()
