@@ -9,7 +9,7 @@
 PY ?= python
 RUFF := $(shell command -v ruff 2>/dev/null)
 
-.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke prefix-smoke paged-smoke spec-smoke chaos chaos-smoke quorum-smoke control-plane-bench
+.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke slo-smoke prefix-smoke paged-smoke spec-smoke chaos chaos-smoke quorum-smoke control-plane-bench
 
 # drift and tsan are standalone conveniences; the full pytest target
 # already runs both (SpecDrift + the TSAN stream test build in-fixture).
@@ -108,6 +108,17 @@ spec-smoke:
 # obs_overhead_ratio. Also runs in tier-1 as tests/test_obs_smoke.py.
 obs-smoke:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --obs-smoke
+
+# Fleet-SLO-plane acceptance loop (seconds): merged fleet p99 within
+# one bucket of the pooled-observation ground truth across a replica
+# restart (counter-reset epochs), a degraded replica firing exactly one
+# TTL-leased alert/<name> row — observed arriving over a registry Watch
+# stream, resolving after heal with one fired/resolved event pair (the
+# debounce contract) — and `oimctl --autopsy` attributing >=90% of one
+# REAL routed request's wall time to named phases. Also runs in tier-1
+# as tests/test_slo_smoke.py.
+slo-smoke:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --slo-smoke
 
 # Chaos ladder (minutes): seeded, scripted fault schedules over an
 # in-process cluster sim — replica SIGKILL, black-holed channel,

@@ -172,23 +172,11 @@ def parse_exemplars(text: str) -> list[tuple[str, str]]:
 
 def _histogram_quantile(buckets: list[tuple[float, float]], q: float) -> float:
     """Linear interpolation over cumulative le-buckets (the PromQL
-    histogram_quantile estimate)."""
-    if not buckets:
-        return float("nan")
-    total = buckets[-1][1]
-    if total <= 0:
-        return float("nan")
-    rank = q * total
-    prev_bound, prev_count = 0.0, 0.0
-    for bound, count in buckets:
-        if count >= rank:
-            if bound == float("inf"):
-                return prev_bound
-            span = count - prev_count
-            frac = (rank - prev_count) / span if span else 1.0
-            return prev_bound + (bound - prev_bound) * frac
-        prev_bound, prev_count = bound, count
-    return prev_bound
+    histogram_quantile estimate) — the shared obs/merge.py math, so the
+    scrape summaries here and the fleet merge can never disagree."""
+    from oim_tpu.obs.merge import bucket_quantile
+
+    return bucket_quantile(buckets, q)
 
 
 def print_metrics(target: str) -> None:
@@ -314,10 +302,13 @@ def print_events(target: str, trace: str = "", type_: str = "") -> None:
 # -- oimctl --top: the live cluster table -----------------------------------
 
 
-def telemetry_rows(stub) -> list[tuple[str, str, str, str]]:
-    """(id, ALIVE|STALE, role, metrics endpoint) per ``telemetry/<id>``
-    registry row — the self-published discovery rows every daemon's
-    observability plane maintains (common/telemetry.py)."""
+def telemetry_rows(stub) -> list[tuple[str, str, str, str, dict]]:
+    """(id, ALIVE|STALE, role, metrics endpoint, row body) per
+    ``telemetry/<id>`` registry row — the self-published discovery rows
+    every daemon's observability plane maintains (common/telemetry.py).
+    The row body carries the fleet-mergeable ``hist``/``counters``
+    payload the --top ALL row folds (empty dict for pre-upgrade
+    daemons, which dash-degrade)."""
     import json
 
     from oim_tpu.common.pathutil import REGISTRY_TELEMETRY
@@ -344,6 +335,7 @@ def telemetry_rows(stub) -> list[tuple[str, str, str, str]]:
             "ALIVE" if value.path in live else "STALE",
             str(snap.get("role", "?")),
             str(snap.get("metrics", "")),
+            snap,
         ))
     return rows
 
@@ -369,7 +361,7 @@ def _series_quantiles(samples, name: str, labels: dict,
 
 
 def top_row(row_id: str, status: str, role: str, target: str,
-            http_get=_http_get) -> dict:
+            snap: dict | None = None, http_get=_http_get) -> dict:
     """One `--top` table row: scrape ``target``'s /metrics +
     /debug/events and distill the columns. STALE/unreachable rows
     degrade to placeholders — a dead daemon must still show up (that it
@@ -461,6 +453,45 @@ def top_row(row_id: str, status: str, role: str, target: str,
     return row
 
 
+def fleet_top_row(entries) -> dict:
+    """The synthesized ALL row: merged fleet percentiles folded from the
+    histogram snapshots riding the telemetry rows themselves — no scrape
+    fan-out, and a registry read (or Watch view) is the only input.
+    Pre-upgrade daemons publish no snapshot and simply don't contribute;
+    with none contributing every fleet column dashes (the mixed-version
+    stance). ``entries`` are telemetry_rows()/TelemetryWatch.rows()
+    5-tuples."""
+    from oim_tpu.obs import merge
+
+    row = {"id": "ALL", "status": "-", "role": "fleet", "qps": None,
+           "ft_ms": (None, None), "it_ms": (None, None), "queue": None,
+           "slots": None, "cache_hit": None, "prefix_hit": None,
+           "pages": None, "accept": None, "repl_lag": None,
+           "spread": None, "events": {}}
+    snapshots: dict[str, list] = {"first_token": [], "inter_token": []}
+    contributors = 0
+    for entry in entries:
+        snap = entry[4] if len(entry) > 4 else None
+        hist = snap.get("hist") if isinstance(snap, dict) else None
+        if not isinstance(hist, dict):
+            continue
+        if any(key in hist for key in snapshots):
+            contributors += 1
+        for key in snapshots:
+            if key in hist:
+                snapshots[key].append(hist[key])
+    for key, col in (("first_token", "ft_ms"), ("inter_token", "it_ms")):
+        merged = merge.merge_snapshots(snapshots[key])
+        if merged is not None and merge.total(merged) > 0:
+            row[col] = (merge.quantile(merged, 0.5) * 1e3,
+                        merge.quantile(merged, 0.99) * 1e3)
+    # SPREAD doubles as "how many rows fed the fleet fold" — the
+    # dash-vs-number that separates a quiet fleet from a pre-upgrade one.
+    if contributors:
+        row["spread"] = contributors
+    return row
+
+
 def render_top(rows: list[dict]) -> str:
     """The cluster table, one daemon per line."""
     def fmt(v, pattern="{:.2g}"):
@@ -505,22 +536,20 @@ def render_top(rows: list[dict]) -> str:
         for row in table)
 
 
-class TelemetryWatch:
-    """``--top --watch N`` rides ONE ``Watch("telemetry")`` stream: the
-    row set is maintained push-style in a background thread and every
-    refresh renders from it, instead of re-issuing two GetValues reads
-    per period. EXPIRED rows flip to STALE (the poll path's
-    include_stale view) rather than vanishing; DELETE removes. Against
-    a pre-Watch registry the stream dies UNIMPLEMENTED and the caller
-    degrades to the poll path — the PAGES/ACCEPT mixed-version
-    stance."""
+class _PrefixWatch:
+    """One background ``Watch(<prefix>)`` stream feeding a cached view:
+    the plumbing (thread, resume token, UNIMPLEMENTED degrade, sync
+    gate) shared by the --top row watch and the FIRING-banner alert
+    watch, so a ``--watch N`` session issues ZERO per-refresh reads.
+    Subclasses implement the view callbacks."""
+
+    PREFIX = ""
 
     def __init__(self, with_failover):
         import threading
 
         self._with_failover = with_failover
         self._lock = threading.Lock()
-        self._rows: dict[str, tuple[str, str, str]] = {}
         self._synced = threading.Event()
         self._unsupported = threading.Event()
         self._stop = threading.Event()
@@ -529,16 +558,24 @@ class TelemetryWatch:
         self._thread.start()
 
     @staticmethod
-    def _parse(value: str) -> tuple[str, str]:
+    def _parse_body(value: str) -> dict:
         import json
 
         try:
-            snap = json.loads(value)
+            body = json.loads(value)
         except ValueError:
-            snap = {}
-        if not isinstance(snap, dict):
-            snap = {}
-        return str(snap.get("role", "?")), str(snap.get("metrics", ""))
+            body = {}
+        return body if isinstance(body, dict) else {}
+
+    # Subclass view callbacks (called with paths/values off the stream).
+    def _install(self, rows: dict) -> None:
+        raise NotImplementedError
+
+    def _put(self, path: str, value: str) -> None:
+        raise NotImplementedError
+
+    def _delete(self, path: str, expired: bool) -> None:
+        raise NotImplementedError
 
     def _consume(self, stub) -> None:
         # The shared Watch-client state machine (registry/watch.py):
@@ -547,37 +584,11 @@ class TelemetryWatch:
 
         consumer = WatchConsumer()
         consumer.resume_token = self._token
-
-        def entry(path: str, value: str) -> tuple[str, str, str, str]:
-            rid = path.partition("/")[2]
-            role, metrics = self._parse(value)
-            return (rid, "ALIVE", role, metrics)
-
-        def install(rows: dict) -> None:
-            with self._lock:
-                self._rows = {path.partition("/")[2]: entry(path, value)
-                              for path, value in rows.items()}
-
-        def put(path: str, value: str) -> None:
-            with self._lock:
-                self._rows[path.partition("/")[2]] = entry(path, value)
-
-        def delete(path: str, expired: bool) -> None:
-            rid = path.partition("/")[2]
-            with self._lock:
-                if expired and rid in self._rows:
-                    # The poll path's include_stale view: an expired
-                    # row flips STALE instead of vanishing.
-                    _, _, role, metrics = self._rows[rid]
-                    self._rows[rid] = (rid, "STALE", role, metrics)
-                elif not expired:
-                    self._rows.pop(rid, None)
-
         try:
             call = stub.Watch(pb.WatchRequest(
-                path="telemetry", resume_token=self._token))
-            consumer.run(call, install=install, put=put, delete=delete,
-                         on_sync=self._synced.set,
+                path=self.PREFIX, resume_token=self._token))
+            consumer.run(call, install=self._install, put=self._put,
+                         delete=self._delete, on_sync=self._synced.set,
                          is_stopped=self._stop.is_set)
         finally:
             self._token = consumer.resume_token
@@ -602,23 +613,170 @@ class TelemetryWatch:
             return False
         return self._synced.wait(timeout)
 
-    def rows(self) -> list[tuple[str, str, str, str]]:
-        with self._lock:
-            return [self._rows[k] for k in sorted(self._rows)]
-
     def stop(self) -> None:
         self._stop.set()
 
 
-def print_top(with_failover, watch: float = 0.0) -> None:
-    """Poll every advertised telemetry endpoint and render one cluster
-    table; ``watch`` > 0 refreshes on that period until interrupted —
-    discovering rows over one Watch stream when the registry supports
-    it (one stream for the whole session, not two GetValues reads per
-    refresh), degrading to the GetValues poll otherwise."""
+class TelemetryWatch(_PrefixWatch):
+    """``--top --watch N`` rides ONE ``Watch("telemetry")`` stream: the
+    row set is maintained push-style in a background thread and every
+    refresh renders from it, instead of re-issuing two GetValues reads
+    per period. EXPIRED rows flip to STALE (the poll path's
+    include_stale view) rather than vanishing; DELETE removes. Against
+    a pre-Watch registry the stream dies UNIMPLEMENTED and the caller
+    degrades to the poll path — the PAGES/ACCEPT mixed-version
+    stance."""
+
+    PREFIX = "telemetry"
+
+    def __init__(self, with_failover):
+        self._rows: dict[str, tuple[str, str, str, str, dict]] = {}
+        super().__init__(with_failover)
+
+    @classmethod
+    def _entry(cls, path: str,
+               value: str) -> tuple[str, str, str, str, dict]:
+        rid = path.partition("/")[2]
+        snap = cls._parse_body(value)
+        return (rid, "ALIVE", str(snap.get("role", "?")),
+                str(snap.get("metrics", "")), snap)
+
+    def _install(self, rows: dict) -> None:
+        with self._lock:
+            self._rows = {path.partition("/")[2]: self._entry(path, value)
+                          for path, value in rows.items()}
+
+    def _put(self, path: str, value: str) -> None:
+        with self._lock:
+            self._rows[path.partition("/")[2]] = self._entry(path, value)
+
+    def _delete(self, path: str, expired: bool) -> None:
+        rid = path.partition("/")[2]
+        with self._lock:
+            if expired and rid in self._rows:
+                # The poll path's include_stale view: an expired
+                # row flips STALE instead of vanishing.
+                _, _, role, metrics, snap = self._rows[rid]
+                self._rows[rid] = (rid, "STALE", role, metrics, snap)
+            elif not expired:
+                self._rows.pop(rid, None)
+
+    def rows(self) -> list[tuple[str, str, str, str, dict]]:
+        with self._lock:
+            return [self._rows[k] for k in sorted(self._rows)]
+
+
+class AlertWatch(_PrefixWatch):
+    """The FIRING banner's ``Watch("alert")`` stream: a firing alert
+    row lands in the banner the moment the monitor publishes it, an
+    expiry (dead monitor) or delete (resolution) clears it — no
+    per-refresh GetValues. Exactly the consumer shape the autoscaler
+    will use."""
+
+    PREFIX = "alert"
+
+    def __init__(self, with_failover):
+        self._alerts: dict[str, dict] = {}
+        super().__init__(with_failover)
+
+    def _install(self, rows: dict) -> None:
+        with self._lock:
+            self._alerts = {
+                path.partition("/")[2]: self._parse_body(value)
+                for path, value in rows.items()}
+
+    def _put(self, path: str, value: str) -> None:
+        with self._lock:
+            self._alerts[path.partition("/")[2]] = self._parse_body(value)
+
+    def _delete(self, path: str, expired: bool) -> None:
+        # Resolution deletes the row; a dead monitor's rows expire.
+        # Either way the alert is no longer being asserted.
+        with self._lock:
+            self._alerts.pop(path.partition("/")[2], None)
+
+    def rows(self) -> list[tuple[str, dict]]:
+        with self._lock:
+            return sorted(self._alerts.items())
+
+
+def alert_rows(stub) -> list[tuple[str, dict]]:
+    """(name, alert body) per live ``alert/<name>`` registry row — the
+    TTL-leased rows oim-monitor publishes while an SLO burns (the lease
+    filter drops a dead monitor's alerts automatically)."""
+    from oim_tpu.common.pathutil import REGISTRY_ALERT
+
+    return sorted(
+        (value.path.partition("/")[2], _PrefixWatch._parse_body(value.value))
+        for value in stub.GetValues(
+            pb.GetValuesRequest(path=REGISTRY_ALERT), timeout=10).values)
+
+
+def print_alerts(with_failover) -> None:
+    """Render the firing alert rows: one line per alert — burn rates,
+    threshold, the objective breached, and how long it has burned."""
     import time
 
+    rows = with_failover(alert_rows)
+    if not rows:
+        print("no alerts firing (oim-monitor publishes alert/<name> "
+              "rows while an SLO's burn rate breaches)")
+        return
+    for name, body in rows:
+        since = body.get("since")
+        age = f"{max(time.time() - since, 0):.0f}s" if since else "?"
+        detail = ""
+        if body.get("kind") == "latency":
+            detail = (f" target p{body.get('objective', 0) * 100:.0f}"
+                      f"<={float(body.get('threshold_s', 0)) * 1e3:.0f}ms")
+        print(f"{name}\tFIRING\tburn_fast={body.get('burn_fast', '?')}"
+              f"\tburn_slow={body.get('burn_slow', '?')}"
+              f"\tthreshold={body.get('threshold', '?')}"
+              f"\tfor={age}{detail}")
+
+
+def print_autopsy(with_failover, trace_id: str) -> None:
+    """One request's phase-attributed timeline: discover the fleet's
+    debug endpoints from the live telemetry rows, fan out to
+    /debug/spans + /debug/events, and render where the wall time went
+    (obs/autopsy.py)."""
+    from oim_tpu.obs import autopsy
+
+    entries = with_failover(telemetry_rows)
+    # STALE rows ride too: a lease lapse (or a registry blip flipping
+    # everything stale) doesn't mean the daemon's /debug endpoints are
+    # gone — and a post-mortem autopsy WANTS the dead daemon's spans.
+    # collect() already skips genuinely unreachable targets.
+    targets = [e[3] for e in entries if e[3]]
+    if not targets:
+        raise SystemExit(
+            "--autopsy: no telemetry/<id> rows advertise a metrics "
+            "endpoint to walk")
+    try:
+        report = autopsy.autopsy(trace_id, targets)
+    except ValueError as err:
+        raise SystemExit(f"--autopsy: {err}") from err
+    print(autopsy.render(report))
+
+
+def print_top(with_failover, watch: float = 0.0) -> None:
+    """Poll every advertised telemetry endpoint and render one cluster
+    table — a synthesized ALL row (fleet-merged percentiles from the
+    rows' histogram snapshots) above the per-daemon rows, and a FIRING
+    banner when any alert/<name> row is live; ``watch`` > 0 refreshes
+    on that period until interrupted — discovering rows over one Watch
+    stream when the registry supports it (one stream for the whole
+    session, not two GetValues reads per refresh), degrading to the
+    GetValues poll otherwise."""
+    import time
+
+    import grpc as grpc_mod
+
     watcher = TelemetryWatch(with_failover) if watch > 0 else None
+    # The banner rides its own alert stream in watch mode — a --watch
+    # session must not re-add a per-refresh GetValues for alerts after
+    # the telemetry stream removed the row reads.
+    alert_watcher = AlertWatch(with_failover) if watch > 0 else None
     first = True
     try:
         while True:
@@ -627,10 +785,24 @@ def print_top(with_failover, watch: float = 0.0) -> None:
                 entries = watcher.rows()
             else:
                 entries = with_failover(telemetry_rows)
+            if alert_watcher is not None and alert_watcher.usable(
+                    timeout=2.0 if first else 0.0):
+                firing = alert_watcher.rows()
+            else:
+                try:
+                    firing = with_failover(alert_rows)
+                except grpc_mod.RpcError:
+                    firing = []  # the table must render through a blip
             first = False
             rows = [top_row(*entry) for entry in entries]
+            if rows:
+                rows.insert(0, fleet_top_row(entries))
             if watch > 0:
                 print("\033[2J\033[H", end="")  # clear + home, like top(1)
+            if firing:
+                names = ", ".join(name for name, _ in firing)
+                print(f"*** FIRING: {names} (oimctl --alerts for "
+                      f"detail) ***")
             if rows:
                 print(render_top(rows))
             else:
@@ -646,6 +818,8 @@ def print_top(with_failover, watch: float = 0.0) -> None:
     finally:
         if watcher is not None:
             watcher.stop()
+        if alert_watcher is not None:
+            alert_watcher.stop()
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -727,12 +901,32 @@ def main(argv: list[str] | None = None) -> int:
              "per-refresh GetValues); degrades to polling against a "
              "pre-Watch registry",
     )
+    parser.add_argument(
+        "--alerts",
+        action="store_true",
+        help="list the firing SLO alerts (the TTL-leased alert/<name> "
+             "rows oim-monitor publishes while a burn rate breaches): "
+             "burn_fast/burn_slow, threshold, and how long each has "
+             "fired",
+    )
+    parser.add_argument(
+        "--autopsy",
+        default=None,
+        metavar="TRACE_ID",
+        help="phase-attributed latency timeline for one request: fans "
+             "out to every live daemon's /debug/spans + /debug/events "
+             "(discovered from the telemetry rows) and renders where "
+             "the trace's wall time went — router pick + retries, "
+             "admission queue, prefill (prefix hit/miss), decode "
+             "cadence — with unattributed gap time called out",
+    )
     add_common_flags(parser)
     args = parser.parse_args(argv)
     setup_logging(args)
     requested_registry_ops = (
         args.set is not None or args.get is not None or args.health
-        or args.promote or args.top)
+        or args.promote or args.top or args.alerts
+        or args.autopsy is not None)
     if args.metrics is not None:
         print_metrics(args.metrics)
     if args.events is not None:
@@ -836,13 +1030,17 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{cid}\t{status}\t{address}\t{mesh}")
         for key, status, endpoint, load in serve_rows:
             print(f"{key}\t{status}\t{endpoint}\t{load}")
+    if args.alerts:
+        print_alerts(with_failover)
+    if args.autopsy is not None:
+        print_autopsy(with_failover, args.autopsy)
     if args.top:
         print_top(with_failover, watch=args.watch)
     if not requested_registry_ops and args.metrics is None \
             and args.events is None:
         raise SystemExit(
             "nothing to do: pass --get, --set, --health, --promote, "
-            "--top, --metrics and/or --events")
+            "--top, --alerts, --autopsy, --metrics and/or --events")
     return 0
 
 

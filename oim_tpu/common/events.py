@@ -70,6 +70,13 @@ STAGE_CACHE_EVICTION = "stage_cache_eviction"
 SLOT_EVICTED = "slot_evicted"
 PAGE_POOL_EXHAUSTED = "page_pool_exhausted"
 SPEC_FALLBACK = "spec_fallback"
+# Fleet SLO plane (oim_tpu/obs/slo.py): a declared SLO's multi-window
+# burn rate crossed the alert threshold / dropped back under it for the
+# resolve-hysteresis hold. One fired per EPISODE however often the burn
+# rate flaps across the line (the page_pool_exhausted debounce stance),
+# so fired/resolved events always arrive in matched pairs.
+SLO_ALERT_FIRED = "slo_alert_fired"
+SLO_ALERT_RESOLVED = "slo_alert_resolved"
 
 DEFAULT_CAPACITY = 2048
 

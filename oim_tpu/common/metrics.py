@@ -130,6 +130,22 @@ class _HistogramValue:
         with self._lock:
             return tuple(self._buckets), tuple(self._counts), self._count
 
+    def snapshot(self) -> dict:
+        """The MERGEABLE wire snapshot (obs/merge.py format): shared
+        ``le`` grid, CUMULATIVE counts with the +Inf total last, and the
+        observation sum — what telemetry rows publish so the fleet SLO
+        plane can fold N replicas into one true fleet histogram."""
+        with self._lock:
+            counts = list(self._counts)
+            total, total_sum = self._count, self._sum
+        cumulative, running = [], 0
+        for n in counts:
+            running += n
+            cumulative.append(running)
+        cumulative.append(total)
+        return {"le": list(self._buckets), "counts": cumulative,
+                "sum": total_sum}
+
     @staticmethod
     def _exemplar_suffix(ex: tuple[str, float, float] | None) -> str:
         # OpenMetrics exemplar: `# {trace_id="..."} <value> <timestamp>`.
@@ -211,6 +227,14 @@ class Counter:
                 f"{self.name} is labeled {self.labelnames}; use .labels()")
         return self._children[()]
 
+    def labeled_values(self) -> dict[tuple[str, ...], float]:
+        """label-values tuple -> current value, for every child (the
+        programmatic read telemetry snapshots use; () keys the sole
+        child of an unlabeled metric)."""
+        with self._family_lock:
+            children = list(self._children.items())
+        return {key: child.value for key, child in children}
+
     # Unlabeled passthroughs (the original API).
     def inc(self, amount: float = 1.0) -> None:
         self._solo().inc(amount)
@@ -266,6 +290,39 @@ class Histogram(Counter):
     @property
     def value(self) -> float:
         return float(self._solo().count)
+
+    def merged_snapshot(self, label_filter: dict | None = None,
+                        skip=None) -> dict:
+        """One mergeable snapshot (obs/merge.py format) summing every
+        child whose labels match ``label_filter`` (None = all children);
+        ``skip(labels) -> bool`` excludes children (the telemetry
+        payload drops the row-renewal RPCs that would otherwise make
+        every snapshot differ from the last). Children of one family
+        share the bucket grid by construction, so the sum is exact —
+        this is how a labeled histogram (token latency by kind, RPC
+        latency by method/code) publishes ONE fleet-mergeable series
+        per telemetry row."""
+        want = {k: str(v) for k, v in (label_filter or {}).items()}
+        with self._family_lock:
+            children = list(self._children.items())
+        out: dict | None = None
+        for key, child in children:
+            labels = dict(zip(self.labelnames, key))
+            if any(labels.get(k) != v for k, v in want.items()):
+                continue
+            if skip is not None and skip(labels):
+                continue
+            snap = child.snapshot()
+            if out is None:
+                out = snap
+            else:
+                out["counts"] = [a + b for a, b in
+                                 zip(out["counts"], snap["counts"])]
+                out["sum"] += snap["sum"]
+        if out is None:
+            counts = [0] * (len(self.buckets) + 1)
+            out = {"le": list(self.buckets), "counts": counts, "sum": 0.0}
+        return out
 
 
 class Registry:
@@ -582,6 +639,18 @@ EVENTS_TOTAL = DEFAULT.counter(
     "feeder_failover, registry_promotion, router_retry, replica_drain, "
     "stage_cache_eviction, slot_evicted, ...)",
     labelnames=("type",))
+# Fleet SLO plane (oim_tpu/obs: burn-rate evaluation over fleet-merged
+# telemetry snapshots; the oim-monitor daemon records these).
+SLO_BURN_RATE = DEFAULT.gauge(
+    "oim_slo_burn_rate",
+    "fast-window error-budget burn rate per declared SLO (bad_fraction "
+    "/ error_budget over the fast window; the alert condition ANDs this "
+    "with the slow window — Google-SRE multi-window burn)",
+    labelnames=("slo",))
+SLO_ALERTS_FIRING = DEFAULT.gauge(
+    "oim_slo_alerts_firing",
+    "SLO alerts currently in a firing episode on this monitor (each is "
+    "mirrored as a TTL-leased alert/<name> registry row)")
 # Labeled RPC telemetry (common/tracing.py interceptors — the
 # go-grpc-prometheus analog; recorded by client and server vantage alike).
 RPC_LATENCY = DEFAULT.histogram(

@@ -22,6 +22,13 @@ REGISTRY_SERVE = "serve"
 # discovers every live metrics endpoint from one registry read. Reserved
 # exactly like ``serve``: no controller may register under this id.
 REGISTRY_TELEMETRY = "telemetry"
+# Top-level namespace for the fleet SLO plane: ``alert/<name>`` -> JSON
+# alert body, published TTL-leased by oim-monitor while the SLO's burn
+# rate breaches (oim_tpu/obs/monitor.py). Consumers (oimctl --alerts,
+# the --top FIRING banner, a future autoscaler) read the lease-filtered
+# prefix; a dead monitor's alerts expire with their lease. Reserved like
+# ``serve``/``telemetry``: no controller may register under this id.
+REGISTRY_ALERT = "alert"
 
 
 def split_registry_path(path: str) -> list[str]:

@@ -48,6 +48,53 @@ def telemetry_key(telemetry_id: str) -> str:
     return f"{REGISTRY_TELEMETRY}/{telemetry_id}"
 
 
+def metrics_snapshot() -> dict:
+    """The fleet-mergeable metrics payload a telemetry row carries each
+    beat (oim_tpu/obs/merge.py snapshot format): cumulative bucket
+    snapshots of the latency histograms the SLO plane merges, plus the
+    ``requests_total{outcome}`` counters the availability SLO needs.
+
+    Every daemon publishes ``rpc`` (the interceptors record it on every
+    process); the serve-side series ride only when they have
+    observations (a router's zero first-token histogram is dead weight
+    in every heartbeat, and absence is what keeps non-serving roles'
+    rows small). A pre-upgrade daemon simply publishes no ``hist`` at
+    all — consumers dash-degrade (the mixed-version stance).
+
+    The ``rpc`` series EXCLUDES the registry row-renewal methods
+    (SetValue / Heartbeat): the publisher's own beat records an RPC
+    latency sample, so including them would make every snapshot differ
+    from the last and silently demote every value-stable row from
+    batched Heartbeat renewal back to publish-every-beat — the
+    instrument observing itself. The data-path methods the RPC SLO
+    cares about (Generate, ReadVolume, Watch, MapVolume, ...) all
+    ride."""
+    from oim_tpu.common import metrics as M
+
+    renewal = {"oim.v1.Registry/SetValue", "oim.v1.Registry/Heartbeat"}
+    hist = {
+        "rpc": M.RPC_LATENCY.merged_snapshot(
+            skip=lambda labels: labels.get("method") in renewal),
+    }
+    for key, family, labels in (
+            ("first_token", M.SERVE_TOKEN_LATENCY, {"kind": "first"}),
+            ("inter_token", M.SERVE_TOKEN_LATENCY, {"kind": "next"}),
+            ("queue_wait", M.SERVE_QUEUE_WAIT, None),
+    ):
+        snap = family.merged_snapshot(labels)
+        if snap["counts"][-1] > 0:
+            hist[key] = snap
+    payload: dict = {"hist": hist}
+    requests = {
+        key[0]: value
+        for key, value in M.SERVE_REQUESTS_TOTAL.labeled_values().items()
+        if value > 0
+    }
+    if requests:
+        payload["counters"] = {"requests_total": requests}
+    return payload
+
+
 class RegistryRowPublisher:
     """Publish-and-renew loop for one TTL-leased registry row.
 
@@ -246,11 +293,20 @@ class RegistryRowPublisher:
 
 
 class TelemetryRegistration(RegistryRowPublisher):
-    """One daemon's ``telemetry/<id>`` row: metrics endpoint + role.
+    """One daemon's ``telemetry/<id>`` row: metrics endpoint + role +
+    the fleet-mergeable metrics payload (``hist``/``counters``, see
+    ``metrics_snapshot``) the SLO plane folds.
 
     ``oimctl --top`` reads the lease-filtered ``telemetry`` prefix and
     scrapes every advertised endpoint — the cluster view needs no static
-    target list, and dead daemons fall out with their lease."""
+    target list, and dead daemons fall out with their lease. The
+    histogram snapshots ride the SAME heartbeat (the aggregation plane
+    adds zero new RPCs, per the control-off-the-data-path stance): a
+    beat with new observations re-publishes, an idle daemon's unchanged
+    row still batch-renews. ``collect`` overrides the payload source
+    (tests, and processes whose metrics live off the DEFAULT registry);
+    ``collect=None`` publishes discovery-only rows (the pre-SLO wire
+    shape)."""
 
     THREAD_NAME = "oim-telemetry"
 
@@ -264,6 +320,7 @@ class TelemetryRegistration(RegistryRowPublisher):
         lease_seconds: float = 0.0,
         tls: TLSConfig | None = None,
         pool: channelpool.ChannelPool | None = None,
+        collect=metrics_snapshot,
     ):
         super().__init__(
             telemetry_key(telemetry_id), registry_address,
@@ -272,13 +329,17 @@ class TelemetryRegistration(RegistryRowPublisher):
         self.telemetry_id = telemetry_id
         self.role = role
         self.metrics_endpoint = metrics_endpoint
+        self.collect = collect
 
     def snapshot(self) -> dict:
-        return {
+        snap = {
             "metrics": self.metrics_endpoint,
             "role": self.role,
             "pid": os.getpid(),
         }
+        if self.collect is not None:
+            snap.update(self.collect())
+        return snap
 
 
 def telemetry_snapshot(role: str, metrics_endpoint: str,

@@ -348,6 +348,29 @@ def start_span(name: str, parent: SpanContext | None = None,
         _recorder.record(span)
 
 
+def record_phase(name: str, start_unix: float, duration: float,
+                 parent: SpanContext | None = None, **attrs: Any) -> Span:
+    """Record a span for a phase measured AFTER the fact — a block whose
+    boundaries were timestamps, not a ``with`` scope (the serve engine's
+    queue-wait and decode phases are bookkept per request and only known
+    complete at retirement). The span lands in the ring and the export
+    stream exactly like a live one; ``oimctl --autopsy`` attributes the
+    request timeline from these."""
+    if parent is None:
+        parent = current_context()
+    if parent is None:
+        ctx = SpanContext(_new_trace_id(), _new_span_id())
+        parent_id = ""
+    else:
+        ctx = SpanContext(parent.trace_id, _new_span_id())
+        parent_id = parent.span_id
+    span = Span(name, ctx, parent_id, attrs)
+    span.start_unix = start_unix
+    span.duration = max(duration, 0.0)
+    _recorder.record(span)
+    return span
+
+
 # -- metadata propagation --------------------------------------------------
 
 

@@ -96,6 +96,143 @@ class PublishedVolume:
     request: Any = None  # the original MapVolumeRequest (heal re-publish)
 
 
+class _AddressWatch:
+    """Push-fed resolver for ONE controller's ``<id>/address`` key.
+
+    PR 14's named follow-up: the feeder's direct-path resolver was the
+    last point-to-point GetValues poll in the data plane — every
+    DIRECT_TTL_S per feeder, fleet-wide. This rides one Watch stream on
+    the single address key instead (a full registry path is a valid
+    prefix), so an address move or lease expiry reaches the resolver
+    the moment it commits, and steady state issues ZERO reads. The poll
+    survives untouched as the fallback: pre-Watch registry
+    (UNIMPLEMENTED retires the thread permanently), stream down, or not
+    yet synced — ``value()`` returns None and the caller's existing
+    GetValues path takes over. ``retarget`` re-scopes the stream after
+    a controller failover."""
+
+    def __init__(self, feeder: "Feeder"):
+        self._feeder = feeder
+        self._lock = threading.Lock()
+        self._controller_id = feeder.controller_id
+        self._value = ""  # the live address, "" = no live row
+        self._synced = False
+        self._unsupported = False
+        self._call = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="oim-feeder-address-watch", daemon=True)
+        self._thread.start()
+
+    def value(self) -> str | None:
+        """The pushed live address; "" when the stream proves there is
+        no live row (lease expired / deleted — the proxy fast-fail
+        signal); None when the stream cannot answer (fall back to the
+        poll)."""
+        with self._lock:
+            if self._unsupported or not self._synced:
+                return None
+            return self._value
+
+    def usable(self) -> bool:
+        with self._lock:
+            return not self._unsupported
+
+    def retarget(self, controller_id: str) -> None:
+        """Point the stream at a new controller's address key (feeder
+        failover): cancel the current call; the loop re-opens scoped to
+        the new key with a fresh snapshot."""
+        with self._lock:
+            self._controller_id = controller_id
+            self._synced = False
+            self._value = ""
+            call = self._call
+        if call is not None:
+            call.cancel()
+
+    def _watch_once(self) -> None:
+        from oim_tpu.registry.watch import WatchConsumer
+
+        with self._lock:
+            cid = self._controller_id
+        key = f"{cid}/{REGISTRY_ADDRESS}"
+        stub = RegistryStub(self._feeder._registry_channel())
+        consumer = WatchConsumer()
+
+        def is_current(path: str) -> bool:
+            with self._lock:
+                return path == f"{self._controller_id}/{REGISTRY_ADDRESS}"
+
+        def install(rows: dict) -> None:
+            with self._lock:
+                self._value = rows.get(
+                    f"{self._controller_id}/{REGISTRY_ADDRESS}", "")
+
+        def put(path: str, value: str) -> None:
+            if is_current(path):
+                with self._lock:
+                    self._value = value
+
+        def delete(path: str, expired: bool) -> None:
+            if is_current(path):
+                with self._lock:
+                    self._value = ""
+
+        def on_sync() -> None:
+            with self._lock:
+                # A retarget between open and sync scoped this stream to
+                # the OLD key: its view must not be trusted for the new.
+                if self._controller_id == cid:
+                    self._synced = True
+
+        def on_reset() -> None:
+            with self._lock:
+                self._synced = False
+
+        call = stub.Watch(pb.WatchRequest(path=key))
+        with self._lock:
+            self._call = call
+        try:
+            consumer.run(call, install=install, put=put, delete=delete,
+                         on_reset=on_reset, on_sync=on_sync,
+                         is_stopped=self._stop.is_set)
+        finally:
+            with self._lock:
+                self._call = None
+                self._synced = False
+
+    def _loop(self) -> None:
+        from oim_tpu.common.backoff import ExponentialBackoff, jittered
+
+        backoff = ExponentialBackoff(base=0.2, cap=10.0)
+        while not self._stop.is_set():
+            try:
+                self._watch_once()
+                backoff.reset()
+                delay = jittered(0.2)
+            except grpc.RpcError as err:
+                if err.code() == grpc.StatusCode.UNIMPLEMENTED:
+                    with self._lock:
+                        self._unsupported = True
+                    events.emit(events.WATCH_RESYNC,
+                                consumer="feeder_resolver",
+                                reason="pre-watch registry: poll mode")
+                    return
+                delay = backoff.next()
+            except Exception:  # noqa: BLE001 - resolver must not die
+                delay = backoff.next()
+            if self._stop.wait(delay):
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._lock:
+            call = self._call
+        if call is not None:
+            call.cancel()
+        self._thread.join(timeout=5.0)
+
+
 class Feeder:
     # StageStatus poll pacing: decorrelated jitter from POLL_BASE_S,
     # capped at POLL_CAP_S (well under any practical publish deadline).
@@ -194,6 +331,12 @@ class Feeder:
         # a deadline-class failure (see _fetch_window_once).
         self._direct_addr: tuple[str, float] | None = None
         self._direct_retry_at = 0.0
+        # Push-fed address resolver (one Watch stream on the pinned
+        # controller's address key), started lazily by the first direct
+        # resolution; None until then, and permanently poll-mode against
+        # a pre-Watch registry. _AddressWatch reads the feeder's pool /
+        # endpoints / tls through _registry_channel.
+        self._address_watch: _AddressWatch | None = None
         # Channels that have answered at least one RPC: first use of a
         # (re)dialed direct channel is probed (hang insurance), verified
         # ones are not. Weak so an evicted channel's entry dies with it.
@@ -309,9 +452,12 @@ class Feeder:
         # The direct-endpoint cache is per PINNED controller: it points
         # at the dead one's address now — and so does any armed direct
         # back-off, which must not pin windows to the proxy for a TTL
-        # against the healthy replacement.
+        # against the healthy replacement. The address watch re-scopes
+        # its stream to the new controller's key.
         self._direct_addr = None
         self._direct_retry_at = 0.0
+        if self._address_watch is not None:
+            self._address_watch.retarget(target)
         return True
 
     def prestage_replica(self, request: pb.MapVolumeRequest) -> str | None:
@@ -731,6 +877,31 @@ class Feeder:
         now = time.monotonic()
         if now < self._direct_retry_at:
             return None
+        # Push path first (PR 14's follow-up): a synced Watch stream on
+        # the address key answers from memory — zero registry reads on
+        # the steady-state data path, and an address move or lease
+        # expiry lands the moment it commits instead of up to one TTL
+        # late. Unsynced/unsupported streams fall through to the
+        # original GetValues poll below.
+        watch = self._address_watch
+        if watch is None:
+            # Under self._lock: concurrent first windows (the fetch
+            # threads) must not each start a watch — the loser's thread
+            # and server-side stream would leak for the process life.
+            with self._lock:
+                watch = self._address_watch
+                if watch is None:
+                    watch = self._address_watch = _AddressWatch(self)
+        if watch.usable():
+            pushed = watch.value()
+            if pushed is not None:
+                if not pushed:
+                    # The stream PROVES no live row: lease expired or
+                    # deleted — the direct path must not outlive it.
+                    self._direct_addr = None
+                    return None
+                self._direct_addr = (pushed, now)
+                return pushed
         cached = self._direct_addr
         if cached is not None and now - cached[1] < self.DIRECT_TTL_S:
             return cached[0]
@@ -899,6 +1070,12 @@ class Feeder:
         stalled direct path."""
         self._pool.evict(direct)
         self._direct_addr = None
+        # A failed direct dial is evidence the PUSHED view may be stale
+        # (an address re-registered out of band of the stream): force
+        # the watch to resync from a fresh snapshot rather than keep
+        # serving the address that just failed.
+        if self._address_watch is not None:
+            self._address_watch.retarget(self.controller_id)
         if code == grpc.StatusCode.DEADLINE_EXCEEDED and arm_backoff:
             self._direct_retry_at = time.monotonic() + self.DIRECT_TTL_S
         from_context().warning(
@@ -1039,6 +1216,17 @@ class Feeder:
                 f"{err.code().name}: {err.details()}",
                 code=err.code().name,
             ) from err
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        """Release the feeder's background resources (the address-watch
+        stream). Channels belong to the shared pool and stay pooled;
+        a feeder that is never closed only leaves one daemon thread
+        parked on a server stream."""
+        watch, self._address_watch = self._address_watch, None
+        if watch is not None:
+            watch.stop()
 
     # -- unpublish ---------------------------------------------------------
 

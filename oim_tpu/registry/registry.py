@@ -31,6 +31,7 @@ from oim_tpu.common import faultinject, metrics as M, tracing
 from oim_tpu.common.logging import from_context
 from oim_tpu.common.pathutil import (
     REGISTRY_ADDRESS,
+    REGISTRY_ALERT,
     REGISTRY_MESH,
     REGISTRY_SERVE,
     REGISTRY_TELEMETRY,
@@ -129,17 +130,28 @@ class RegistryService(RegistryServicer):
             row_id = path_parts[1]
             return bool(owner) and (
                 row_id == owner or row_id.startswith(owner + "."))
+        if len(path_parts) == 2 and path_parts[0] == REGISTRY_ALERT:
+            # The SLO plane's alert/<name> rows: only a monitor identity
+            # (component.monitor, or a dot-suffixed variant for an HA
+            # pair) may publish them — an alert row drives the future
+            # autoscaler, so no replica/controller identity may forge
+            # one. Alert names are SLO names, not the writer's id, so
+            # the telemetry own-row rule cannot apply here.
+            return peer == "component.monitor" \
+                or peer.startswith("component.monitor.")
         if peer.startswith("controller."):
             controller_id = peer[len("controller."):]
             return (
                 len(path_parts) == 2
                 and path_parts[0] == controller_id
-                # "serve" and "telemetry" are reserved namespaces: a
-                # controller named serve could otherwise write
-                # serve/address — and its Heartbeat would prefix-renew
-                # EVERY replica's lease (same hole for telemetry rows).
+                # "serve", "telemetry" and "alert" are reserved
+                # namespaces: a controller named serve could otherwise
+                # write serve/address — and its Heartbeat would
+                # prefix-renew EVERY replica's lease (same hole for
+                # telemetry and alert rows).
                 and controller_id not in (REGISTRY_SERVE,
-                                          REGISTRY_TELEMETRY)
+                                          REGISTRY_TELEMETRY,
+                                          REGISTRY_ALERT)
                 and path_parts[1] in (REGISTRY_ADDRESS, REGISTRY_MESH)
             )
         if peer.startswith("host.") and len(path_parts) == 2 \
@@ -338,8 +350,9 @@ class RegistryService(RegistryServicer):
                     f"controller_id {request.controller_id!r} is a path, "
                     f"not an id",
                 )
-            if request.controller_id in (REGISTRY_SERVE, REGISTRY_TELEMETRY):
-                # Renewal is prefix-scoped: a "serve"/"telemetry"
+            if request.controller_id in (REGISTRY_SERVE, REGISTRY_TELEMETRY,
+                                         REGISTRY_ALERT):
+                # Renewal is prefix-scoped: a "serve"/"telemetry"/"alert"
                 # heartbeat would renew EVERY row's lease in that
                 # namespace at once. Those rows renew individually via
                 # the batch `keys` list (or by re-publishing).

@@ -128,6 +128,7 @@ class _Request:
     finished_at: float = 0.0
     emitted: int = 0
     last_emit_at: float = 0.0
+    first_emit_at: float = 0.0
     trace_ctx: Any = None
     # Prompt tokens whose K/V came from the prefix cache (0 = the whole
     # prompt was prefilled): the per-request hit record.
@@ -815,6 +816,7 @@ class ServeEngine:
     def _finish(self, req: _Request, reason: str) -> None:
         req.finish_reason = reason
         req.finished_at = time.monotonic()
+        self._record_phases(req)
         req.out.put(_DONE)
         self.finished_total += 1
         M.SERVE_REQUESTS_TOTAL.labels(outcome=reason).inc()
@@ -830,6 +832,28 @@ class ServeEngine:
     @staticmethod
     def _trace_id(req: _Request) -> str:
         return req.trace_ctx.trace_id if req.trace_ctx is not None else ""
+
+    def _record_phases(self, req: _Request) -> None:
+        """Synthesize the request's phase spans at retirement — the
+        boundaries (submit, admit, first token, finish) are monotonic
+        bookkeeping, only complete now. ``oimctl --autopsy`` tiles the
+        request's timeline from these plus the live prefill span; two
+        ring appends per request, the flight-recorder cost class."""
+        now_wall, now_mono = time.time(), time.monotonic()
+
+        def wall(mono: float) -> float:
+            return now_wall - (now_mono - mono)
+
+        if req.admitted_at and req.admitted_at > req.submitted_at:
+            tracing.record_phase(
+                "serve.queue_wait", wall(req.submitted_at),
+                req.admitted_at - req.submitted_at, parent=req.trace_ctx)
+        if req.first_emit_at and req.finished_at > req.first_emit_at \
+                and req.emitted > 1:
+            duration = req.finished_at - req.first_emit_at
+            tracing.record_phase(
+                "serve.decode", wall(req.first_emit_at), duration,
+                parent=req.trace_ctx, tokens=req.emitted - 1)
 
     def _emit(self, req: _Request, token: int) -> None:
         now = time.monotonic()
@@ -848,7 +872,9 @@ class ServeEngine:
                 prefix="hit" if req.prefix_tokens else "miss").observe(
                 now - base, self._trace_id(req))
         M.SERVE_TOKENS_TOTAL.inc()
-        if kind == "next":
+        if kind == "first":
+            req.first_emit_at = now
+        else:
             self._decode_tokens += 1
         req.last_emit_at = now
         req.emitted += 1

@@ -1,0 +1,261 @@
+"""Request autopsy (oim_tpu/obs/autopsy.py): phase attribution over
+synthetic span sets — router pick/retry classification, prefill/decode
+details, the unattributed-gap callout, union-based coverage (overlap
+tolerant), per-target fetch resilience, and the engine's synthesized
+phase spans feeding it end to end in-process."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from oim_tpu.obs import autopsy
+
+TRACE = "ab" * 16
+
+
+def span(name, start_s, dur_s, span_id="", parent_id="", **attrs):
+    args = {"trace_id": TRACE, "span_id": span_id or name}
+    if parent_id:
+        args["parent_id"] = parent_id
+    args.update(attrs)
+    return {"name": name, "ph": "X", "ts": start_s * 1e6,
+            "dur": dur_s * 1e6, "args": args}
+
+
+def routed_trace():
+    """A full routed request at t=0..0.6s: pick 10ms, a failed dial
+    30ms, the winning hop 540ms containing serve-side queue 50ms /
+    prefill 150ms / decode 300ms."""
+    return [
+        span(autopsy.ROUTER_ROOT, 0.0, 0.6, span_id="root"),
+        span(autopsy.CLIENT_HOP, 0.01, 0.03, span_id="c-dead",
+             parent_id="root", code="UNAVAILABLE"),
+        span(autopsy.CLIENT_HOP, 0.045, 0.54, span_id="c-win",
+             parent_id="root", code="OK"),
+        # The caller's own client hop: same name, but it PARENTS the
+        # root — must not be classified as a retry.
+        span(autopsy.CLIENT_HOP, 0.0, 0.62, span_id="c-outer",
+             parent_id="caller"),
+        span(autopsy.SERVE_ROOT, 0.05, 0.53, span_id="srv",
+             parent_id="c-win"),
+        span("serve.queue_wait", 0.055, 0.05, span_id="q",
+             parent_id="srv"),
+        span("serve.prefill", 0.105, 0.15, span_id="p", parent_id="srv",
+             prompt_tokens=32, prefix_tokens=16, slot=0),
+        span("serve.decode", 0.26, 0.3, span_id="d", parent_id="srv",
+             tokens=10),
+    ]
+
+
+def collected(spans, events=()):
+    return {"trace_id": TRACE, "spans": sorted(spans, key=lambda s: s["ts"]),
+            "events": list(events), "unreachable": []}
+
+
+class TestAnalyze:
+    def test_phases_and_coverage(self):
+        report = autopsy.analyze(collected(routed_trace()))
+        assert report["root"] == autopsy.ROUTER_ROOT
+        assert report["wall_ms"] == pytest.approx(600.0)
+        by_name = {p["name"]: p for p in report["phases"]}
+        assert by_name["router pick"]["dur_ms"] == pytest.approx(10.0)
+        assert by_name["router retry dial"]["detail"] == "code=UNAVAILABLE"
+        assert by_name["admission queue"]["dur_ms"] == pytest.approx(50.0)
+        assert "prefix HIT, 16 tokens saved" in by_name["prefill"]["detail"]
+        assert "10 tokens, 30.0ms/token" in by_name["decode"]["detail"]
+        # transport send (45->50ms), stream close (580->585), router
+        # return (585->600) attribute the hop overhead.
+        assert by_name["transport send"]["dur_ms"] == pytest.approx(5.0)
+        # The outer caller hop contributed nothing: no phantom retry.
+        retries = [p for p in report["phases"]
+                   if p["name"] == "router retry dial"]
+        assert len(retries) == 1
+        assert report["coverage"] > 0.9
+        assert report["unattributed_ms"] == pytest.approx(
+            600 * (1 - report["coverage"]), rel=1e-6)
+
+    def test_retry_attributes_the_winners_serve_span(self):
+        """A retry that was ADMITTED on the failed replica leaves an
+        earlier serve.generate span on the trace; the analyzer must
+        follow the winner's parent chain (client hop -> server hop ->
+        serve.generate) instead of taking first-by-ts, and scope the
+        queue/prefill/decode phases to the winning attempt."""
+        spans = [
+            span(autopsy.ROUTER_ROOT, 0.0, 1.0, span_id="root"),
+            # Attempt A: admitted, prefilled, then died pre-first-token.
+            span(autopsy.CLIENT_HOP, 0.01, 0.2, span_id="c-a",
+                 parent_id="root", code="UNAVAILABLE"),
+            span(autopsy.SERVER_HOP, 0.015, 0.19, span_id="h-a",
+                 parent_id="c-a"),
+            span(autopsy.SERVE_ROOT, 0.02, 0.18, span_id="srv-a",
+                 parent_id="h-a"),
+            span("serve.prefill", 0.03, 0.1, span_id="p-a",
+                 parent_id="srv-a", prompt_tokens=8),
+            # Attempt B: the winner.
+            span(autopsy.CLIENT_HOP, 0.25, 0.7, span_id="c-b",
+                 parent_id="root", code="OK"),
+            span(autopsy.SERVER_HOP, 0.26, 0.68, span_id="h-b",
+                 parent_id="c-b"),
+            span(autopsy.SERVE_ROOT, 0.27, 0.66, span_id="srv-b",
+                 parent_id="h-b"),
+            span("serve.prefill", 0.3, 0.2, span_id="p-b",
+                 parent_id="srv-b", prompt_tokens=8, prefix_tokens=0),
+            span("serve.decode", 0.5, 0.4, span_id="d-b",
+                 parent_id="srv-b", tokens=4),
+        ]
+        report = autopsy.analyze(collected(spans))
+        by_name = {p["name"]: p for p in report["phases"]}
+        # transport send = winner start (250ms) -> winner's serve start
+        # (270ms); first-by-ts would have yielded a NEGATIVE interval
+        # against attempt A's span.
+        assert by_name["transport send"]["start_ms"] == pytest.approx(250)
+        assert by_name["transport send"]["dur_ms"] == pytest.approx(20)
+        prefills = [p for p in report["phases"] if p["name"] == "prefill"]
+        assert len(prefills) == 1
+        assert prefills[0]["start_ms"] == pytest.approx(300)
+        assert by_name["router retry dial"]["dur_ms"] == pytest.approx(200)
+        for p in report["phases"]:
+            assert p["dur_ms"] > 0
+
+    def test_serve_only_trace(self):
+        spans = [s for s in routed_trace()
+                 if s["args"]["span_id"] in ("srv", "q", "p", "d")]
+        report = autopsy.analyze(collected(spans))
+        assert report["root"] == autopsy.SERVE_ROOT
+        names = {p["name"] for p in report["phases"]}
+        assert {"admission queue", "prefill", "decode"} <= names
+        assert "router pick" not in names
+
+    def test_missing_trace_raises(self):
+        with pytest.raises(ValueError):
+            autopsy.analyze(collected([]))
+
+    def test_coverage_union_not_double_counted(self):
+        # Two phases covering the SAME interval must not count twice.
+        spans = [
+            span(autopsy.SERVE_ROOT, 0.0, 1.0, span_id="srv"),
+            span("serve.prefill", 0.0, 0.5, span_id="p", prompt_tokens=1),
+            span("serve.decode", 0.25, 0.5, span_id="d", tokens=2),
+        ]
+        report = autopsy.analyze(collected(spans))
+        assert report["coverage"] == pytest.approx(0.75)
+
+    def test_prefix_miss_detail(self):
+        spans = [
+            span(autopsy.SERVE_ROOT, 0.0, 1.0, span_id="srv"),
+            span("serve.prefill", 0.1, 0.2, span_id="p",
+                 prompt_tokens=8, prefix_tokens=0),
+        ]
+        report = autopsy.analyze(collected(spans))
+        prefill = next(p for p in report["phases"] if p["name"] == "prefill")
+        assert "prefix miss" in prefill["detail"]
+
+    def test_render_calls_out_gap_and_events(self):
+        report = autopsy.analyze(collected(
+            routed_trace(),
+            events=[{"ts": 12.5, "type": "router_retry",
+                     "attrs": {"replica": "zz-dead"}}]))
+        text = autopsy.render(report)
+        assert "unattributed gap" in text
+        assert "router_retry" in text and "replica=zz-dead" in text
+        assert f"autopsy {TRACE}" in text
+
+
+class TestCollect:
+    def test_dedupes_and_survives_dead_targets(self):
+        span_doc = json.dumps({"traceEvents": routed_trace()})
+        event_doc = json.dumps({"events": [
+            {"seq": 1, "ts": 1.0, "type": "router_retry"}]})
+
+        def http_get(url):
+            if "dead:1" in url:
+                raise OSError("refused")
+            return span_doc if "/debug/spans" in url else event_doc
+
+        out = autopsy.collect(
+            TRACE, ["a:1", "a:1", "b:2", "dead:1", ""], http_get)
+        # Two live targets advertise the SAME process: spans dedupe by
+        # span_id, events by (ts, type, seq).
+        assert len(out["spans"]) == len(routed_trace())
+        assert len(out["events"]) == 1
+        assert out["unreachable"] == ["dead:1"]
+        report = autopsy.analyze(out)
+        assert report["unreachable"] == ["dead:1"]
+
+    def test_filters_foreign_traces_and_non_complete_events(self):
+        doc = json.dumps({"traceEvents": [
+            span(autopsy.SERVE_ROOT, 0.0, 1.0, span_id="srv"),
+            {"name": "process_name", "ph": "M", "args": {}},
+            {"name": "other", "ph": "X", "ts": 0, "dur": 1,
+             "args": {"trace_id": "ff" * 16, "span_id": "x"}},
+        ]})
+
+        def http_get(url):
+            return doc if "/debug/spans" in url else '{"events": []}'
+
+        out = autopsy.collect(TRACE, ["a:1"], http_get)
+        assert [s["name"] for s in out["spans"]] == [autopsy.SERVE_ROOT]
+
+
+@pytest.fixture
+def fresh_recorder(monkeypatch):
+    """A private span ring installed as the process-global recorder for
+    one test — monkeypatch restores the original, so later tests in the
+    same pytest process keep their full-capacity ring."""
+    from oim_tpu.common import tracing
+
+    rec = tracing.SpanRecorder("autopsy-test", capacity=64)
+    monkeypatch.setattr(tracing, "_recorder", rec)
+    return rec
+
+
+class TestEnginePhaseSpans:
+    def test_engine_records_queue_and_decode_phases(self, fresh_recorder):
+        """The synthesized phase spans land in the ring at retirement
+        with wall-clock starts consistent with the request's bounds."""
+        import time
+
+        from oim_tpu.common import tracing
+        from oim_tpu.serve.engine import _Request
+
+        # A retired request's bookkeeping, without a live engine: drive
+        # _record_phases via a minimal stand-in.
+        from oim_tpu.serve.engine import ServeEngine
+
+        rec = fresh_recorder
+        now = time.monotonic()
+        req = _Request(prompt=[1, 2, 3], max_new=4, temperature=0.0,
+                       seed=0, eos=-1)
+        req.submitted_at = now - 0.5
+        req.admitted_at = now - 0.45
+        req.first_emit_at = now - 0.3
+        req.finished_at = now
+        req.emitted = 5
+        with tracing.start_span("serve.generate") as root:
+            req.trace_ctx = root.context
+        ServeEngine._record_phases(object.__new__(ServeEngine), req)
+        spans = {s.name: s for s in rec.spans()}
+        queue = spans["serve.queue_wait"]
+        decode = spans["serve.decode"]
+        assert queue.trace_id == root.trace_id
+        assert queue.duration == pytest.approx(0.05, abs=1e-3)
+        assert decode.duration == pytest.approx(0.3, abs=1e-3)
+        assert decode.attrs["tokens"] == 4
+        assert decode.start_unix > queue.start_unix
+
+    def test_record_phase_helper_clamps_and_parents(self, fresh_recorder):
+        from oim_tpu.common import tracing
+
+        rec = fresh_recorder
+        with tracing.start_span("root") as root:
+            pass
+        span_ = tracing.record_phase("phase", 123.0, -1.0,
+                                     parent=root.context, note="x")
+        assert span_.duration == 0.0
+        assert span_.trace_id == root.trace_id
+        assert span_.parent_id == root.span_id
+        orphan = tracing.record_phase("orphan", 1.0, 1.0)
+        assert orphan.trace_id != root.trace_id
+        assert [s.name for s in rec.spans()] == ["root", "phase", "orphan"]

@@ -34,7 +34,7 @@ class TestRenderDeploy:
         rendered = sorted(p.name for p in tmp_path.iterdir())
         assert rendered == [
             "controller-daemonset.yaml", "feeder-daemonset.yaml",
-            "registry-quorum.yaml", "registry.yaml",
+            "monitor.yaml", "registry-quorum.yaml", "registry.yaml",
         ]
         for p in tmp_path.iterdir():
             text = p.read_text()

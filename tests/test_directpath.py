@@ -339,6 +339,77 @@ class TestDirectWindows:
         finally:
             ctrl2.force_stop()
 
+    def test_address_watch_pushes_moves_without_ttl_wait(self, cluster):
+        """PR 14's named follow-up: the direct-path resolver rides a
+        Watch stream on the one address key — an address re-registered
+        through the WRITE path (apply_kv, what a real re-registration
+        does) reaches _direct_endpoint the moment it commits, not one
+        DIRECT_TTL_S later; a pushed lease expiry turns the direct path
+        off the same way."""
+        import time as time_mod
+
+        db, _, controller = cluster
+        service = RegistryService(db=db)
+        registry = registry_server("tcp://localhost:0", service)
+        try:
+            feeder = self.feeder_for(registry)
+            assert feeder._direct_endpoint() == controller.addr
+            watch = feeder._address_watch
+            assert watch is not None
+            deadline = time_mod.monotonic() + 5
+            while watch.value() is None:  # wait for the stream to sync
+                assert time_mod.monotonic() < deadline, \
+                    "watch never synced"
+                time_mod.sleep(0.02)
+            # The address moves through the committed-write path; the
+            # stale TTL cache would have served the old value for 30s —
+            # the push must override it.
+            service.apply_kv("host-0/address", "10.9.9.9:1", 0.0)
+            deadline = time_mod.monotonic() + 5
+            while feeder._direct_endpoint() != "10.9.9.9:1":
+                assert time_mod.monotonic() < deadline, \
+                    "pushed address move never reached the resolver"
+                time_mod.sleep(0.02)
+            # Delete (the lease-expiry/deregistration shape): the
+            # stream PROVES no live row — direct path off, no poll.
+            service.apply_kv("host-0/address", "", 0.0)
+            deadline = time_mod.monotonic() + 5
+            while feeder._direct_endpoint() is not None:
+                assert time_mod.monotonic() < deadline, \
+                    "pushed delete never disabled the direct path"
+                time_mod.sleep(0.02)
+            feeder.close()
+            assert feeder._address_watch is None
+        finally:
+            registry.force_stop()
+
+    def test_address_watch_falls_back_to_poll_pre_watch(self, cluster):
+        """Against a registry with no Watch RPC the resolver degrades to
+        the original GetValues poll permanently (UNIMPLEMENTED retires
+        the stream — the mixed-version stance)."""
+        import time as time_mod
+
+        class _NoWatch(RegistryService):
+            def Watch(self, request, context):
+                context.abort(grpc.StatusCode.UNIMPLEMENTED, "pre-watch")
+
+        db, _, controller = cluster
+        old_registry = registry_server(
+            "tcp://localhost:0", _NoWatch(db=db))
+        try:
+            feeder = self.feeder_for(old_registry)
+            assert feeder._direct_endpoint() == controller.addr
+            deadline = time_mod.monotonic() + 5
+            while not feeder._address_watch._unsupported:
+                assert time_mod.monotonic() < deadline
+                time_mod.sleep(0.02)
+            # Poll keeps answering (and honors its TTL cache).
+            assert feeder._direct_endpoint() == controller.addr
+            assert feeder._address_watch.value() is None
+            feeder.close()
+        finally:
+            old_registry.force_stop()
+
     def test_direct_disabled_never_dials_controller(
             self, cluster, tmp_path, monkeypatch):
         _, registry, controller = cluster

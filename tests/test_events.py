@@ -504,9 +504,14 @@ class TestTelemetryRegistration:
         from oim_tpu.common.telemetry import TelemetryRegistration
 
         srv, service = registry
+        # collect=None: the discovery-only row shape. This test pins
+        # the RENEWAL mechanics, which need a value-stable snapshot —
+        # the default metrics payload is stable on an idle daemon but
+        # not in a pytest process where neighboring tests' RPCs tick
+        # the shared rpc histogram between beats.
         reg = TelemetryRegistration(
             "host-0", "controller", "127.0.0.1:9090", srv.addr,
-            interval=5.0)
+            interval=5.0, collect=None)
         snap = reg.beat_once()
         assert snap["metrics"] == "127.0.0.1:9090"
         assert snap["role"] == "controller" and snap["beat"] == 1
@@ -678,5 +683,11 @@ class TestOimctlTop:
                 channel.close()
         finally:
             srv.stop()
-        assert rows == [("a", "ALIVE", "serve", "ma:1"),
-                        ("b", "STALE", "serve", "mb:1")]
+        # The 5th element is the parsed row body (the --top ALL fleet
+        # row folds the hist snapshots it may carry).
+        assert rows == [
+            ("a", "ALIVE", "serve", "ma:1",
+             {"metrics": "ma:1", "role": "serve"}),
+            ("b", "STALE", "serve", "mb:1",
+             {"metrics": "mb:1", "role": "serve"}),
+        ]

@@ -424,6 +424,55 @@ class TestMetricsDrift:
         assert not unreferenced, (
             f"canonical metrics never recorded by any module: {unreferenced}")
 
+    def test_slo_plane_metrics_declared_and_shaped(self):
+        """The fleet SLO plane's metric names are API (ISSUE 15): the
+        monitor's burn gauge must stay labeled by SLO name, and the
+        firing census unlabeled — alert dashboards key on both."""
+        assert isinstance(metrics.SLO_BURN_RATE, Gauge)
+        assert metrics.SLO_BURN_RATE.name == "oim_slo_burn_rate"
+        assert metrics.SLO_BURN_RATE.labelnames == ("slo",)
+        assert isinstance(metrics.SLO_ALERTS_FIRING, Gauge)
+        assert metrics.SLO_ALERTS_FIRING.name == "oim_slo_alerts_firing"
+        assert metrics.SLO_ALERTS_FIRING.labelnames == ()
+
+
+class TestTelemetrySnapshotPayload:
+    def test_rows_carry_mergeable_histograms(self):
+        """TelemetryRegistration's default collector publishes the
+        fleet-mergeable snapshots (obs/merge.py wire format) inside the
+        row body: rpc always; the serve-side series only once observed;
+        requests_total counters once any request finished."""
+        from oim_tpu.common.telemetry import metrics_snapshot
+        from oim_tpu.obs import merge
+
+        payload = metrics_snapshot()
+        assert "rpc" in payload["hist"]
+        merge.validate(payload["hist"]["rpc"])
+        metrics.SERVE_TOKEN_LATENCY.labels(kind="first").observe(0.02)
+        metrics.SERVE_QUEUE_WAIT.observe(0.003)
+        metrics.SERVE_REQUESTS_TOTAL.labels(outcome="eos").inc()
+        payload = metrics_snapshot()
+        for key in ("first_token", "queue_wait"):
+            assert merge.total(payload["hist"][key]) >= 1
+        assert payload["counters"]["requests_total"]["eos"] >= 1
+        # The whole payload must survive the registry row's JSON trip.
+        import json as json_mod
+
+        fleet = merge.FleetHistogram()
+        fleet.update("r0", json_mod.loads(
+            json_mod.dumps(payload))["hist"]["first_token"])
+        assert fleet.merged() is not None
+
+    def test_collect_none_restores_discovery_only_rows(self):
+        from oim_tpu.common.telemetry import TelemetryRegistration
+
+        reg = TelemetryRegistration(
+            "t0", "serve", "127.0.0.1:1", "localhost:1", collect=None)
+        assert set(reg.snapshot()) == {"metrics", "role", "pid"}
+        with_payload = TelemetryRegistration(
+            "t1", "serve", "127.0.0.1:1", "localhost:1")
+        assert "hist" in with_payload.snapshot()
+
 
 class TestMetricsServer:
     def test_bind_host_and_debug_spans(self):

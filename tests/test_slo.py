@@ -1,0 +1,397 @@
+"""Fleet SLO plane: burn-rate window math against hand-computed
+fixtures, alert debounce/hysteresis (one fired/resolved pair per
+episode), the SLO engine end-to-end over synthetic telemetry rows, the
+oim-monitor core against a real in-process registry (Watch mode and the
+poll fallback), alert-row authorization, and the oimctl surfaces
+(--alerts, the --top ALL fleet row)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from oim_tpu.common import events
+from oim_tpu.obs.slo import (
+    SLO,
+    AlertEpisode,
+    BurnSeries,
+    SloEngine,
+    default_slos,
+)
+
+LE = [0.05, 0.1, 0.5]
+
+
+def ft_snap(good: int, bad: int, le=LE):
+    """A first-token snapshot: ``good`` obs <= 0.1s, ``bad`` above."""
+    return {"le": list(le), "counts": [0, good, good, good + bad],
+            "sum": 0.01 * good + 0.5 * bad}
+
+
+class TestBurnSeries:
+    """Hand-computed fixtures: cumulative (good, total) samples at known
+    timestamps; burn = ((d_total - d_good) / d_total) / budget with the
+    baseline = latest sample at or before now - window."""
+
+    def test_burn_against_hand_computed_windows(self):
+        s = BurnSeries(retain_s=100.0)
+        s.sample(0.0, good=0, total=0)
+        s.sample(10.0, good=90, total=100)   # 10 bad in (0, 10]
+        s.sample(20.0, good=190, total=200)  # 0 bad in (10, 20]
+        # Window 10 @ now=20: baseline is the ts=10 sample ->
+        # d_good=100, d_total=100, bad_frac=0, burn=0.
+        assert s.burn(10.0, budget=0.01, now=20.0) == pytest.approx(0.0)
+        # Window 20 @ now=20: baseline ts=0 -> 10 bad of 200 -> 5% of a
+        # 1% budget = burn 5.
+        assert s.burn(20.0, budget=0.01, now=20.0) == pytest.approx(5.0)
+        # Window 5 @ now=20: no sample at or before 15 except ts=10.
+        assert s.burn(5.0, budget=0.01, now=20.0) == pytest.approx(0.0)
+
+    def test_short_series_uses_oldest_baseline(self):
+        # A monitor booted into an outage must fire before a full
+        # window of history exists.
+        s = BurnSeries(retain_s=100.0)
+        s.sample(0.0, good=0, total=0)
+        s.sample(1.0, good=50, total=100)
+        assert s.burn(60.0, budget=0.1, now=1.0) == pytest.approx(5.0)
+
+    def test_no_traffic_is_zero_burn(self):
+        s = BurnSeries(retain_s=100.0)
+        assert s.burn(10.0, 0.01, now=5.0) == 0.0
+        s.sample(0.0, 10, 10)
+        s.sample(10.0, 10, 10)
+        assert s.burn(5.0, 0.01, now=10.0) == 0.0
+
+    def test_non_monotone_sample_clamped(self):
+        s = BurnSeries(retain_s=100.0)
+        s.sample(0.0, 5, 10)
+        s.sample(1.0, 3, 8)  # a buggy feed must not poison deltas
+        d_good, d_total = s.delta(10.0, now=1.0)
+        assert (d_good, d_total) == (0, 0)
+
+    def test_retention_keeps_window_baseline(self):
+        s = BurnSeries(retain_s=10.0)
+        for i in range(40):
+            s.sample(float(i), good=i, total=i)
+        # The oldest retained sample must still cover a 10s window.
+        assert s.delta(10.0, now=39.0) == (10, 10)
+
+
+class TestAlertEpisode:
+    def test_one_fired_per_episode_with_hysteresis(self):
+        ep = AlertEpisode(resolve_hold_s=5.0)
+        assert ep.update(True, 0.0) == "fired"
+        assert ep.update(True, 1.0) is None  # still breaching: no re-fire
+        assert ep.update(False, 2.0) is None  # clear starts, hold not met
+        assert ep.update(True, 4.0) is None  # FLAP back: no second fired
+        assert ep.update(False, 5.0) is None
+        assert ep.update(False, 9.0) is None  # 4s clear < 5s hold
+        assert ep.update(False, 10.1) == "resolved"
+        assert ep.update(False, 11.0) is None
+        assert ep.update(True, 12.0) == "fired"  # a NEW episode
+
+    def test_never_fired_never_resolves(self):
+        ep = AlertEpisode(resolve_hold_s=1.0)
+        assert ep.update(False, 0.0) is None
+        assert ep.update(False, 100.0) is None
+
+
+class TestSloEngine:
+    def make_engine(self, **kw):
+        kw.setdefault("fast_window_s", 10.0)
+        kw.setdefault("slow_window_s", 60.0)
+        kw.setdefault("burn_threshold", 10.0)
+        kw.setdefault("resolve_hold_s", 5.0)
+        return SloEngine(
+            [SLO(name="first_token_p99", kind="latency", objective=0.99,
+                 metric="first_token", threshold_s=0.1),
+             SLO(name="availability", kind="availability",
+                 objective=0.99)], **kw)
+
+    def test_latency_alert_fires_and_resolves_once(self):
+        events.configure(capacity=256)
+        eng = self.make_engine()
+        eng.ingest("r0", {"hist": {"first_token": ft_snap(100, 0)}})
+        assert eng.evaluate(now=0.0) == []
+        # Degrade: 50 slow of the next 100.
+        eng.ingest("r0", {"hist": {"first_token": ft_snap(150, 50)}})
+        out = eng.evaluate(now=5.0)
+        assert [(t["slo"], t["transition"]) for t in out] == [
+            ("first_token_p99", "fired")]
+        assert out[0]["burn_fast"] == pytest.approx(50.0)
+        assert eng.firing() == ["first_token_p99"]
+        # Heal: only good obs from here; windows slide clear.
+        eng.ingest("r0", {"hist": {"first_token": ft_snap(450, 50)}})
+        assert eng.evaluate(now=20.0) == []  # clear hold begins
+        assert eng.evaluate(now=23.0) == []  # 3s clear < the 5s hold
+        out = eng.evaluate(now=26.0)  # 6s clear: hold met
+        assert [(t["slo"], t["transition"]) for t in out] == [
+            ("first_token_p99", "resolved")]
+        fired = [e for e in events.recorder().events(
+            type_=events.SLO_ALERT_FIRED)]
+        resolved = [e for e in events.recorder().events(
+            type_=events.SLO_ALERT_RESOLVED)]
+        assert len(fired) == 1 and len(resolved) == 1
+
+    def test_multiwindow_and_prevents_spiky_page(self):
+        """A short spike breaches the fast window, but against a long
+        clean history the slow window's burn stays under threshold —
+        the multi-window AND keeps the pager quiet (the whole point of
+        evaluating two windows instead of one)."""
+        eng = self.make_engine()
+        eng.ingest("r0", {"hist": {"first_token": ft_snap(100000, 0)}})
+        eng.evaluate(now=0.0)
+        eng.ingest("r0", {"hist": {"first_token": ft_snap(101000, 0)}})
+        eng.evaluate(now=30.0)
+        eng.ingest("r0", {"hist": {"first_token": ft_snap(101100, 0)}})
+        eng.evaluate(now=55.0)
+        # Spike: 40 bad of the last 100 requests, 5s before the tick.
+        eng.ingest("r0", {"hist": {"first_token": ft_snap(101160, 40)}})
+        assert eng.evaluate(now=60.0) == []
+        assert eng.firing() == []
+        burn_fast, burn_slow = eng._burns["first_token_p99"]
+        # Fast window (baseline ts=30): 40 bad of 200 -> burn 20,
+        # breaching alone; slow window (baseline ts=0): 40 bad of 1200
+        # -> burn ~3.3, under threshold — the AND held.
+        assert burn_fast >= 10 > burn_slow
+
+    def test_availability_slo_from_counters(self):
+        eng = self.make_engine()
+        eng.ingest("r0", {"counters": {"requests_total": {"eos": 100}}})
+        eng.evaluate(now=0.0)
+        eng.ingest("r0", {"counters": {"requests_total": {
+            "eos": 150, "rejected": 30}}})
+        out = eng.evaluate(now=5.0)
+        assert [(t["slo"], t["transition"]) for t in out] == [
+            ("availability", "fired")]
+        # 30 bad of 80 new = 37.5% of a 1% budget.
+        assert out[0]["burn_fast"] == pytest.approx(37.5)
+
+    def test_replica_restart_never_negative(self):
+        eng = self.make_engine()
+        eng.ingest("r0", {"hist": {"first_token": ft_snap(500, 0)}})
+        eng.evaluate(now=0.0)
+        eng.ingest("r0", {"hist": {"first_token": ft_snap(3, 0)}})  # reset
+        out = eng.evaluate(now=5.0)
+        assert out == []
+        assert eng.fleet_quantiles("first_token") is not None
+
+    def test_malformed_rows_ignored(self):
+        eng = self.make_engine()
+        eng.ingest("r0", {"hist": {"first_token": {"le": [1], "counts": [9]}}})
+        eng.ingest("r1", "not a dict")
+        eng.ingest("r2", {"hist": "nope", "counters": {"requests_total": 3}})
+        assert eng.evaluate(now=0.0) == []
+        assert eng.fleet_quantiles("first_token") is None
+
+    def test_status_body_schema(self):
+        eng = self.make_engine()
+        eng.evaluate(now=0.0)
+        body = eng.status("first_token_p99")
+        assert body["slo"] == "first_token_p99"
+        assert body["kind"] == "latency"
+        assert body["state"] == "ok"
+        assert body["threshold_s"] == pytest.approx(0.1)
+        assert body["windows_s"] == [10.0, 60.0]
+        json.dumps(body)  # must be registry-row serializable
+
+    def test_default_slos_and_validation(self):
+        assert [s.name for s in default_slos()] == [
+            "first_token_p99", "availability"]
+        with pytest.raises(ValueError):
+            SLO(name="x", kind="latency", objective=0.99)  # no metric
+        with pytest.raises(ValueError):
+            SLO(name="x", kind="weird", objective=0.99)
+        with pytest.raises(ValueError):
+            SLO(name="x", kind="availability", objective=1.5)
+        with pytest.raises(ValueError):
+            SloEngine(fast_window_s=60, slow_window_s=60)
+        with pytest.raises(ValueError):
+            SloEngine([SLO(name="dup", kind="availability", objective=0.9),
+                       SLO(name="dup", kind="availability", objective=0.9)])
+
+
+@pytest.fixture()
+def registry_cluster():
+    from oim_tpu.common.channelpool import ChannelPool
+    from oim_tpu.registry import MemRegistryDB, RegistryService
+    from oim_tpu.registry.registry import registry_server
+
+    pool = ChannelPool()
+    srv = registry_server(
+        "tcp://localhost:0", RegistryService(db=MemRegistryDB()))
+    yield srv, pool
+    srv.force_stop()
+    pool.close()
+
+
+def publish_row(pool, addr, rid, snap_payload):
+    from oim_tpu.common.telemetry import TelemetryRegistration
+
+    reg = TelemetryRegistration(
+        rid, "serve", "127.0.0.1:0", addr, interval=5.0, pool=pool,
+        collect=lambda: snap_payload)
+    reg.beat_once()
+    reg.stop(deregister=False)
+
+
+class TestFleetMonitor:
+    def make_monitor(self, addr, pool, watch=True):
+        from oim_tpu.obs.monitor import FleetMonitor
+
+        engine = SloEngine(
+            [SLO(name="first_token_p99", kind="latency", objective=0.99,
+                 metric="first_token", threshold_s=0.1)],
+            fast_window_s=10.0, slow_window_s=60.0, burn_threshold=10.0,
+            resolve_hold_s=0.1)
+        return FleetMonitor(addr, engine, interval=0.2, pool=pool,
+                            watch=watch)
+
+    def wait_watch_synced(self, monitor, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while not monitor._watch_synced:
+            if time.monotonic() > deadline:
+                raise AssertionError("telemetry watch never synced")
+            time.sleep(0.02)
+
+    @pytest.mark.parametrize("watch", [True, False])
+    def test_alert_row_lifecycle(self, registry_cluster, watch):
+        """Degrade -> one TTL-leased alert row (state, burn numbers,
+        lease); heal -> the row is DELETED. Watch mode rides the
+        stream; watch=False exercises the GetValues poll fallback."""
+        from oim_tpu.cli import oimctl
+        from oim_tpu.spec import RegistryStub
+
+        srv, pool = registry_cluster
+        events.configure(capacity=256)
+        monitor = self.make_monitor(srv.addr, pool, watch=watch)
+        try:
+            publish_row(pool, srv.addr, "r0",
+                        {"hist": {"first_token": ft_snap(100, 0)}})
+            if watch:
+                # The watch thread alone (no tick loop): the test drives
+                # tick_once with synthetic clocks.
+                monitor._watch_thread = threading.Thread(
+                    target=monitor._watch_loop, daemon=True)
+                monitor._watch_thread.start()
+                self.wait_watch_synced(monitor)
+            monitor.tick_once(now=0.0)
+            stub = RegistryStub(pool.get(srv.addr, None))
+            assert oimctl.alert_rows(stub) == []
+            publish_row(pool, srv.addr, "r0",
+                        {"hist": {"first_token": ft_snap(150, 50)}})
+            if watch:
+                deadline = time.monotonic() + 5
+                while not monitor.tick_once(now=5.0):
+                    assert time.monotonic() < deadline, \
+                        "watch never delivered the degraded row"
+                    time.sleep(0.05)
+            else:
+                assert monitor.tick_once(now=5.0)
+            rows = oimctl.alert_rows(stub)
+            assert [name for name, _ in rows] == ["first_token_p99"]
+            body = rows[0][1]
+            assert body["state"] == "firing"
+            assert body["burn_fast"] >= 10
+            assert body["monitor"] == "monitor"
+            # Ticks while firing RENEW the row (beat stamps change).
+            monitor.tick_once(now=6.0)
+            assert oimctl.alert_rows(stub)[0][1]["beat"] >= 2
+            # Heal.
+            publish_row(pool, srv.addr, "r0",
+                        {"hist": {"first_token": ft_snap(2000, 50)}})
+            resolved = False
+            deadline = time.monotonic() + 5
+            now = 20.0
+            while not resolved and time.monotonic() < deadline:
+                for t in monitor.tick_once(now=now):
+                    resolved |= t["transition"] == "resolved"
+                now += 10.0
+                time.sleep(0.02)
+            assert resolved
+            assert oimctl.alert_rows(stub) == []
+        finally:
+            monitor.stop()
+
+    def test_deregistration_closes_epoch_without_deflating(
+            self, registry_cluster):
+        srv, pool = registry_cluster
+        monitor = self.make_monitor(srv.addr, pool, watch=False)
+        try:
+            publish_row(pool, srv.addr, "r0",
+                        {"hist": {"first_token": ft_snap(7, 0)}})
+            monitor.tick_once(now=0.0)
+            assert monitor.fleet_quantiles("first_token") is not None
+            # An explicit delete (deregistration) closes the replica's
+            # epoch — history is BANKED, so merged cumulatives stay
+            # monotone and the burn windows keep their baselines
+            # (exercised through the watch delete callback's path).
+            with monitor._lock:
+                monitor.engine.forget("r0")
+                merged = monitor.engine.hists["first_token"].merged()
+            assert monitor.fleet_quantiles("first_token") is not None
+            from oim_tpu.obs import merge as merge_mod
+
+            assert merge_mod.total(merged) == 7
+        finally:
+            monitor.stop()
+
+
+class TestAlertAuthz:
+    def test_only_monitor_identity_may_write_alert_rows(self):
+        from oim_tpu.registry.registry import RegistryService
+
+        may = RegistryService._may_set
+        assert may("component.monitor", ["alert", "first_token_p99"])
+        assert may("component.monitor.b", ["alert", "x"])
+        assert may("user.admin", ["alert", "x"])
+        assert not may("component.router", ["alert", "x"])
+        assert not may("host.h0", ["alert", "x"])
+        assert not may("controller.alert", ["alert", "address"])
+        assert not may("controller.alert", ["alert", "mesh"])
+        assert not may("component.monitor", ["alert"])
+        assert not may("component.monitor", ["alert", "a", "b"])
+
+
+class TestOimctlSurfaces:
+    def entry(self, rid, snap):
+        return (rid, "ALIVE", "serve", "", snap)
+
+    def test_fleet_top_row_merges_and_dashes(self):
+        from oim_tpu.cli.oimctl import fleet_top_row, render_top
+
+        ft = {"le": [0.05, 0.1], "counts": [0, 10, 10], "sum": 0.9}
+        it = {"le": [0.05, 0.1], "counts": [20, 20, 20], "sum": 0.2}
+        entries = [
+            self.entry("r0", {"hist": {"first_token": ft,
+                                       "inter_token": it}}),
+            self.entry("r1", {"hist": {"first_token": ft}}),
+            self.entry("old", {}),  # pre-upgrade: no snapshot at all
+            ("legacy4", "ALIVE", "serve", ""),  # pre-upgrade row shape
+        ]
+        row = fleet_top_row(entries)
+        assert row["id"] == "ALL" and row["role"] == "fleet"
+        p50, p99 = row["ft_ms"]
+        assert 50 <= p50 <= 100 and p99 <= 100
+        assert row["it_ms"][0] == pytest.approx(25.0)
+        assert row["spread"] == 2  # the two snapshot contributors
+        rendered = render_top([row])
+        assert rendered.splitlines()[1].startswith("ALL")
+
+    def test_fleet_top_row_all_dashes_without_snapshots(self):
+        from oim_tpu.cli.oimctl import fleet_top_row, render_top
+
+        row = fleet_top_row([self.entry("old", {})])
+        assert row["ft_ms"] == (None, None)
+        assert "-" in render_top([row])
+
+    def test_print_alerts_and_autopsy_need_rows(self, capsys):
+        from oim_tpu.cli import oimctl
+
+        oimctl.print_alerts(lambda op: [])
+        assert "no alerts firing" in capsys.readouterr().out
+        with pytest.raises(SystemExit):
+            oimctl.print_autopsy(lambda op: [], "deadbeef")

@@ -1,0 +1,26 @@
+"""Tier-1 wiring of `make slo-smoke`: the fleet-SLO-plane acceptance
+story runs inside the normal (non-slow) test pass — the fleet-merged
+p99 lands within one bucket of the pooled-observation ground truth
+across a replica restart, a degraded replica fires exactly one
+TTL-leased alert row over a registry Watch stream and resolves after
+heal with one fired/resolved event pair, and `oimctl --autopsy`
+attributes >= 90% of one REAL routed request's wall time to named
+phases (bench.slo_smoke() itself raises on any break in the story)."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def test_slo_smoke_merge_alert_autopsy():
+    import bench
+
+    extras = bench.slo_smoke()  # raises AssertionError on a broken story
+    assert extras["slo_p99_bucket_drift"] <= 1
+    assert extras["slo_merge_observations"] == 1000
+    assert extras["slo_alert_pairs"] == 1
+    assert extras["slo_alert_burn_fast"] >= 10
+    assert extras["slo_fleet_ft_p99_ms"] > 0
+    assert extras["autopsy_coverage"] >= 0.9
+    assert {"prefill", "decode"} <= set(extras["autopsy_phases"])
