@@ -9,7 +9,7 @@
 PY ?= python
 RUFF := $(shell command -v ruff 2>/dev/null)
 
-.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke slo-smoke prefix-smoke paged-smoke spec-smoke chaos chaos-smoke quorum-smoke control-plane-bench
+.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke slo-smoke autoscale-smoke prefix-smoke paged-smoke spec-smoke chaos chaos-smoke quorum-smoke control-plane-bench
 
 # drift and tsan are standalone conveniences; the full pytest target
 # already runs both (SpecDrift + the TSAN stream test build in-fixture).
@@ -119,6 +119,16 @@ obs-smoke:
 # as tests/test_slo_smoke.py.
 slo-smoke:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --slo-smoke
+
+# Fleet-actuator acceptance loop (seconds): an SLO alert scales a
+# one-slot fleet up through oim-autoscaler, with alert-to-ready latency
+# broken into actuate/prestage/boot (the boot proven a stage-cache HIT,
+# zero source re-reads), then a rolling weight upgrade drains stale
+# replicas one cooldown at a time under routed load — zero
+# client-visible errors, byte-identical outputs. Also runs in tier-1
+# as tests/test_autoscale_smoke.py.
+autoscale-smoke:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --autoscale
 
 # Chaos ladder (minutes): seeded, scripted fault schedules over an
 # in-process cluster sim — replica SIGKILL, black-holed channel,

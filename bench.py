@@ -140,7 +140,21 @@ def main(argv=None) -> int:
                              "resolved event pair, and oimctl --autopsy "
                              "attributing >=90% of a real routed "
                              "request's wall time to named phases")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="fleet-actuator acceptance run: an SLO "
+                             "alert scaling a one-slot fleet up through "
+                             "the autoscaler, alert-to-ready latency "
+                             "broken into actuate/prestage/boot (the "
+                             "boot a stage-cache HIT with zero source "
+                             "re-reads), then a rolling weight upgrade "
+                             "under routed load with zero errors and "
+                             "byte-identical outputs")
     args = parser.parse_args(argv)
+
+    if args.autoscale:
+        print(json.dumps({"metric": "autoscale_smoke", "value": 1,
+                          "unit": "ok", "extras": autoscale_smoke()}))
+        return 0
 
     if args.slo_smoke:
         print(json.dumps({"metric": "slo_smoke", "value": 1,
@@ -2801,6 +2815,252 @@ def slo_smoke() -> dict:
         "slo_story": ("merge==pooled, alert fired+resolved over Watch, "
                       "autopsy >=90% attributed"),
     })
+    return extras
+
+
+def autoscale_smoke() -> dict:
+    """The fleet-actuator acceptance run (seconds, in-process), two
+    stories:
+
+    1. **Alert -> N ready, with a breakdown**: a one-slot fleet behind
+       a real registry + FleetMonitor; a degraded probe fires the
+       ``first_token_p99`` alert, the autoscaler (leader via the
+       TTL-leased ``fleet/`` row) spawns through the chaos sim's
+       launcher seam, and the time from the alert ROW appearing to the
+       new replica's first ready heartbeat is measured and broken into
+       actuate (alert -> spawn decision), prestage (the weights
+       fan-out) and boot (spawn -> ready heartbeat). The scale-up
+       boot's weight publish must be a stage-cache HIT with zero
+       misses: the launcher prestaged the volume to the boot
+       controller first, so the boot re-reads no source bytes.
+    2. **Rolling upgrade**: weights v2 published as a NEW
+       content-addressed volume and prestaged fleet-wide while v1
+       serves; flipping the spec's version drains stale replicas one
+       cooldown at a time (router pinning streams to their replica's
+       version) while routed load rides the mixed-version fleet with
+       zero client-visible errors and byte-identical outputs.
+
+    Wired into tier-1 as tests/test_autoscale_smoke.py and
+    `make autoscale-smoke`."""
+    import dataclasses
+    import random
+
+    import numpy as np
+
+    from oim_tpu.autoscale import Autoscaler, FleetSpec
+    from oim_tpu.chaos.sim import ClusterSim, SimReplicaLauncher, \
+        solo_tokens, wait_for
+    from oim_tpu.common import events, metrics as M
+    from oim_tpu.common.metrics import Registry
+    from oim_tpu.common.telemetry import TelemetryRegistration
+    from oim_tpu.obs.monitor import FleetMonitor
+    from oim_tpu.obs.slo import SLO, SloEngine
+    from oim_tpu.registry.registry import CONTROLLER_ID_META
+    from oim_tpu.spec import ControllerStub, pb
+
+    extras: dict = {}
+    rng = random.Random(20260806)
+    with ClusterSim(replicas=1, controllers=2, max_batch=1) as sim:
+        # Two weight generations as content-addressed raw volumes. The
+        # unversioned baseline fleet runs v1; the upgrade flips to v2.
+        data = {v: np.random.RandomState(i).bytes(120_000)
+                for i, v in enumerate(("v1", "v2"))}
+        requests = {v: pb.MapVolumeRequest(
+            volume_id=f"weights-{v}",
+            file=pb.FileParams(path=sim.tmpfile(blob), format="raw"))
+            for v, blob in data.items()}
+        feeder0 = sim.feeder("host-0")
+        feeder1 = sim.feeder("host-1")
+        feeder0.publish(requests["v1"], timeout=60)  # day-0 publish
+
+        prestage_s: dict = {}
+        ctrl = ControllerStub(sim.pool.get(
+            sim.registries[0][1].addr, None, "component.registry"))
+
+        def prestage(version: str) -> None:
+            """Publish (content-addressed, idempotent) + fan the volume
+            out to the failover/boot controller, and WAIT for the async
+            stage to land — the O(1)-boot precondition."""
+            v = version or "v1"
+            t = time.monotonic()
+            req = requests[v]
+            feeder0.publish(req, timeout=60)
+            assert feeder0.prestage_replica(req) == "host-1", \
+                "prestage fan-out never reached the standby controller"
+            assert wait_for(
+                lambda: ctrl.PrestageVolume(
+                    req, metadata=[(CONTROLLER_ID_META, "host-1")],
+                    timeout=10.0).already_cached, timeout=30), \
+                f"prestaged {v} volume never landed on host-1"
+            prestage_s[v] = time.monotonic() - t
+
+        boot_cache = {"hits": 0, "misses": 0}
+
+        class BenchLauncher(SimReplicaLauncher):
+            """The sim launcher plus the boot's weight load: each spawn
+            publishes its version's volume against the PRESTAGED
+            controller — the fetch a real oim-serve boot would issue —
+            under stage-cache hit/miss accounting."""
+
+            def spawn(self, version: str) -> str:
+                rid = super().spawn(version)
+                h0, m0 = M.STAGE_CACHE_HITS.value, M.STAGE_CACHE_MISSES.value
+                feeder1.publish(requests[version or "v1"], timeout=60)
+                boot_cache["hits"] += int(M.STAGE_CACHE_HITS.value - h0)
+                boot_cache["misses"] += int(
+                    M.STAGE_CACHE_MISSES.value - m0)
+                return rid
+
+        launcher = BenchLauncher(sim, prestage_fn=prestage)
+        hist = Registry().histogram(
+            "ft_seconds", buckets=(0.001, 0.0025, 0.005, 0.01, 0.025,
+                                   0.05, 0.1, 0.25, 0.5, 1.0, 2.5))
+        probe = TelemetryRegistration(
+            "probe", "serve", "127.0.0.1:0", sim.registry_address,
+            interval=5.0, pool=sim.pool,
+            collect=lambda: {"hist": {"first_token":
+                                      hist.merged_snapshot()}})
+
+        def beat(fast: int = 0, slow: int = 0) -> None:
+            for _ in range(fast):
+                hist.observe(rng.uniform(0.002, 0.04))
+            for _ in range(slow):
+                hist.observe(rng.uniform(0.3, 0.9))
+            probe.beat_once()
+
+        monitor = FleetMonitor(
+            sim.registry_address,
+            SloEngine([SLO(name="first_token_p99", kind="latency",
+                           objective=0.99, metric="first_token",
+                           threshold_s=0.1)],
+                      fast_window_s=0.8, slow_window_s=2.4,
+                      burn_threshold=10.0, resolve_hold_s=0.3),
+            interval=0.15, pool=sim.pool)
+        spec = FleetSpec(min_replicas=1, max_replicas=2,
+                         cooldown_s=0.4, scale_down_hold_s=300.0)
+        scaler = Autoscaler(sim.registry_address, spec, launcher,
+                            interval=0.2, pool=sim.pool)
+        watcher = sim.registry_watcher("")
+
+        def row_body(path: str) -> dict:
+            value = watcher.get(path)
+            try:
+                body = json.loads(value) if value else None
+            except ValueError:
+                body = None
+            return body if isinstance(body, dict) else {}
+
+        try:
+            monitor.start()
+            scaler.start()
+            assert wait_for(lambda: scaler.is_leader, timeout=15), \
+                "autoscaler never took the fleet row"
+            for _ in range(5):
+                beat(fast=20)  # healthy baseline
+            sim.warm()
+
+            # ---- (1) alert -> ready, with the breakdown ----------------
+            t0 = t_spawn = t_ready = None
+            deadline = time.monotonic() + 120
+            while t_ready is None:
+                assert time.monotonic() < deadline, (
+                    f"scale-up never completed: alert={t0} "
+                    f"spawn={t_spawn}")
+                if t0 is None:
+                    beat(slow=6)
+                    if watcher.get("alert/first_token_p99") is not None:
+                        t0 = time.monotonic()
+                elif t_spawn is None:
+                    beat(slow=2)  # keep the alert firing until actuation
+                    if len(sim.replicas) > 1:
+                        t_spawn = time.monotonic()
+                else:
+                    beat(fast=4)  # heal: capacity landed
+                    if row_body(
+                            f"serve/{sim.replicas[1].rid}").get("ready"):
+                        t_ready = time.monotonic()
+                time.sleep(0.05)
+            assert boot_cache["hits"] >= 1, \
+                "scale-up boot missed the prestaged stage cache"
+            assert boot_cache["misses"] == 0, (
+                f"scale-up boot re-staged from source "
+                f"({boot_cache['misses']} misses): prestage did not "
+                f"make the boot O(1)")
+            # The alert resolves (row DELETED) and the daemon's
+            # alert-to-ready histogram records the episode.
+            deadline = time.monotonic() + 60
+            while watcher.get("alert/first_token_p99") is not None \
+                    or M.AUTOSCALE_ALERT_TO_READY.count < 1:
+                assert time.monotonic() < deadline, \
+                    "alert never resolved after capacity landed"
+                beat(fast=6)
+                time.sleep(0.05)
+
+            # ---- (2) rolling upgrade under routed load -----------------
+            upgrade_reqs = [
+                ([rng.randrange(1, 64) for _ in range(4)], 4, 0.0,
+                 rng.randrange(1 << 16)) for _ in range(8)]
+            expected = [solo_tokens(p, n, temperature=t, seed=s)
+                        for p, n, t, s in upgrade_reqs]
+            scaler.set_spec(dataclasses.replace(spec, version="v2"))
+
+            def fleet_versions() -> list:
+                rows = [row_body(p) for p in list(watcher.rows)
+                        if p.startswith("serve/")]
+                return [r.get("version", "") for r in rows
+                        if r.get("ready")]
+
+            flip_waves = 0
+            checked = 0
+            flip_errors: list = []
+            deadline = time.monotonic() + 120
+            while not (len(fleet_versions()) >= 2
+                       and set(fleet_versions()) == {"v2"}):
+                assert time.monotonic() < deadline, (
+                    f"upgrade wave never converged: fleet versions "
+                    f"{fleet_versions()}")
+                beat(fast=2)
+                results, errors = sim.routed_load(
+                    upgrade_reqs, concurrency=3, timeout=60)
+                flip_waves += 1
+                flip_errors.extend(errors)
+                for exp, toks in zip(expected, results):
+                    if toks is None:
+                        continue
+                    assert toks == exp, (
+                        f"mixed-version routed output diverged: "
+                        f"{toks} != {exp}")
+                    checked += 1
+            assert not flip_errors, (
+                f"client saw errors across the rolling upgrade: "
+                f"{flip_errors[0]!r}")
+            flips = len(sim.debug_events(events.AUTOSCALE_UPGRADE_FLIP))
+            assert flips >= 1, "no upgrade-flip drain was recorded"
+        finally:
+            scaler.stop(deregister=True)
+            monitor.stop()
+            probe.stop(deregister=False)
+            launcher.join()
+
+        extras.update({
+            "autoscale_alert_to_ready_s": round(t_ready - t0, 3),
+            "autoscale_actuate_s": round(
+                t_spawn - t0 - prestage_s["v1"], 3),
+            "autoscale_prestage_s": round(prestage_s["v1"], 3),
+            "autoscale_boot_s": round(t_ready - t_spawn, 3),
+            "autoscale_boot_cache_hits": boot_cache["hits"],
+            "autoscale_boot_cache_misses": boot_cache["misses"],
+            "autoscale_alert_to_ready_observed":
+                int(M.AUTOSCALE_ALERT_TO_READY.count),
+            "autoscale_upgrade_flips": flips,
+            "autoscale_upgrade_waves": flip_waves,
+            "autoscale_upgrade_errors": len(flip_errors),
+            "autoscale_byte_identical": checked,
+            "autoscale_fleet_version": "v2",
+            "autoscale_story": ("alert->spawn->ready broken down, boot "
+                                "= stage-cache hit, rolling upgrade "
+                                "zero-error byte-identical"),
+        })
     return extras
 
 

@@ -34,6 +34,7 @@ fresh cluster per rung costs milliseconds after the first.
 from __future__ import annotations
 
 import functools
+import itertools
 import json
 import os
 import tempfile
@@ -111,10 +112,12 @@ class ReplicaHandle:
     """One serve replica (engine + server + registration) with the
     fault levers a chaos rung pulls."""
 
-    def __init__(self, sim: "ClusterSim", rid: str, engine_kwargs: dict):
+    def __init__(self, sim: "ClusterSim", rid: str, engine_kwargs: dict,
+                 version: str = ""):
         self.sim = sim
         self.rid = rid
         self.engine_kwargs = dict(engine_kwargs)
+        self.version = version
         self.engine = None
         self.server = None
         self.service = None
@@ -141,7 +144,8 @@ class ReplicaHandle:
         self.registration = ServeRegistration(
             self.rid, self.server.addr, self.engine,
             self.sim.registry_address,
-            interval=self.sim.heartbeat_s, pool=self.sim.pool)
+            interval=self.sim.heartbeat_s, pool=self.sim.pool,
+            version=self.version)
         self.registration.beat_once()  # deterministic first registration
         self.registration.start()
         self.alive = True
@@ -243,6 +247,75 @@ class ControllerHandle:
                 self.kill()
             except Exception:  # noqa: BLE001 - teardown best-effort
                 self.alive = False
+
+
+class SimReplicaLauncher:
+    """The autoscaler's ``ReplicaLauncher`` seam, in-process: spawn
+    boots a :class:`ReplicaHandle` inside this sim instead of forking an
+    ``oim-serve`` process; drain runs the same SIGTERM-shaped drain
+    path. Handles are appended to ``sim.replicas`` BEFORE the
+    background boot starts, so the leak census and teardown always see
+    them — and the autoscaler's pending-spawn tracking (not this
+    launcher) covers the boot window.
+
+    ``spawn()`` is fire-and-forget like the subprocess launcher: engine
+    init takes real time and the reconcile loop (and the standby's
+    leader gate) must keep ticking through it. ``prestage_fn``, when
+    given, is called once per new version before its first spawn — the
+    bench wires a PrestageVolume fan-out here to prove scale-up boots
+    are stage-cache hits.
+    """
+
+    def __init__(self, sim: "ClusterSim", engine_kwargs: dict | None = None,
+                 prestage_fn=None, id_prefix: str = "as"):
+        self.sim = sim
+        self.engine_kwargs = dict(sim.engine_defaults)
+        self.engine_kwargs.update(engine_kwargs or {})
+        self.prestage_fn = prestage_fn
+        self.id_prefix = id_prefix
+        self._seq = itertools.count()
+        self._prestaged: set[str] = set()
+        self._threads: list[threading.Thread] = []
+        self._lock = threading.Lock()
+
+    def prestage(self, version: str) -> None:
+        if self.prestage_fn is None or version in self._prestaged:
+            return
+        self._prestaged.add(version)
+        self.prestage_fn(version)
+
+    def spawn(self, version: str) -> str:
+        self.prestage(version)
+        with self._lock:
+            rid = f"{self.id_prefix}{next(self._seq)}"
+        handle = ReplicaHandle(self.sim, rid, self.engine_kwargs,
+                               version=version)
+        self.sim.replicas.append(handle)
+        thread = threading.Thread(target=handle.boot, daemon=True,
+                                  name=f"sim-spawn-{rid}")
+        with self._lock:
+            self._threads.append(thread)
+        thread.start()
+        return rid
+
+    def drain(self, replica_id: str) -> None:
+        for handle in self.sim.replicas:
+            if handle.rid == replica_id and handle.alive:
+                thread = threading.Thread(
+                    target=handle.drain, daemon=True,
+                    name=f"sim-drain-{replica_id}")
+                with self._lock:
+                    self._threads.append(thread)
+                thread.start()
+                return
+
+    def join(self, timeout: float = 60.0) -> None:
+        """Wait out in-flight boots/drains (rung teardown hygiene)."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            threads = list(self._threads)
+        for thread in threads:
+            thread.join(timeout=max(0.0, deadline - time.monotonic()))
 
 
 class _SimWatcher:
@@ -859,7 +932,8 @@ class ClusterSim:
         # Every pooled channel must belong to a known target (registry
         # nodes, replicas, controllers) — nothing dangling.
         known = {server.addr for _, server, _ in self.registries}
-        known |= {h.server.addr for h in self.replicas}
+        known |= {h.server.addr for h in self.replicas
+                  if h.server is not None}
         known |= {h.server.addr for h in self.controllers}
         strays = [t for t in self.pool.targets() if t not in known]
         if strays:

@@ -215,6 +215,13 @@ def main(argv: list[str] | None = None) -> int:
              "under this id; alone in remote mode: restore the already-"
              "mapped volume)")
     parser.add_argument(
+        "--weights-version", default="",
+        help="weights version advertised in the serve/<id> row (rolling "
+             "upgrades: the autoscaler drains replicas whose advertised "
+             "version differs from the declared one, and routers pin a "
+             "retried request to its first attempt's version). Empty = "
+             "unversioned")
+    parser.add_argument(
         "--restore-only", action="store_true",
         help="remote mode without --weights-file: restore "
              "--weights-volume as already mapped on the controller")
@@ -425,7 +432,7 @@ def main(argv: list[str] | None = None) -> int:
         registration = ServeRegistration(
             args.serve_id, advertise, engine,
             args.registry, interval=args.heartbeat,
-            tls=load_tls_flags(args))
+            tls=load_tls_flags(args), version=args.weights_version)
         registration.start()
         log.info("registered in routing table", serve_id=args.serve_id,
                  advertise=advertise, heartbeat_s=args.heartbeat)

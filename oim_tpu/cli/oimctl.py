@@ -700,6 +700,71 @@ class AlertWatch(_PrefixWatch):
             return sorted(self._alerts.items())
 
 
+class FleetWatch(_PrefixWatch):
+    """The FLEET banner's ``Watch("fleet")`` stream: the autoscaler's
+    TTL-leased desired-state row lands push-style, and an expiry (dead
+    autoscaler with no standby) or delete (clean stop) clears it — the
+    banner dashing out IS the "nobody is holding the wheel" signal."""
+
+    PREFIX = "fleet"
+
+    def __init__(self, with_failover):
+        self._fleet: dict[str, dict] = {}
+        super().__init__(with_failover)
+
+    def _install(self, rows: dict) -> None:
+        with self._lock:
+            self._fleet = {
+                path.partition("/")[2]: self._parse_body(value)
+                for path, value in rows.items()}
+
+    def _put(self, path: str, value: str) -> None:
+        with self._lock:
+            self._fleet[path.partition("/")[2]] = self._parse_body(value)
+
+    def _delete(self, path: str, expired: bool) -> None:
+        with self._lock:
+            self._fleet.pop(path.partition("/")[2], None)
+
+    def rows(self) -> list[tuple[str, dict]]:
+        with self._lock:
+            return sorted(self._fleet.items())
+
+
+def fleet_rows(stub) -> list[tuple[str, dict]]:
+    """(name, row body) per live ``fleet/<name>`` registry row — the
+    TTL-leased desired-state rows the leading oim-autoscaler publishes
+    (the lease filter makes a dead autoscaler's claim vanish)."""
+    from oim_tpu.common.pathutil import REGISTRY_FLEET
+
+    return sorted(
+        (value.path.partition("/")[2], _PrefixWatch._parse_body(value.value))
+        for value in stub.GetValues(
+            pb.GetValuesRequest(path=REGISTRY_FLEET), timeout=10).values)
+
+
+def fleet_banner(rows) -> str:
+    """The --top FLEET line: the autoscaler's declared-vs-actual fleet.
+    Every field dash-degrades — no autoscaler row (none deployed, or
+    the leader died with no standby), a pre-autoscaler registry, or a
+    row missing fields all render as "-" rather than breaking the
+    table (the PAGES/ACCEPT mixed-version stance)."""
+    body = dict(rows).get("autoscaler") if rows else None
+    if not isinstance(body, dict):
+        body = {}
+
+    def field(key):
+        value = body.get(key)
+        return "-" if value is None or value == "" else value
+
+    alerts = body.get("alerts")
+    firing = ",".join(alerts) if isinstance(alerts, list) and alerts else "-"
+    return (f"FLEET  leader={field('autoscaler')}"
+            f"  desired={field('desired')}  ready={field('ready')}"
+            f"  min={field('min')}  max={field('max')}"
+            f"  version={field('version')}  alerts={firing}")
+
+
 def alert_rows(stub) -> list[tuple[str, dict]]:
     """(name, alert body) per live ``alert/<name>`` registry row — the
     TTL-leased rows oim-monitor publishes while an SLO burns (the lease
@@ -729,7 +794,8 @@ def print_alerts(with_failover) -> None:
         if body.get("kind") == "latency":
             detail = (f" target p{body.get('objective', 0) * 100:.0f}"
                       f"<={float(body.get('threshold_s', 0)) * 1e3:.0f}ms")
-        print(f"{name}\tFIRING\tburn_fast={body.get('burn_fast', '?')}"
+        print(f"{name}\tFIRING\tdir={body.get('direction', '?')}"
+              f"\tburn_fast={body.get('burn_fast', '?')}"
               f"\tburn_slow={body.get('burn_slow', '?')}"
               f"\tthreshold={body.get('threshold', '?')}"
               f"\tfor={age}{detail}")
@@ -773,10 +839,12 @@ def print_top(with_failover, watch: float = 0.0) -> None:
     import grpc as grpc_mod
 
     watcher = TelemetryWatch(with_failover) if watch > 0 else None
-    # The banner rides its own alert stream in watch mode — a --watch
-    # session must not re-add a per-refresh GetValues for alerts after
-    # the telemetry stream removed the row reads.
+    # The banners ride their own streams in watch mode — a --watch
+    # session must not re-add per-refresh GetValues reads for alerts
+    # (or the fleet row) after the telemetry stream removed the row
+    # reads.
     alert_watcher = AlertWatch(with_failover) if watch > 0 else None
+    fleet_watcher = FleetWatch(with_failover) if watch > 0 else None
     first = True
     try:
         while True:
@@ -793,12 +861,21 @@ def print_top(with_failover, watch: float = 0.0) -> None:
                     firing = with_failover(alert_rows)
                 except grpc_mod.RpcError:
                     firing = []  # the table must render through a blip
+            if fleet_watcher is not None and fleet_watcher.usable(
+                    timeout=2.0 if first else 0.0):
+                fleet = fleet_watcher.rows()
+            else:
+                try:
+                    fleet = with_failover(fleet_rows)
+                except grpc_mod.RpcError:
+                    fleet = []  # dash-degrade, never break the table
             first = False
             rows = [top_row(*entry) for entry in entries]
             if rows:
                 rows.insert(0, fleet_top_row(entries))
             if watch > 0:
                 print("\033[2J\033[H", end="")  # clear + home, like top(1)
+            print(fleet_banner(fleet))
             if firing:
                 names = ", ".join(name for name, _ in firing)
                 print(f"*** FIRING: {names} (oimctl --alerts for "
@@ -820,6 +897,8 @@ def print_top(with_failover, watch: float = 0.0) -> None:
             watcher.stop()
         if alert_watcher is not None:
             alert_watcher.stop()
+        if fleet_watcher is not None:
+            fleet_watcher.stop()
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -77,6 +77,17 @@ SPEC_FALLBACK = "spec_fallback"
 # so fired/resolved events always arrive in matched pairs.
 SLO_ALERT_FIRED = "slo_alert_fired"
 SLO_ALERT_RESOLVED = "slo_alert_resolved"
+# Fleet actuator (oim_tpu/autoscale): the reconcile loop spawned a
+# replica toward a higher target / drained one toward a lower target
+# (scale_down also covers the stale half of an upgrade flip, with
+# reason="upgrade"); upgrade_flip marks one replica's version rollover
+# completing (stale drained, successor ready). Takeover fires when an
+# autoscaler claims the fleet/ leadership row — once at first election,
+# and again on every standby promotion after a leader death.
+AUTOSCALE_SCALE_UP = "autoscale_scale_up"
+AUTOSCALE_SCALE_DOWN = "autoscale_scale_down"
+AUTOSCALE_UPGRADE_FLIP = "autoscale_upgrade_flip"
+AUTOSCALE_TAKEOVER = "autoscale_takeover"
 
 DEFAULT_CAPACITY = 2048
 

@@ -651,6 +651,33 @@ SLO_ALERTS_FIRING = DEFAULT.gauge(
     "oim_slo_alerts_firing",
     "SLO alerts currently in a firing episode on this monitor (each is "
     "mirrored as a TTL-leased alert/<name> registry row)")
+# Fleet actuator (oim_tpu/autoscale: SLO-driven reconcile loop; the
+# oim-autoscaler daemon records these while it holds leadership).
+AUTOSCALE_REPLICAS_DESIRED = DEFAULT.gauge(
+    "oim_autoscale_replicas_desired",
+    "the reconciler's current replica target: the declared minimum, "
+    "stepped up one per cooldown while an alert/ row fires and decayed "
+    "back after the alert-free hold (mirrored in the fleet/ desired-"
+    "state row `oimctl --top` banners)")
+AUTOSCALE_REPLICAS_READY = DEFAULT.gauge(
+    "oim_autoscale_replicas_ready",
+    "serve/ rows the autoscaler observes ready:true — desired minus "
+    "ready is the fleet's actuation lag, the gap bench.py --autoscale "
+    "times end to end")
+AUTOSCALE_ACTIONS_TOTAL = DEFAULT.counter(
+    "oim_autoscale_actions_total",
+    "reconcile actions executed through the ReplicaLauncher, by action "
+    "(spawn = boot a replica toward the target, drain = SIGTERM-contract "
+    "drain of the worst-scoring replica; upgrade flips are a spawn + a "
+    "drain with reason=upgrade)",
+    labelnames=("action",))
+AUTOSCALE_ALERT_TO_READY = DEFAULT.histogram(
+    "oim_autoscale_alert_to_ready_seconds",
+    "seconds from an alert/ row first observed to every replica of the "
+    "raised target heartbeating ready:true — THE number the prestaged "
+    "O(1) boot path exists to minimize (spawn/prestage/first-ready "
+    "breakdown in bench.py --autoscale)",
+    buckets=(0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0))
 # Labeled RPC telemetry (common/tracing.py interceptors — the
 # go-grpc-prometheus analog; recorded by client and server vantage alike).
 RPC_LATENCY = DEFAULT.histogram(

@@ -29,6 +29,14 @@ REGISTRY_TELEMETRY = "telemetry"
 # prefix; a dead monitor's alerts expire with their lease. Reserved like
 # ``serve``/``telemetry``: no controller may register under this id.
 REGISTRY_ALERT = "alert"
+# Top-level namespace for the fleet actuator: ``fleet/<name>`` -> JSON
+# desired-state row, published TTL-leased by oim-autoscaler while it
+# holds leadership (oim_tpu/autoscale/daemon.py). The lease doubles as
+# the leader election: a standby autoscaler defers while the row's
+# monotonic beat progresses and claims the key once it freezes or the
+# lease lapses. Reserved like ``alert``: writable only by
+# ``component.autoscaler``, never registrable as a controller id.
+REGISTRY_FLEET = "fleet"
 
 
 def split_registry_path(path: str) -> list[str]:

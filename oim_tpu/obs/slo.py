@@ -321,6 +321,15 @@ class SloEngine:
             body["threshold_s"] = slo.threshold_s
         if episode.firing:
             body["since"] = round(episode.since, 3)
+            # The actuator-facing hint: "up" while the burn rate still
+            # breaches (add capacity), "down" once the episode is inside
+            # its resolve-hysteresis hold (the breach cleared; the alert
+            # only persists so a flap can't silence it early). Readers
+            # that predate the field treat a bare firing row as "up" —
+            # and writers that predate it omit it, so consumers default
+            # the same way (mixed-version safe in both directions).
+            body["direction"] = ("up" if burn_fast >= self.burn_threshold
+                                 else "down")
         return body
 
     def firing(self) -> list[str]:

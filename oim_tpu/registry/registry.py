@@ -32,6 +32,7 @@ from oim_tpu.common.logging import from_context
 from oim_tpu.common.pathutil import (
     REGISTRY_ADDRESS,
     REGISTRY_ALERT,
+    REGISTRY_FLEET,
     REGISTRY_MESH,
     REGISTRY_SERVE,
     REGISTRY_TELEMETRY,
@@ -139,19 +140,28 @@ class RegistryService(RegistryServicer):
             # the telemetry own-row rule cannot apply here.
             return peer == "component.monitor" \
                 or peer.startswith("component.monitor.")
+        if len(path_parts) == 2 and path_parts[0] == REGISTRY_FLEET:
+            # The actuator's fleet/<name> desired-state rows: only an
+            # autoscaler identity (component.autoscaler, or a
+            # dot-suffixed variant for an HA standby) may publish them.
+            # The row IS the leader lease — a forged fleet row would
+            # both lie to `oimctl --top` and fence out the real leader.
+            return peer == "component.autoscaler" \
+                or peer.startswith("component.autoscaler.")
         if peer.startswith("controller."):
             controller_id = peer[len("controller."):]
             return (
                 len(path_parts) == 2
                 and path_parts[0] == controller_id
-                # "serve", "telemetry" and "alert" are reserved
+                # "serve", "telemetry", "alert" and "fleet" are reserved
                 # namespaces: a controller named serve could otherwise
                 # write serve/address — and its Heartbeat would
                 # prefix-renew EVERY replica's lease (same hole for
-                # telemetry and alert rows).
+                # telemetry, alert and fleet rows).
                 and controller_id not in (REGISTRY_SERVE,
                                           REGISTRY_TELEMETRY,
-                                          REGISTRY_ALERT)
+                                          REGISTRY_ALERT,
+                                          REGISTRY_FLEET)
                 and path_parts[1] in (REGISTRY_ADDRESS, REGISTRY_MESH)
             )
         if peer.startswith("host.") and len(path_parts) == 2 \
@@ -351,9 +361,9 @@ class RegistryService(RegistryServicer):
                     f"not an id",
                 )
             if request.controller_id in (REGISTRY_SERVE, REGISTRY_TELEMETRY,
-                                         REGISTRY_ALERT):
+                                         REGISTRY_ALERT, REGISTRY_FLEET):
                 # Renewal is prefix-scoped: a "serve"/"telemetry"/"alert"
-                # heartbeat would renew EVERY row's lease in that
+                # /"fleet" heartbeat would renew EVERY row's lease in that
                 # namespace at once. Those rows renew individually via
                 # the batch `keys` list (or by re-publishing).
                 context.abort(

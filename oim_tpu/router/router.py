@@ -28,7 +28,12 @@ Retry contract — before the first token delta ONLY: a replica answering
 ``RESOURCE_EXHAUSTED`` (admission queue full) or ``UNAVAILABLE``
 (dead/draining) is retried once on the NEXT replica by score, and
 ``UNAVAILABLE`` additionally evicts the replica from the table until a
-registry poll proves it back. After the first token has streamed, any
+registry poll proves it back. During a rolling weight upgrade the
+re-pick prefers replicas advertising the FIRST attempt's ``version``
+when any remain (a response must not splice two models), and streams
+past the first token never migrate at all — which is the whole
+version-pinning contract: an in-flight stream stays on the replica
+(hence the version) it started on. After the first token has streamed, any
 upstream failure surfaces to the client unchanged: a sampled stream must
 never be silently replayed — the retry would re-sample and splice two
 different completions into one response.
@@ -177,16 +182,27 @@ class RouterService:
 
     def _pick(self, exclude: frozenset | set = frozenset(),
               prompt=None, prefix_len: int = 0,
-              hash_cache: dict | None = None
+              hash_cache: dict | None = None,
+              prefer_version: str = ""
               ) -> tuple[Replica | None, bool]:
         """(replica, was_affinity_pick); the one pick implementation.
         ``hash_cache`` is the per-request hash memo (block size ->
-        chain hashes) — _route passes one dict across retry attempts."""
+        chain hashes) — _route passes one dict across retry attempts.
+        ``prefer_version`` is the rolling-upgrade pin: a retry re-pick
+        prefers replicas advertising the FIRST attempt's weights version
+        (the two halves of one response must come from one model), but
+        falls back to any routable replica when none remain — a
+        preference, never a filter, so the last v1 replica draining
+        mid-upgrade cannot strand a retry (mixed-version safe)."""
         faultinject.fire("router.pick", tried=len(exclude))
         candidates = [r for r in self.table.replicas()
                       if r.replica_id not in exclude]
         if not candidates:
             return None, False
+        if prefer_version:
+            same = [r for r in candidates if r.version == prefer_version]
+            if same:
+                candidates = same
         affine = self.affinity and bool(prompt)
         hash_cache = hash_cache if hash_cache is not None else {}
         if affine:
@@ -290,11 +306,14 @@ class RouterService:
         tried: set[str] = set()
         last_err: grpc.RpcError | None = None
         hash_cache: dict = {}  # one hashing of the prompt per request
+        pinned_version = ""  # the first pick's advertised weights version
         for attempt in range(self.MAX_ATTEMPTS):
             replica, affine = self._pick(tried, prompt, prefix_len,
-                                         hash_cache)
+                                         hash_cache, pinned_version)
             if replica is None:
                 break
+            if attempt == 0:
+                pinned_version = replica.version
             tried.add(replica.replica_id)
             rid = replica.replica_id
             span.attrs["replica"] = rid

@@ -64,6 +64,11 @@ class Replica:
     # disabled) — they stay routable, just never attract affinity.
     prefix_block: int = 0
     prefix_hashes: frozenset = frozenset()
+    # Weights-version advertisement (rolling upgrades): "" for replicas
+    # that predate the field or run unversioned. The router only uses it
+    # as a soft retry preference — a version is never a routability
+    # filter, so a mixed-version fleet keeps every row in play.
+    version: str = ""
 
     @classmethod
     def parse(cls, path: str, value: str) -> "Replica | None":
@@ -100,6 +105,8 @@ class Replica:
                 ready=bool(snap.get("ready", True)),
                 prefix_block=block,
                 prefix_hashes=frozenset(hashes),
+                version=(snap["version"]
+                         if isinstance(snap.get("version"), str) else ""),
             )
         except (TypeError, ValueError):
             return None

@@ -87,6 +87,7 @@ class ServeRegistration(RegistryRowPublisher):
         lease_seconds: float = 0.0,
         tls: TLSConfig | None = None,
         pool: channelpool.ChannelPool | None = None,
+        version: str = "",
     ):
         # republish_every=1: the load row PUBLISHES every beat, never
         # batch-renews — the snapshot is the advertisement (load, prefix
@@ -101,9 +102,19 @@ class ServeRegistration(RegistryRowPublisher):
         self.serve_id = serve_id
         self.endpoint = endpoint
         self.engine = engine
+        # Weights-version advertisement for rolling upgrades: stamped
+        # into every heartbeat so the router can tell v1 from v2 rows
+        # and the autoscaler can drain stale replicas one at a time.
+        # Empty = unversioned (pre-upgrade build or operator opt-out):
+        # the row simply carries no "version" key, and readers treat
+        # that as "any version" (mixed-version safe).
+        self.version = version
 
     def snapshot(self) -> dict:
-        return load_snapshot(self.endpoint, self.engine)
+        snap = load_snapshot(self.endpoint, self.engine)
+        if self.version:
+            snap["version"] = self.version
+        return snap
 
     def beat_once(self, ready: bool | None = None) -> dict:
         """One heartbeat: publish the current load snapshot with the

@@ -1,0 +1,596 @@
+"""The autoscaler's decision core, pinned transition by transition.
+
+reconcile.plan() is a pure function of (spec, observed, alerts, now,
+state), so every fleet-sizing rule is pinned here with explicit clocks
+and hand-built fleets — no registry, no sleeps: boot-to-min repair
+(cooldown-exempt), alert step-up gated on the previous step landing,
+flap damping, max-cap clamping, lazy scale-down after the alert-free
+hold, scale-to-zero, direction-aware alert rows (missing direction
+reads as "up" — mixed-version safe), the rolling-upgrade wave
+(surge-then-drain, drain-first at max), and the worst-score drain
+victim.
+
+LeaderGate is pinned against the failure the beat stamp exists for: a
+dead leader's frozen row — replayed by a Watch RESET resync or a stale
+cache — must never be re-admitted as fresh, while genuine beat
+progress keeps a live leader's claim indefinitely.
+
+The daemon half (Autoscaler.tick_once) runs against a real in-process
+registry with a fake launcher and injected clocks: the fleet view over
+GetValues, pending-spawn synthesis (no double-spawn while a boot is in
+flight, repair after the pending timeout), the TTL-leased fleet/ row
+with its monotonic beat, alert-to-ready tracking, and the leadership
+handoff — a standby defers while the leader's beat progresses, then
+takes over and ADOPTS the published target (crash) or promotes
+instantly on the pushed delete (clean stop).
+"""
+
+import itertools
+import json
+
+import pytest
+
+from oim_tpu.common import events, metrics as M
+from oim_tpu.autoscale.reconcile import (
+    NEVER,
+    Action,
+    FleetSpec,
+    LeaderGate,
+    ObservedReplica,
+    ReconcileState,
+    plan,
+    wants_scale_up,
+)
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+def rep(rid, ready=True, version="", score=0):
+    return ObservedReplica(replica_id=rid, ready=ready, version=version,
+                           score=score)
+
+
+UP = {"state": "firing", "direction": "up"}
+DOWN = {"state": "firing", "direction": "down"}
+
+
+class TestPlan:
+    def test_first_plan_repairs_to_min_without_cooldown_stamp(self):
+        """Boot: target adopts min_replicas and the missing replicas
+        spawn as cooldown-exempt repairs (damping slows decisions, not
+        recovery)."""
+        spec = FleetSpec(min_replicas=2, max_replicas=4, cooldown_s=15.0)
+        actions, state = plan(spec, [], {}, 0.0, ReconcileState())
+        assert actions == [Action("spawn", reason="repair"),
+                           Action("spawn", reason="repair")]
+        assert state.target == 2
+        assert state.last_action_at == NEVER  # repair is not a decision
+
+    def test_died_replica_repairs_immediately_inside_cooldown(self):
+        spec = FleetSpec(min_replicas=2, max_replicas=4, cooldown_s=15.0)
+        state = ReconcileState(target=2, last_action_at=9.0)
+        actions, state = plan(spec, [rep("r0")], {}, 10.0, state)
+        assert actions == [Action("spawn", reason="repair")]
+        assert state.target == 2
+        assert state.last_action_at == 9.0
+
+    def test_alert_steps_up_one_and_stamps_cooldown(self):
+        spec = FleetSpec(min_replicas=1, max_replicas=3, cooldown_s=10.0)
+        actions, state = plan(
+            spec, [rep("r0")], {"hot": UP}, 5.0, ReconcileState(target=1))
+        assert actions == [Action("spawn", reason="alert:hot")]
+        assert state.target == 2
+        assert state.last_action_at == 5.0
+
+    def test_alert_step_up_waits_for_previous_step_to_land(self):
+        """One alert grows the fleet one BOOT at a time: no further
+        step while ready lags the target (the pending spawn counts as
+        observed-not-ready), then the next cooled tick steps again."""
+        spec = FleetSpec(min_replicas=1, max_replicas=3, cooldown_s=10.0)
+        state = ReconcileState(target=2, last_action_at=5.0)
+        booting = [rep("r0"), rep("p0", ready=False)]
+        actions, state = plan(spec, booting, {"hot": UP}, 20.0, state)
+        assert actions == []  # cooled, but ready(1) < target(2)
+        assert state.target == 2
+        landed = [rep("r0"), rep("p0")]
+        actions, state = plan(spec, landed, {"hot": UP}, 20.0, state)
+        assert actions == [Action("spawn", reason="alert:hot")]
+        assert state.target == 3
+
+    def test_cooldown_damps_alert_flapping(self):
+        spec = FleetSpec(min_replicas=1, max_replicas=3, cooldown_s=10.0)
+        state = ReconcileState(target=2, last_action_at=5.0)
+        actions, state = plan(
+            spec, [rep("r0"), rep("r1")], {"hot": UP}, 14.9, state)
+        assert actions == []
+        assert state.target == 2
+
+    def test_max_cap_clamps_step_up(self):
+        spec = FleetSpec(min_replicas=1, max_replicas=2, cooldown_s=10.0)
+        state = ReconcileState(target=2)
+        actions, state = plan(
+            spec, [rep("r0"), rep("r1")], {"hot": UP}, 100.0, state)
+        assert actions == []
+        assert state.target == 2
+
+    def test_scale_down_only_after_alert_free_hold(self):
+        spec = FleetSpec(min_replicas=1, max_replicas=3, cooldown_s=10.0,
+                         scale_down_hold_s=60.0)
+        fleet = [rep("r0", score=1), rep("r1", score=5)]
+        # The first alert-free plan stamps clear_since (not cooled yet).
+        actions, state = plan(
+            spec, fleet, {}, 5.0, ReconcileState(target=2, last_action_at=0.0))
+        assert actions == [] and state.clear_since == 5.0
+        # Cooled but inside the hold: still no shrink.
+        actions, state = plan(spec, fleet, {}, 30.0, state)
+        assert actions == [] and state.target == 2
+        # Past the hold: one step down, draining the WORST score.
+        actions, state = plan(spec, fleet, {}, 70.0, state)
+        assert actions == [Action("drain", replica_id="r1", reason="idle")]
+        assert state.target == 1 and state.last_action_at == 70.0
+        # At min: decay stops.
+        actions, state = plan(spec, [rep("r0", score=1)], {}, 200.0, state)
+        assert actions == [] and state.target == 1
+
+    def test_alert_resets_the_hold_clock(self):
+        spec = FleetSpec(min_replicas=1, max_replicas=3, cooldown_s=1.0,
+                         scale_down_hold_s=60.0)
+        state = ReconcileState(target=2, last_action_at=0.0, clear_since=0.0)
+        _, state = plan(spec, [rep("r0"), rep("r1")], {"hot": DOWN},
+                        59.0, state)
+        assert state.clear_since is None
+        _, state = plan(spec, [rep("r0"), rep("r1")], {}, 61.0, state)
+        assert state.clear_since == 61.0  # the hold starts over
+
+    def test_scale_to_zero_and_alert_wakes_it(self):
+        spec = FleetSpec(min_replicas=0, max_replicas=1, cooldown_s=1.0,
+                         scale_down_hold_s=60.0)
+        # First plan with min=0 wants nothing.
+        actions, state = plan(spec, [], {}, 0.0, ReconcileState())
+        assert actions == [] and state.target == 0
+        # A carried target of 1 decays to zero and drains the last one.
+        state = ReconcileState(target=1, last_action_at=NEVER,
+                               clear_since=0.0)
+        actions, state = plan(spec, [rep("r0")], {}, 61.0, state)
+        assert actions == [Action("drain", replica_id="r0", reason="idle")]
+        assert state.target == 0
+        # From zero, a firing alert boots the first replica (ready 0 >=
+        # target 0: the landed-gate is satisfied vacuously).
+        actions, state = plan(spec, [], {"hot": UP}, 100.0, state)
+        assert actions == [Action("spawn", reason="alert:hot")]
+        assert state.target == 1
+
+    def test_direction_down_never_steps_up_but_blocks_shrink(self):
+        """A direction:"down" alert asks for drains, not spawns — but
+        while ANY alert fires the idle decay stays off (shrinking is
+        scale_down_hold_s of silence, never a reflex)."""
+        spec = FleetSpec(min_replicas=1, max_replicas=3, cooldown_s=1.0,
+                         scale_down_hold_s=10.0)
+        state = ReconcileState(target=2, last_action_at=0.0, clear_since=0.0)
+        actions, state = plan(
+            spec, [rep("r0"), rep("r1")], {"cold": DOWN}, 50.0, state)
+        assert actions == [] and state.target == 2
+
+    def test_missing_direction_reads_as_up(self):
+        """Rows from a pre-direction monitor (and garbage) must read as
+        "add capacity" — mixed-version safe, and never shrink under an
+        active alert."""
+        assert wants_scale_up({"direction": "up"})
+        assert not wants_scale_up({"direction": "down"})
+        assert wants_scale_up({})
+        assert wants_scale_up("garbage")
+        assert wants_scale_up(None)
+        spec = FleetSpec(min_replicas=1, max_replicas=2, cooldown_s=1.0)
+        actions, state = plan(
+            spec, [rep("r0")], {"old": {"state": "firing"}}, 5.0,
+            ReconcileState(target=1))
+        assert actions == [Action("spawn", reason="alert:old")]
+
+    def test_pending_spawn_prevents_duplicate(self):
+        """The caller contract: observed includes launches in flight,
+        so re-planning mid-boot never spawns twice."""
+        spec = FleetSpec(min_replicas=2, max_replicas=2)
+        state = ReconcileState(target=2)
+        actions, _ = plan(
+            spec, [rep("r0"), rep("p0", ready=False)], {}, 0.0, state)
+        assert actions == []
+
+    def test_drain_waits_for_ready_surplus(self):
+        """Shrink only out of READY capacity: draining while a boot is
+        in flight would dip below target."""
+        spec = FleetSpec(min_replicas=1, max_replicas=3, cooldown_s=1.0)
+        state = ReconcileState(target=1, last_action_at=NEVER)
+        actions, _ = plan(
+            spec, [rep("r0"), rep("p0", ready=False)], {}, 10.0, state)
+        assert actions == []
+
+    def test_upgrade_surges_then_drains_stale(self):
+        """Below max: spawn one fresh-version replica first, and only
+        once the fleet is whole again drain one stale — capacity never
+        dips below target mid-flip."""
+        spec = FleetSpec(min_replicas=1, max_replicas=2, version="v2",
+                         cooldown_s=10.0)
+        state = ReconcileState(target=1)
+        actions, state = plan(spec, [rep("r0", version="v1")], {}, 0.0, state)
+        assert actions == [Action("spawn", version="v2", reason="upgrade")]
+        assert state.last_action_at == 0.0  # flips are damped decisions
+        surged = [rep("r0", version="v1"), rep("as0", version="v2")]
+        actions, state = plan(spec, surged, {}, 5.0, state)
+        assert actions == []  # not cooled
+        actions, state = plan(spec, surged, {}, 10.0, state)
+        assert actions == [
+            Action("drain", replica_id="r0", reason="upgrade")]
+        # Converged: nothing left to do.
+        actions, _ = plan(spec, [rep("as0", version="v2")], {}, 20.0, state)
+        assert actions == []
+
+    def test_upgrade_at_max_drains_first_and_prefers_stale(self):
+        spec = FleetSpec(min_replicas=2, max_replicas=2, version="v2",
+                         cooldown_s=1.0)
+        state = ReconcileState(target=2, last_action_at=NEVER)
+        fleet = [rep("r0", version="v1", score=3),
+                 rep("r1", version="v1", score=1)]
+        actions, _ = plan(spec, fleet, {}, 10.0, state)
+        # No surge headroom: flip drain-first, worst-scoring stale row.
+        assert actions == [
+            Action("drain", replica_id="r0", reason="upgrade")]
+        # Mixed fleet mid-wave: the stale replica is drained even when a
+        # fresh one scores worse.
+        mixed = [rep("r0", version="v2", score=9),
+                 rep("r1", version="v1", score=0), rep("r2", version="v2")]
+        actions, _ = plan(
+            spec, mixed, {}, 10.0,
+            ReconcileState(target=2, last_action_at=NEVER))
+        assert actions == [
+            Action("drain", replica_id="r1", reason="upgrade")]
+
+    def test_upgrade_pauses_while_alert_fires(self):
+        """An upgrade never competes with an incident: version pressure
+        waits out the alert."""
+        spec = FleetSpec(min_replicas=2, max_replicas=2, version="v2",
+                         cooldown_s=1.0)
+        state = ReconcileState(target=2, last_action_at=NEVER)
+        fleet = [rep("r0", version="v1"), rep("r1", version="v1")]
+        actions, _ = plan(spec, fleet, {"hot": UP}, 10.0, state)
+        assert actions == []
+
+    def test_drain_tie_breaks_deterministically(self):
+        spec = FleetSpec(min_replicas=1, max_replicas=3, cooldown_s=1.0,
+                         scale_down_hold_s=1.0)
+        state = ReconcileState(target=2, last_action_at=NEVER,
+                               clear_since=0.0)
+        fleet = [rep("r0", score=2), rep("r1", score=2)]
+        actions, _ = plan(spec, fleet, {}, 10.0, state)
+        assert actions == [Action("drain", replica_id="r1", reason="idle")]
+
+
+class TestLeaderGate:
+    def test_absent_row_means_lead(self):
+        gate = LeaderGate("as-b", stale_after_s=2.0)
+        assert gate.observe(None, 0.0)
+        assert gate.leading
+
+    def test_own_row_means_lead(self):
+        gate = LeaderGate("as-a", stale_after_s=2.0)
+        assert gate.observe({"autoscaler": "as-a", "beat": 1}, 0.0)
+
+    def test_foreign_fresh_row_defers_while_beat_progresses(self):
+        gate = LeaderGate("as-b", stale_after_s=2.0)
+        assert not gate.observe({"autoscaler": "as-a", "beat": 1}, 0.0)
+        assert not gate.observe({"autoscaler": "as-a", "beat": 2}, 1.9)
+        # Progress at 1.9 restarted the clock: still fresh at 3.8.
+        assert not gate.observe({"autoscaler": "as-a", "beat": 3}, 3.8)
+
+    def test_frozen_beat_past_stale_after_means_lead(self):
+        gate = LeaderGate("as-b", stale_after_s=2.0)
+        assert not gate.observe({"autoscaler": "as-a", "beat": 5}, 0.0)
+        assert not gate.observe({"autoscaler": "as-a", "beat": 5}, 1.9)
+        assert gate.observe({"autoscaler": "as-a", "beat": 5}, 2.0)
+
+    def test_replayed_stale_beat_never_refreshes(self):
+        """THE anti-replay pin: a Watch RESET resync (or stale cache)
+        re-delivering the dead leader's old beats must not extend its
+        claim — only beats HIGHER than any seen count as progress."""
+        gate = LeaderGate("as-b", stale_after_s=2.0)
+        assert not gate.observe({"autoscaler": "as-a", "beat": 7}, 0.0)
+        # Replays: an equal beat, then an OLDER one.
+        assert not gate.observe({"autoscaler": "as-a", "beat": 7}, 1.5)
+        assert not gate.observe({"autoscaler": "as-a", "beat": 6}, 1.9)
+        assert gate.observe({"autoscaler": "as-a", "beat": 7}, 2.0)
+
+    def test_new_owner_restarts_the_freshness_clock(self):
+        gate = LeaderGate("as-c", stale_after_s=2.0)
+        assert not gate.observe({"autoscaler": "as-a", "beat": 9}, 0.0)
+        # as-a dies; as-b claims the row just before as-c would.
+        assert not gate.observe({"autoscaler": "as-b", "beat": 1}, 1.9)
+        assert not gate.observe({"autoscaler": "as-b", "beat": 2}, 3.0)
+        # as-b freezes too: as-c finally leads off ITS stale clock.
+        assert gate.observe({"autoscaler": "as-b", "beat": 2}, 5.0)
+
+    def test_unreadable_row_does_not_fence(self):
+        gate = LeaderGate("as-b", stale_after_s=2.0)
+        assert gate.observe("not-a-dict", 0.0)
+        # And a beat-less foreign row goes stale on schedule.
+        gate = LeaderGate("as-b", stale_after_s=2.0)
+        assert not gate.observe({"autoscaler": "as-a"}, 0.0)
+        assert gate.observe({"autoscaler": "as-a"}, 2.5)
+
+    def test_losing_leadership_to_a_fresh_claim(self):
+        """A gate that led (absent row) must defer the moment a rival's
+        row appears fresh — the second autoscaler yields, not fights."""
+        gate = LeaderGate("as-b", stale_after_s=2.0)
+        assert gate.observe(None, 0.0)
+        assert not gate.observe({"autoscaler": "as-a", "beat": 1}, 1.0)
+
+
+# -- the daemon against a real in-process registry -------------------------
+
+
+@pytest.fixture()
+def registry():
+    from oim_tpu.common.channelpool import ChannelPool
+    from oim_tpu.registry import MemRegistryDB, RegistryService
+    from oim_tpu.registry.registry import registry_server
+
+    pool = ChannelPool()
+    srv = registry_server(
+        "tcp://localhost:0", RegistryService(db=MemRegistryDB()))
+    yield srv, pool
+    srv.force_stop()
+    pool.close()
+
+
+class FakeLauncher:
+    """Records actuations; replicas never actually boot — tests publish
+    (or withhold) the serve/ row themselves."""
+
+    def __init__(self):
+        self.spawned = []  # (rid, version)
+        self.drained = []
+        self._seq = itertools.count()
+
+    def prestage(self, version):
+        pass
+
+    def spawn(self, version):
+        rid = f"fake{next(self._seq)}"
+        self.spawned.append((rid, version))
+        return rid
+
+    def drain(self, replica_id):
+        self.drained.append(replica_id)
+
+
+class TestAutoscalerDaemon:
+    def make(self, srv, pool, spec, autoscaler_id="as-test", **kw):
+        from oim_tpu.autoscale.daemon import Autoscaler
+
+        launcher = FakeLauncher()
+        # interval=30 keeps the fleet row's REAL lease far from the
+        # test's fake clocks; watch=False pins the GetValues path (the
+        # stream path is exercised end to end by the chaos rung).
+        scaler = Autoscaler(
+            srv.addr, spec, launcher, autoscaler_id=autoscaler_id,
+            interval=30.0, pool=pool, watch=False, **kw)
+        return scaler, launcher
+
+    def put(self, srv, pool, path, body, lease=60.0):
+        from oim_tpu.spec import RegistryStub, pb
+
+        RegistryStub(pool.get(srv.addr, None)).SetValue(
+            pb.SetValueRequest(value=pb.Value(
+                path=path, value=json.dumps(body), lease_seconds=lease)),
+            timeout=5.0)
+
+    def serve_row(self, srv, pool, rid, ready=True, version="",
+                  queue_depth=0, free_slots=1):
+        self.put(srv, pool, f"serve/{rid}", {
+            "endpoint": "127.0.0.1:1", "ready": ready, "version": version,
+            "queue_depth": queue_depth, "free_slots": free_slots,
+            "max_batch": 1})
+
+    def fleet_row(self, srv, pool):
+        from oim_tpu.spec import RegistryStub, pb
+
+        reply = RegistryStub(pool.get(srv.addr, None)).GetValues(
+            pb.GetValuesRequest(path="fleet"), timeout=5.0)
+        rows = {v.path: json.loads(v.value) for v in reply.values}
+        return rows.get("fleet/autoscaler")
+
+    def test_tick_repairs_to_min_and_publishes_beating_row(self, registry):
+        srv, pool = registry
+        events.configure(capacity=256)
+        scaler, launcher = self.make(
+            srv, pool, FleetSpec(min_replicas=1, max_replicas=2))
+        try:
+            summary = scaler.tick_once(now=0.0)
+            assert summary["leader"] and summary["target"] == 1
+            assert launcher.spawned == [("fake0", "")]
+            row = self.fleet_row(srv, pool)
+            assert row["autoscaler"] == "as-test"
+            assert row["desired"] == 1 and row["ready"] == 0
+            assert row["min"] == 1 and row["max"] == 2
+            beat0 = row["beat"]
+            # The pending spawn counts as fleet: no duplicate, and the
+            # republish_every=1 row beats MONOTONICALLY every tick (the
+            # standby's whole liveness signal).
+            scaler.tick_once(now=1.0)
+            assert launcher.spawned == [("fake0", "")]
+            assert self.fleet_row(srv, pool)["beat"] > beat0
+            # The spawned replica registers: ready converges and the
+            # gauges agree.
+            self.serve_row(srv, pool, "fake0")
+            summary = scaler.tick_once(now=2.0)
+            assert summary["ready"] == 1
+            assert self.fleet_row(srv, pool)["ready"] == 1
+            assert M.AUTOSCALE_REPLICAS_DESIRED.value == 1
+            assert M.AUTOSCALE_REPLICAS_READY.value == 1
+        finally:
+            scaler.stop(deregister=True)
+        assert self.fleet_row(srv, pool) is None  # clean stop deletes
+
+    def test_alert_scale_up_tracks_alert_to_ready(self, registry):
+        srv, pool = registry
+        events.configure(capacity=256)
+        spec = FleetSpec(min_replicas=1, max_replicas=2, cooldown_s=10.0)
+        scaler, launcher = self.make(srv, pool, spec)
+        observed0 = M.AUTOSCALE_ALERT_TO_READY.count
+        try:
+            self.serve_row(srv, pool, "r0")
+            assert scaler.tick_once(now=0.0)["target"] == 1
+            assert launcher.spawned == []
+            self.put(srv, pool, "alert/first_token_p99",
+                     {"state": "firing", "direction": "up",
+                      "slo": "first_token_p99", "burn_fast": 20.0})
+            summary = scaler.tick_once(now=20.0)
+            assert summary["target"] == 2
+            assert launcher.spawned == [("fake0", "")]
+            up = events.recorder().events(type_=events.AUTOSCALE_SCALE_UP)
+            assert up and up[-1].attrs["reason"] == "alert:first_token_p99"
+            # Mid-boot re-tick: pending synthesis, no double-spawn, no
+            # observation yet (capacity has not landed).
+            scaler.tick_once(now=21.0)
+            assert launcher.spawned == [("fake0", "")]
+            assert M.AUTOSCALE_ALERT_TO_READY.count == observed0
+            # The new replica's heartbeat lands: alert-to-ready observed
+            # once, stamped from the first firing tick.
+            self.serve_row(srv, pool, "fake0")
+            assert scaler.tick_once(now=23.5)["ready"] == 2
+            assert M.AUTOSCALE_ALERT_TO_READY.count == observed0 + 1
+        finally:
+            scaler.stop(deregister=True)
+
+    def test_pending_spawn_times_out_into_repair(self, registry):
+        srv, pool = registry
+        events.configure(capacity=256)
+        scaler, launcher = self.make(
+            srv, pool, FleetSpec(min_replicas=1, max_replicas=1),
+            pending_timeout_s=5.0)
+        try:
+            scaler.tick_once(now=0.0)
+            scaler.tick_once(now=4.0)
+            assert launcher.spawned == [("fake0", "")]  # still pending
+            # The launcher's process never registered: past the timeout
+            # the reconciler stops waiting and repairs.
+            scaler.tick_once(now=10.0)
+            assert launcher.spawned == [("fake0", ""), ("fake1", "")]
+        finally:
+            scaler.stop(deregister=True)
+
+    def test_standby_defers_then_takes_over_adopting_target(self, registry):
+        """Crash handoff: the standby waits out the frozen beat, then
+        leads and ADOPTS the dead leader's published target — a
+        mid-incident failover continues the scale-up, never drains it."""
+        srv, pool = registry
+        events.configure(capacity=256)
+        leader, _ = self.make(
+            srv, pool, FleetSpec(min_replicas=2, max_replicas=3),
+            autoscaler_id="as-a")
+        standby, st_launcher = self.make(
+            srv, pool, FleetSpec(min_replicas=1, max_replicas=3),
+            autoscaler_id="as-b", stale_after_s=2.0)
+        try:
+            assert leader.tick_once(now=0.0)["target"] == 2
+            assert self.fleet_row(srv, pool)["desired"] == 2
+            # as-a now crashes (no more ticks): its row stays, frozen.
+            assert not standby.tick_once(now=100.0)["leader"]
+            assert not standby.tick_once(now=101.9)["leader"]
+            assert st_launcher.spawned == []  # a standby never actuates
+            summary = standby.tick_once(now=102.1)
+            assert summary["leader"]
+            # Adopted desired=2 beats the standby's own min=1, and the
+            # repair spawns follow in the same tick.
+            assert summary["target"] == 2
+            assert [v for _, v in st_launcher.spawned] == ["", ""]
+            takeovers = [e for e in events.recorder().events(
+                type_=events.AUTOSCALE_TAKEOVER)
+                if e.attrs["autoscaler"] == "as-b"]
+            assert len(takeovers) == 1
+            assert takeovers[0].attrs["adopted_target"] == 2
+            # The row now carries the new leader's identity.
+            assert self.fleet_row(srv, pool)["autoscaler"] == "as-b"
+        finally:
+            leader.stop(deregister=False)
+            standby.stop(deregister=True)
+
+    def test_clean_stop_promotes_standby_instantly(self, registry):
+        """deregister=True deletes the fleet row: the next tick of a
+        standby leads with NO stale window to wait out."""
+        srv, pool = registry
+        events.configure(capacity=256)
+        leader, _ = self.make(
+            srv, pool, FleetSpec(min_replicas=1, max_replicas=1),
+            autoscaler_id="as-a")
+        standby, _ = self.make(
+            srv, pool, FleetSpec(min_replicas=1, max_replicas=1),
+            autoscaler_id="as-b", stale_after_s=3600.0)
+        try:
+            leader.tick_once(now=0.0)
+            assert not standby.tick_once(now=0.0)["leader"]
+            leader.stop(deregister=True)
+            assert self.fleet_row(srv, pool) is None
+            assert standby.tick_once(now=0.1)["leader"]
+            assert self.fleet_row(srv, pool)["autoscaler"] == "as-b"
+        finally:
+            leader.stop(deregister=False)
+            standby.stop(deregister=True)
+
+    def test_garbage_fleet_row_does_not_fence(self, registry):
+        from oim_tpu.spec import RegistryStub, pb
+
+        srv, pool = registry
+        events.configure(capacity=256)
+        RegistryStub(pool.get(srv.addr, None)).SetValue(
+            pb.SetValueRequest(value=pb.Value(
+                path="fleet/autoscaler", value="{not json",
+                lease_seconds=60.0)), timeout=5.0)
+        scaler, _ = self.make(
+            srv, pool, FleetSpec(min_replicas=0, max_replicas=1))
+        try:
+            assert scaler.tick_once(now=0.0)["leader"]
+        finally:
+            scaler.stop(deregister=True)
+
+
+class TestOimctlFleet:
+    def test_fleet_banner_renders_the_autoscaler_row(self):
+        from oim_tpu.cli.oimctl import fleet_banner
+
+        line = fleet_banner([("autoscaler", {
+            "autoscaler": "as-a", "desired": 3, "ready": 2, "min": 1,
+            "max": 4, "version": "v2", "alerts": ["first_token_p99"],
+            "beat": 7})])
+        assert line == ("FLEET  leader=as-a  desired=3  ready=2"
+                        "  min=1  max=4  version=v2"
+                        "  alerts=first_token_p99")
+
+    def test_fleet_banner_dash_degrades(self):
+        """No autoscaler row (none deployed, or dead with no standby),
+        a garbage body, and missing fields all render dashes — the
+        banner must never break the --top table."""
+        from oim_tpu.cli.oimctl import fleet_banner
+
+        dashes = ("FLEET  leader=-  desired=-  ready=-"
+                  "  min=-  max=-  version=-  alerts=-")
+        assert fleet_banner([]) == dashes
+        assert fleet_banner([("autoscaler", "not-a-dict")]) == dashes
+        assert fleet_banner([("other", {"desired": 9})]) == dashes
+        assert "desired=0" in fleet_banner(
+            [("autoscaler", {"desired": 0})])  # 0 is a value, not a dash
+
+    def test_print_alerts_shows_direction_and_age(self, capsys):
+        import time
+
+        from oim_tpu.cli import oimctl
+
+        rows = [("first_token_p99",
+                 {"state": "firing", "direction": "up", "burn_fast": 14.2,
+                  "burn_slow": 11.0, "threshold": 10.0,
+                  "since": time.time() - 30}),
+                ("old_monitor", {"state": "firing"})]
+        oimctl.print_alerts(lambda op: rows)
+        out = capsys.readouterr().out.splitlines()
+        assert "dir=up" in out[0] and "burn_fast=14.2" in out[0]
+        assert "for=30s" in out[0]
+        # A pre-direction monitor's row renders tolerantly.
+        assert "dir=?" in out[1]

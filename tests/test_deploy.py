@@ -33,8 +33,9 @@ class TestRenderDeploy:
         ])
         rendered = sorted(p.name for p in tmp_path.iterdir())
         assert rendered == [
-            "controller-daemonset.yaml", "feeder-daemonset.yaml",
-            "monitor.yaml", "registry-quorum.yaml", "registry.yaml",
+            "autoscaler.yaml", "controller-daemonset.yaml",
+            "feeder-daemonset.yaml", "monitor.yaml",
+            "registry-quorum.yaml", "registry.yaml",
         ]
         for p in tmp_path.iterdir():
             text = p.read_text()

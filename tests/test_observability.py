@@ -435,6 +435,30 @@ class TestMetricsDrift:
         assert metrics.SLO_ALERTS_FIRING.name == "oim_slo_alerts_firing"
         assert metrics.SLO_ALERTS_FIRING.labelnames == ()
 
+    def test_autoscale_metrics_declared_and_shaped(self):
+        """The fleet actuator's metric names are API (ISSUE 16):
+        capacity dashboards graph desired-vs-ready as two unlabeled
+        gauges, alert runbooks rate() the actions counter BY action,
+        and the alert-to-ready histogram's buckets are the SLO ladder
+        bench.py --autoscale reports against — none may drift."""
+        assert isinstance(metrics.AUTOSCALE_REPLICAS_DESIRED, Gauge)
+        assert (metrics.AUTOSCALE_REPLICAS_DESIRED.name
+                == "oim_autoscale_replicas_desired")
+        assert metrics.AUTOSCALE_REPLICAS_DESIRED.labelnames == ()
+        assert isinstance(metrics.AUTOSCALE_REPLICAS_READY, Gauge)
+        assert (metrics.AUTOSCALE_REPLICAS_READY.name
+                == "oim_autoscale_replicas_ready")
+        assert metrics.AUTOSCALE_REPLICAS_READY.labelnames == ()
+        assert isinstance(metrics.AUTOSCALE_ACTIONS_TOTAL, Counter)
+        assert (metrics.AUTOSCALE_ACTIONS_TOTAL.name
+                == "oim_autoscale_actions_total")
+        assert metrics.AUTOSCALE_ACTIONS_TOTAL.labelnames == ("action",)
+        assert isinstance(metrics.AUTOSCALE_ALERT_TO_READY, Histogram)
+        assert (metrics.AUTOSCALE_ALERT_TO_READY.name
+                == "oim_autoscale_alert_to_ready_seconds")
+        assert metrics.AUTOSCALE_ALERT_TO_READY.buckets == (
+            0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
 
 class TestTelemetrySnapshotPayload:
     def test_rows_carry_mergeable_histograms(self):
