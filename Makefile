@@ -9,7 +9,7 @@
 PY ?= python
 RUFF := $(shell command -v ruff 2>/dev/null)
 
-.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke slo-smoke autoscale-smoke prefix-smoke paged-smoke spec-smoke chaos chaos-smoke quorum-smoke control-plane-bench
+.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke slo-smoke autoscale-smoke prefix-smoke paged-smoke spec-smoke kvtier-smoke chaos chaos-smoke quorum-smoke control-plane-bench
 
 # drift and tsan are standalone conveniences; the full pytest target
 # already runs both (SpecDrift + the TSAN stream test build in-fixture).
@@ -99,6 +99,19 @@ paged-smoke:
 # tests/test_spec_smoke.py.
 spec-smoke:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --serve --smoke --spec-tokens 4
+
+# KV-tiering + fleet-prefix-sharing acceptance loop (seconds): replica
+# A exports a finished 28-block prefix chain as a content-addressed
+# KV-page volume through an in-process controller; replica B — which
+# never held the prefix — adopts the pages over the data path. Gates:
+# byte identity to solo generate() (greedy and sampled), first-token
+# p50 on a peer-hit STRICTLY better than full recompute, every trial a
+# real peer fetch, and a post-drain zero-leak census across the HBM
+# tier, the host tier (A's store demotes D2H on eviction first), and
+# the exported volume (unpublishes cleanly). Also runs in tier-1 as
+# tests/test_kvtier_smoke.py.
+kvtier-smoke:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --serve --smoke --peer-prefix
 
 # Observability-plane acceptance loop (seconds): in-process registry +
 # 2 serve replicas + router; one trace_id traced from a /metrics

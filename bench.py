@@ -90,6 +90,17 @@ def main(argv=None) -> int:
                              "same p99 columns, and peak pool pages vs "
                              "the dense reservation (with --smoke: the "
                              "asserting paged-KV smoke)")
+    parser.add_argument("--peer-prefix", action="store_true",
+                        help="with --serve: the asserting KV-tiering + "
+                             "fleet prefix-sharing smoke — replica A "
+                             "exports a finished prefix chain as a "
+                             "content-addressed KV-page volume through "
+                             "an in-process controller, replica B (which "
+                             "never saw the prefix) adopts the pages "
+                             "over the data path; gates byte identity, "
+                             "peer-hit vs full-recompute first-token "
+                             "p50, and a zero-leak census across the "
+                             "HBM tier, host tier and exported volumes")
     parser.add_argument("--spec-tokens", type=int, default=0,
                         help="with --serve: speculative decoding — a "
                              "draft model proposes this many tokens per "
@@ -185,6 +196,11 @@ def main(argv=None) -> int:
             "unit": "rungs",
             "extras": extras,
         }))
+        return 0
+
+    if args.serve and args.peer_prefix:
+        print(json.dumps({"metric": "peer_prefix_smoke", "value": 1,
+                          "unit": "ok", "extras": peer_prefix_smoke()}))
         return 0
 
     if args.serve:
@@ -1535,6 +1551,182 @@ def prefix_smoke(prefix_share: float = 0.5) -> dict:
         "router_affinity_byte_identity": True,
     })
     return extras
+
+
+def peer_prefix_smoke() -> dict:
+    """The KV-tiering + fleet-prefix-sharing acceptance run (seconds,
+    in-process): replica A serves one long shared prefix, exports the
+    finished chain as a content-addressed KV-page volume through a
+    real in-process controller, and replica B — whose local store has
+    NEVER held the prefix — adopts the pages over the direct data path
+    instead of re-prefilling. Three gates:
+
+    1. byte identity — every peer-adopted output (greedy and sampled)
+       matches its solo generate() run exactly, and every trial really
+       did peer-fetch (the outcome="hit" counter moves per trial);
+    2. latency — first-token p50 with the prefix hot ONLY on a peer
+       beats full recompute (engine C: same geometry, no prefix reuse)
+       strictly;
+    3. census — post-drain, zero leaked pages/bytes in the HBM tier
+       and the host tier (replica A's store demotes D2H on eviction,
+       then the host tier drains to zero), and the exported volume
+       unpublishes cleanly from the controller.
+
+    The tier-1 guard wired in as tests/test_kvtier_smoke.py and
+    `make kvtier-smoke`."""
+    import statistics
+
+    import jax
+
+    from oim_tpu.common import metrics as M
+    from oim_tpu.controller import MallocBackend
+    from oim_tpu.controller.controller import ControllerService
+    from oim_tpu.feeder import Feeder
+    from oim_tpu.models import generate as gen, llama
+    from oim_tpu.serve import ServeEngine
+    from oim_tpu.serve.kvvolume import (
+        PeerPrefixFetcher,
+        config_fingerprint,
+        export_chain,
+    )
+
+    block, n_blocks, max_new = 16, 28, 4
+    # 4 layers x 448 shared tokens: enough attention flops that a full
+    # recompute prefill visibly outweighs the peer path's fetch +
+    # batched H2D scatter, even on a laptop CPU.
+    cfg = llama.tiny(vocab=64, dim=32, n_layers=4)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.RandomState(7)
+    shared = rng.randint(1, 64, size=block * n_blocks).tolist()
+    warm_prompt = rng.randint(1, 64, size=block * n_blocks + 1).tolist()
+    feeder = Feeder(controller=ControllerService(MallocBackend()))
+
+    def make_engine(**kw):
+        return ServeEngine(params, cfg, max_batch=2, max_seq=512,
+                           queue_depth=8, prefix_block=block, **kw)
+
+    hit_counter = M.SERVE_PREFIX_PEER_FETCHES.labels(outcome="hit")
+    eng_a = make_engine(kv_host_bytes=4 << 20)
+    eng_b = eng_c = None
+    try:
+        # -- replica A: warm the chain, export it as a volume ----------
+        eng_a.submit(shared + [60], max_new=max_new).result(timeout=300)
+        chain = eng_a.hot_chains()[0]
+        if len(chain) != n_blocks:
+            raise AssertionError(
+                f"warmed chain has {len(chain)} blocks, want {n_blocks}")
+        volume_id = export_chain(eng_a, feeder, list(chain))
+        if not volume_id:
+            raise AssertionError(
+                "chain export returned no volume id (chain evicted?)")
+
+        # -- replica B (peer fetch) and C (recompute baseline) ---------
+        eng_b = make_engine(kv_fetch=PeerPrefixFetcher(
+            feeder, config_fingerprint(cfg, block)))
+        eng_c = make_engine(prefix_cache_bytes=0)
+        # Warm every jit program both timed paths touch: the full-length
+        # prefill bucket + decode (warm_prompt shares no prefix), then
+        # one untimed peer adoption (stage_pages + tail-bucket prefill).
+        for eng in (eng_b, eng_c):
+            eng.submit(warm_prompt, max_new=max_new).result(timeout=300)
+        eng_b.submit(shared + [59], max_new=max_new).result(timeout=300)
+
+        def timed(eng, prompt, temp, seed):
+            t0 = time.perf_counter()
+            handle = eng.submit(prompt, max_new=max_new,
+                                temperature=temp, seed=seed)
+            first, toks = None, []
+            for tok in handle.tokens(timeout=300):
+                if first is None:
+                    first = time.perf_counter() - t0
+                toks.append(tok)
+            return first, toks
+
+        trials, peer_ft, recompute_ft = 3, [], []
+        hits_before = hit_counter.value
+        tokens_before = M.SERVE_PREFIX_PEER_TOKENS.value
+        for i in range(trials):
+            prompt = shared + [10 + i]
+            temp = 0.0 if i % 2 else 0.6
+            # Evict B's local store so EVERY trial exercises a true
+            # peer fetch, not a local re-hit of trial i-1's adoption.
+            eng_b.evict_prefix_store()
+            ft_b, toks_b = timed(eng_b, prompt, temp, seed=i)
+            ft_c, toks_c = timed(eng_c, prompt, temp, seed=i)
+            peer_ft.append(ft_b)
+            recompute_ft.append(ft_c)
+            solo = gen.generate(
+                params, np.asarray([prompt], np.int32), max_new, cfg,
+                temperature=temp, rng=jax.random.PRNGKey(i),
+                max_seq=512)[0, len(prompt):].tolist()
+            if toks_b != solo:
+                raise AssertionError(
+                    f"peer-adopted tokens diverge from solo: "
+                    f"{toks_b} != {solo}")
+            if toks_c != solo:
+                raise AssertionError(
+                    f"recompute tokens diverge from solo: "
+                    f"{toks_c} != {solo}")
+        peer_hits = int(hit_counter.value - hits_before)
+        if peer_hits < trials:
+            raise AssertionError(
+                f"only {peer_hits}/{trials} trials peer-fetched")
+        adopted_tokens = int(
+            M.SERVE_PREFIX_PEER_TOKENS.value - tokens_before)
+        peer_p50 = statistics.median(peer_ft)
+        recompute_p50 = statistics.median(recompute_ft)
+        if not peer_p50 < recompute_p50:
+            raise AssertionError(
+                f"peer-hit first-token p50 {peer_p50 * 1e3:.2f}ms not "
+                f"better than recompute {recompute_p50 * 1e3:.2f}ms")
+
+        # -- census: every tier drains to zero -------------------------
+        for eng in (eng_b, eng_c):
+            eng.stop(drain=True, timeout=60)
+            eng.evict_prefix_store()
+            used = eng.pool_stats()["used_pages"]
+            if used:
+                raise AssertionError(
+                    f"{eng.name}: {used} HBM pages leaked after drain")
+        eng_a.stop(drain=True, timeout=60)
+        # A's store-only pages demote D2H on eviction (tiering on), so
+        # the host tier must be non-empty before ITS census drains it.
+        eng_a.evict_prefix_store()
+        demoted = eng_a.host_stats()
+        if not demoted["entries"]:
+            raise AssertionError(
+                "replica A demoted nothing on store eviction")
+        eng_a.evict_host_tier()
+        host_after = eng_a.host_stats()
+        if host_after["entries"] or host_after["bytes"]:
+            raise AssertionError(
+                f"host tier leaked after census: {host_after}")
+        if eng_a.pool_stats()["used_pages"]:
+            raise AssertionError("replica A leaked HBM pages")
+        feeder.unpublish(volume_id)
+        if feeder.controller.get_volume(volume_id) is not None:
+            raise AssertionError(
+                f"exported volume {volume_id} survived unpublish")
+        return {
+            "peer_first_token_p50_ms": peer_p50 * 1e3,
+            "recompute_first_token_p50_ms": recompute_p50 * 1e3,
+            "peer_speedup_x": recompute_p50 / peer_p50,
+            "peer_hits": peer_hits,
+            "peer_adopted_tokens": adopted_tokens,
+            # B's own store was evicted before every trial, so its
+            # per-replica ceiling on this workload is 0; the fleet
+            # tier served the whole shared prefix anyway.
+            "fleet_prefix_hit_rate": adopted_tokens
+            / (trials * n_blocks * block),
+            "per_replica_prefix_hit_rate": 0.0,
+            "exported_volume": volume_id,
+            "host_demotions": demoted["demotions"],
+            "byte_identity": True,
+        }
+    finally:
+        for eng in (eng_a, eng_b, eng_c):
+            if eng is not None:
+                eng.stop(drain=False, timeout=30)
 
 
 @contextlib.contextmanager

@@ -44,6 +44,12 @@ feeder_failover     SIGKILL the pinned controller      feeder failover +
                                                        warm cache hit
 draft_collapse      a draft that stops predicting      valve fallback,
                                                        byte-identity
+kv_peer_fetch       prefix-holder + controller         peer adoption
+                    SIGKILLed mid peer-fetch           first, then
+                                                       fallback to local
+                                                       recompute; byte-
+                                                       identity; both
+                                                       tiers census 0
 autoscale           latency SLO fires under load;      alert -> scale-up;
                     leader autoscaler killed           standby takeover
                     mid-episode                        by lease; resolve
@@ -297,6 +303,84 @@ def _run_draft_collapse(sim: ClusterSim, rng: random.Random) -> dict:
     assert spec["draft_used_pages"] == 0, f"draft pages leaked: {spec}"
     return {"requests": len(reqs),
             "draft_peak_used_pages": spec["draft_peak_used_pages"]}
+
+
+def _run_kv_peer_fetch(sim: ClusterSim, rng: random.Random) -> dict:
+    """The fleet KV tier under fire: r0 exports a hot prefix chain as
+    a content-addressed volume, r1 adopts it over the data path
+    (kv_peer_fetch), then the prefix-holder AND its controller are
+    SIGKILLed mid-fetch — the broken fetch must fall back to plain
+    local recompute (kv_fetch_fallback), byte-identical to solo
+    generate(), with both tiers census-clean at the end."""
+    from oim_tpu.serve.kvvolume import (
+        PeerPrefixFetcher,
+        config_fingerprint,
+        export_chain,
+    )
+
+    sim.warm()
+    r0, r1 = sim.replicas[0], sim.replicas[1]
+    prefix = [rng.randrange(1, 64) for _ in range(32)]  # 2 full blocks
+    r0.engine.submit(prefix + [9], max_new=2, seed=1).result(timeout=300)
+    chains = r0.engine.hot_chains(1)
+    assert chains and len(chains[0]) == 2, \
+        f"holder never recorded the 2-block chain: {chains}"
+    chain = list(chains[0])
+    feeder = sim.feeder("host-0")
+    volume_id = export_chain(r0.engine, feeder, chain)
+    assert volume_id, "export found the chain already evicted"
+
+    # The adopter's fetch path: its OWN feeder (registry mode — the
+    # remote ReadVolume window path, exactly what a real peer pays).
+    fetcher = PeerPrefixFetcher(
+        sim.feeder("host-0"),
+        config_fingerprint(r1.engine.cfg, r1.engine.page_tokens))
+    r1.engine.set_kv_fetch(fetcher)
+    mark = sim.mark_faults()
+
+    # Phase 1 — adoption: r1 never held the prefix, so admission must
+    # fetch the peer's finished pages (greedy + sampled, both pinned
+    # to solo generate()).
+    phase1 = [(prefix + [10], 4, 0.0, 7),
+              (prefix + [12, 13], 4, 0.9, rng.randrange(1 << 16))]
+    for prompt, n_new, temp, seed in phase1:
+        toks = r1.engine.submit(
+            prompt, max_new=n_new, temperature=temp,
+            seed=seed).result(timeout=300)
+        expect = solo_tokens(prompt, n_new, temperature=temp, seed=seed)
+        assert toks == expect, \
+            f"adopted output diverged: {toks} != {expect}"
+    adopted = [e for e in sim.debug_events(events.KV_PEER_FETCH)
+               if e["seq"] > mark]
+    assert adopted and adopted[0]["attrs"]["blocks"] == 2, \
+        f"peer adoption never fired: {adopted}"
+
+    # Phase 2 — the holder dies mid-fetch: evict r1's HBM tier (the
+    # chain demotes D2H into its host tier) and the host tier too, so
+    # the next admission MUST go back to the fleet — where the fetch
+    # wrapper SIGKILLs the controller and the holder before reading.
+    assert r1.engine.evict_prefix_store() > 0, "nothing to demote"
+    host = r1.engine.host_stats()
+    assert host["demotions"] > 0, f"eviction never demoted D2H: {host}"
+    assert r1.engine.evict_host_tier() > 0, "host tier was empty"
+
+    def killing_fetch(chain_arg, m):
+        sim.controllers[0].kill()
+        r0.kill()
+        return fetcher(chain_arg, m)
+
+    r1.engine.set_kv_fetch(killing_fetch)
+    prompt = prefix + [11]
+    toks = r1.engine.submit(
+        prompt, max_new=4, temperature=0.0, seed=3).result(timeout=300)
+    expect = solo_tokens(prompt, 4, temperature=0.0, seed=3)
+    assert toks == expect, \
+        f"fallback output diverged (misaligned resume?): {toks} != {expect}"
+    sim.wait_heal([events.KV_FETCH_FALLBACK], mark)
+    return {"volume": volume_id,
+            "adopted_blocks": adopted[0]["attrs"]["blocks"],
+            "host_demotions": host["demotions"],
+            "requests": len(phase1) + 1}
 
 
 def _run_compound(sim: ClusterSim, rng: random.Random) -> dict:
@@ -731,6 +815,12 @@ RUNGS: tuple[Rung, ...] = (
              _draft=True, spec_tokens=4, spec_accept_floor=0.95,
              spec_window_rounds=4, spec_reprobe_rounds=100_000,
              max_batch=2, max_seq=64, queue_depth=16)])),
+    Rung("kv_peer_fetch",
+         (events.KV_PEER_FETCH, events.KV_FETCH_FALLBACK),
+         _run_kv_peer_fetch,
+         dict(replicas=2, controllers=1,
+              engine_kwargs=[dict(kv_host_bytes=1 << 20),
+                             dict(kv_host_bytes=1 << 20)])),
     Rung("autoscale",
          (events.SLO_ALERT_FIRED, events.AUTOSCALE_SCALE_UP,
           events.AUTOSCALE_TAKEOVER, events.SLO_ALERT_RESOLVED,
@@ -744,13 +834,15 @@ RUNGS: tuple[Rung, ...] = (
          slow=True),
 )
 
-# The trimmed tier-1 set: no replication pair, no controllers, no spec
-# compile — the three rungs that exercise the serving tier's own heal
-# paths in seconds, plus the serve-free fast variants of the quorum
-# rungs (partition and rolling restart over 3 registries only; the
-# full leader-kill-under-load rung runs in `make chaos`).
+# The trimmed tier-1 set: no replication pair, no spec compile — the
+# fast rungs that exercise the serving tier's own heal paths in
+# seconds (including the fleet-KV-tier fetch/fallback rung), plus the
+# serve-free fast variants of the quorum rungs (partition and rolling
+# restart over 3 registries only; the full leader-kill-under-load rung
+# runs in `make chaos`).
 SMOKE_RUNGS = ("replica_kill", "channel_blackhole", "pool_exhaustion",
-               "quorum_partition", "registry_rolling_restart")
+               "kv_peer_fetch", "quorum_partition",
+               "registry_rolling_restart")
 
 
 def run_ladder(seed: int = DEFAULT_SEED, include_slow: bool = True,

@@ -897,8 +897,9 @@ class ClusterSim:
         """Zero-leak census over every LIVE replica: no occupied slots,
         no queued work, every page either free or held by the prefix
         store (one store entry == one page ref), a drained draft pool,
-        and a bounded channel pool. Returns the census; raises on any
-        leak."""
+        a consistent in-budget host tier (entries/bytes agree, bytes
+        within --kv-host-bytes), and a bounded channel pool. Returns
+        the census; raises on any leak."""
         leaks = []
         census: dict = {"replicas": {}}
         for handle in self.replicas:
@@ -908,12 +909,15 @@ class ClusterSim:
             pool = engine.pool_stats()
             prefix = engine.prefix_stats()
             spec = engine.spec_stats()
+            host = engine.host_stats()
             row = {
                 "active_slots": engine.active_slots,
                 "queued": engine.queue_len,
                 "used_pages": pool["used_pages"],
                 "prefix_entries": prefix["entries"],
                 "draft_used_pages": spec["draft_used_pages"],
+                "host_entries": host["entries"],
+                "host_bytes": host["bytes"],
             }
             census["replicas"][handle.rid] = row
             if row["active_slots"] or row["queued"]:
@@ -928,6 +932,17 @@ class ClusterSim:
             if row["draft_used_pages"]:
                 leaks.append(f"{handle.rid}: {row['draft_used_pages']} "
                              f"draft pages leaked")
+            # Host tier: entries and bytes must agree (move semantics
+            # keep a block in ONE tier) and the budget must hold.
+            if bool(row["host_entries"]) != bool(row["host_bytes"]):
+                leaks.append(
+                    f"{handle.rid}: host tier skewed "
+                    f"({row['host_entries']} entries, "
+                    f"{row['host_bytes']} bytes)")
+            if row["host_bytes"] > host["capacity_bytes"]:
+                leaks.append(
+                    f"{handle.rid}: host tier over budget "
+                    f"({row['host_bytes']} > {host['capacity_bytes']})")
         census["pooled_channels"] = len(self.pool)
         # Every pooled channel must belong to a known target (registry
         # nodes, replicas, controllers) — nothing dangling.

@@ -160,7 +160,8 @@ def _make_feeder(args):
             from oim_tpu.controller import MallocBackend
 
             backend = MallocBackend()
-        return Feeder(controller=ControllerService(backend))
+        return Feeder(controller=ControllerService(backend),
+                      window_compress=args.window_compress)
     if not (args.registry and args.controller_id):
         raise SystemExit(
             "--weights-file/--weights-volume need --backend (local) or "
@@ -170,6 +171,7 @@ def _make_feeder(args):
         registry_address=args.registry,
         controller_id=args.controller_id,
         tls=load_tls_flags(args),
+        window_compress=args.window_compress,
     )
 
 
@@ -291,6 +293,31 @@ def main(argv: list[str] | None = None) -> int:
              "(RESOURCE_EXHAUSTED past --queue-depth) instead of "
              "OOMing")
     parser.add_argument(
+        "--kv-host-bytes", type=int, default=0,
+        help="host-RAM budget for demoted KV prefix pages (the second "
+             "tier): prefix-store evictions under pressure copy D2H "
+             "into an LRU here instead of dropping, and a later hit "
+             "re-stages H2D. 0 disables tiering")
+    parser.add_argument(
+        "--kv-peer-fetch", action="store_true",
+        help="resolve prefix misses against peer-exported KV volumes "
+             "(content-addressed kvchain-* volumes on the control "
+             "plane) before recomputing; any failure falls back to "
+             "local recompute. Needs a feeder (--backend or remote "
+             "mode)")
+    parser.add_argument(
+        "--kv-export", action="store_true",
+        help="publish this replica's hot prefix chains as content-"
+             "addressed KV volumes every --heartbeat seconds, so peers "
+             "with --kv-peer-fetch skip the prefill. Needs a feeder")
+    parser.add_argument(
+        "--window-compress", action="store_true",
+        help="ask volume servers to zlib-compress ReadVolume window "
+             "chunks (applied only when smaller; negotiated per stream "
+             "so mixed versions interop). Off by default: weights and "
+             "KV bytes are mostly incompressible, cold text-like "
+             "extents are not")
+    parser.add_argument(
         "--spec-tokens", type=int, default=0,
         help="speculative decoding: tokens the draft model proposes "
              "per verify round (0 disables). Needs exactly one draft "
@@ -378,6 +405,13 @@ def main(argv: list[str] | None = None) -> int:
     if args.serve_id and not args.registry:
         raise SystemExit("--serve-id registers in the routing table and "
                          "needs --registry")
+    if (args.kv_peer_fetch or args.kv_export) and args.checkpoint_dir:
+        # Both sides of fleet prefix sharing move KV bytes over the
+        # control plane; checkpoint-dir mode has no feeder at all.
+        raise SystemExit(
+            "--kv-peer-fetch/--kv-export need a control plane "
+            "(--backend or --registry + --controller-id), not "
+            "--checkpoint-dir")
     if args.platform:
         import jax as _jax
 
@@ -391,6 +425,16 @@ def main(argv: list[str] | None = None) -> int:
     if args.spec_tokens > 0:
         draft_params, draft_mcfg = _load_draft_params(
             args, log, feeder=feeder)
+    kv_fetch = None
+    if args.kv_peer_fetch:
+        from oim_tpu.serve.kvvolume import (
+            PeerPrefixFetcher,
+            config_fingerprint,
+        )
+
+        page_tokens = args.kv_page_tokens or args.prefix_block
+        kv_fetch = PeerPrefixFetcher(
+            feeder, config_fingerprint(mcfg, page_tokens))
     engine = ServeEngine(
         params, mcfg,
         max_batch=args.max_batch,
@@ -401,6 +445,8 @@ def main(argv: list[str] | None = None) -> int:
         prefix_block=args.prefix_block,
         kv_page_tokens=args.kv_page_tokens,
         kv_pool_tokens=args.kv_pool_tokens,
+        kv_host_bytes=args.kv_host_bytes,
+        kv_fetch=kv_fetch,
         draft_params=draft_params,
         draft_cfg=draft_mcfg,
         spec_tokens=args.spec_tokens,
@@ -437,6 +483,29 @@ def main(argv: list[str] | None = None) -> int:
         log.info("registered in routing table", serve_id=args.serve_id,
                  advertise=advertise, heartbeat_s=args.heartbeat)
 
+    export_stop = threading.Event()
+    if args.kv_export:
+        from oim_tpu.serve.kvvolume import export_chain
+
+        def _export_loop():
+            elog = from_context()
+            while not export_stop.wait(args.heartbeat):
+                done = set(engine.exported_volumes())
+                for chain in engine.hot_chains():
+                    if not chain or chain[-1] in done:
+                        continue
+                    try:
+                        # Returns None when the chain partially evicted
+                        # since admission — not an error, just cold.
+                        export_chain(engine, feeder, list(chain))
+                    except Exception as err:  # noqa: BLE001 — keep beating
+                        elog.warning("kv chain export failed",
+                                     error=repr(err))
+
+        threading.Thread(target=_export_loop, name="oim-kv-export",
+                         daemon=True).start()
+        log.info("kv chain exporter started", interval_s=args.heartbeat)
+
     telemetry_default = args.serve_id or (
         f"{args.controller_id}.serve" if args.controller_id else "")
     start_telemetry_row(
@@ -457,6 +526,7 @@ def main(argv: list[str] | None = None) -> int:
         pass
     log.info("draining", active=engine.active_slots,
              queued=engine.queue_len)
+    export_stop.set()
     if registration is not None:
         # ready: false FIRST, so routers rotate away while the residents
         # below finish on their still-open streams.

@@ -371,8 +371,8 @@ def top_row(row_id: str, status: str, role: str, target: str,
     row = {"id": row_id, "status": status, "role": role, "qps": None,
            "ft_ms": (None, None), "it_ms": (None, None), "queue": None,
            "slots": None, "cache_hit": None, "prefix_hit": None,
-           "pages": None, "accept": None, "repl_lag": None,
-           "spread": None, "events": {}}
+           "pages": None, "kvtier": None, "accept": None,
+           "repl_lag": None, "spread": None, "events": {}}
     if status != "ALIVE" or not target:
         return row
     try:
@@ -412,6 +412,16 @@ def top_row(row_id: str, status: str, role: str, target: str,
         pused = _series_value(samples, "oim_serve_kv_pages_used")
         if ptotal is not None and pused is not None and ptotal > 0:
             row["pages"] = (pused, ptotal)
+        # KV tiering census: hbm/host resident prefix pages plus the
+        # lifetime peer-fetch attempt count. Dash for pre-tier replicas
+        # (series absent from the scrape) — the PAGES stance again.
+        hbm = _series_value(samples, "oim_kvtier_hbm_pages")
+        host = _series_value(samples, "oim_kvtier_host_pages")
+        if hbm is not None and host is not None:
+            peer = sum(
+                v for n, lbls, v in samples
+                if n == "oim_serve_prefix_peer_fetches_total")
+            row["kvtier"] = (hbm, host, peer)
         # Speculative-decoding acceptance: the valve's ROLLING window
         # when the scrape carries it (what fallback decisions track),
         # else the lifetime accepted/proposed ratio. Dash for pre-spec
@@ -466,8 +476,8 @@ def fleet_top_row(entries) -> dict:
     row = {"id": "ALL", "status": "-", "role": "fleet", "qps": None,
            "ft_ms": (None, None), "it_ms": (None, None), "queue": None,
            "slots": None, "cache_hit": None, "prefix_hit": None,
-           "pages": None, "accept": None, "repl_lag": None,
-           "spread": None, "events": {}}
+           "pages": None, "kvtier": None, "accept": None,
+           "repl_lag": None, "spread": None, "events": {}}
     snapshots: dict[str, list] = {"first_token": [], "inter_token": []}
     contributors = 0
     for entry in entries:
@@ -509,10 +519,20 @@ def render_top(rows: list[dict]) -> str:
         used, total = pair
         return f"{used:g}/{total:g}"
 
+    def fmt_kvtier(triple):
+        # hbm-pages/host-pages, "+N" peer fetches only once any
+        # happened (most fleets never peer-fetch; the column should
+        # not imply they tried).
+        if triple is None:
+            return "-"
+        hbm, host, peer = triple
+        cell = f"{hbm:g}/{host:g}"
+        return f"{cell}+{peer:g}" if peer else cell
+
     headers = ("ID", "ROLE", "STATUS", "QPS", "FIRST-TOK(ms)",
-               "INTER-TOK(ms)", "QUEUE", "SLOTS", "PAGES", "ACCEPT",
-               "CACHE-HIT", "PREFIX-HIT", "REPL-LAG", "SPREAD",
-               "EVENTS")
+               "INTER-TOK(ms)", "QUEUE", "SLOTS", "PAGES", "KV-TIER",
+               "ACCEPT", "CACHE-HIT", "PREFIX-HIT", "REPL-LAG",
+               "SPREAD", "EVENTS")
     table = [headers]
     for r in rows:
         top_events = sorted(r["events"].items(),
@@ -522,6 +542,7 @@ def render_top(rows: list[dict]) -> str:
             fmt_pair(r["ft_ms"]), fmt_pair(r["it_ms"]),
             fmt(r["queue"], "{:g}"), fmt(r["slots"]),
             fmt_pages(r.get("pages")),
+            fmt_kvtier(r.get("kvtier")),
             fmt(r.get("accept"), "{:.0%}"),
             fmt(r["cache_hit"], "{:.0%}"),
             fmt(r.get("prefix_hit"), "{:.0%}"),

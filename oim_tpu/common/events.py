@@ -70,6 +70,15 @@ STAGE_CACHE_EVICTION = "stage_cache_eviction"
 SLOT_EVICTED = "slot_evicted"
 PAGE_POOL_EXHAUSTED = "page_pool_exhausted"
 SPEC_FALLBACK = "spec_fallback"
+# KV tiering / fleet prefix sharing (serve/kvtier.py, serve/kvvolume.py):
+# a hot chain exported as a content-addressed volume; an admission
+# adopted peer-fetched KV blocks; a peer fetch that STARTED but failed
+# (holder died mid-stream, bad blob) fell back to local recompute —
+# byte-identity is preserved either way, the event exists so the chaos
+# ladder can pin the fallback actually fired.
+KV_CHAIN_EXPORTED = "kv_chain_exported"
+KV_PEER_FETCH = "kv_peer_fetch"
+KV_FETCH_FALLBACK = "kv_fetch_fallback"
 # Fleet SLO plane (oim_tpu/obs/slo.py): a declared SLO's multi-window
 # burn rate crossed the alert threshold / dropped back under it for the
 # resolve-hysteresis hold. One fired per EPISODE however often the burn

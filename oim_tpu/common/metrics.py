@@ -579,6 +579,45 @@ SERVE_KV_PAGES_SHARED = DEFAULT.gauge(
     "oim_serve_kv_pages_shared",
     "KV pages with more than one reference — prompt-prefix pages shared "
     "zero-copy between slots and/or the prefix store")
+# KV tiering (serve/kvtier.py): cold prefix chains demote HBM -> host
+# RAM instead of dropping; a later hit re-stages them H2D. The gauges
+# describe the replica's ONE host tier; transitions are lifetime counts.
+KVTIER_HBM_PAGES = DEFAULT.gauge(
+    "oim_kvtier_hbm_pages",
+    "prefix KV pages resident in the HBM tier (the prefix store's "
+    "entry count; one page per block)")
+KVTIER_HOST_PAGES = DEFAULT.gauge(
+    "oim_kvtier_host_pages",
+    "prefix KV pages resident in the host-RAM tier (demoted from HBM, "
+    "promotable back on a chain hit)")
+KVTIER_HOST_BYTES = DEFAULT.gauge(
+    "oim_kvtier_host_bytes",
+    "K/V bytes resident in the host-RAM tier (bounded by "
+    "--kv-host-bytes)")
+KVTIER_DEMOTIONS = DEFAULT.counter(
+    "oim_kvtier_demotions_total",
+    "prefix pages demoted HBM -> host RAM (D2H on eviction pressure "
+    "instead of dropping the chain)")
+KVTIER_PROMOTIONS = DEFAULT.counter(
+    "oim_kvtier_promotions_total",
+    "prefix pages promoted host RAM -> HBM (H2D re-stage on a chain "
+    "hit)")
+KVTIER_EXPORTS = DEFAULT.counter(
+    "oim_kvtier_exports_total",
+    "prefix chains exported as content-addressed KV-page volumes "
+    "(serve/kvvolume.py pack -> feeder publish)")
+# Fleet prefix sharing: a replica adopting finished KV pages fetched
+# from a peer's exported chain volume instead of re-prefilling.
+SERVE_PREFIX_PEER_FETCHES = DEFAULT.counter(
+    "oim_serve_prefix_peer_fetches_total",
+    "peer prefix-fetch attempts, by outcome: hit = blocks fetched and "
+    "adoptable, miss = no peer volume covers the chain, error = fetch "
+    "started but failed (the engine recomputes locally either way)",
+    labelnames=("outcome",))
+SERVE_PREFIX_PEER_TOKENS = DEFAULT.counter(
+    "oim_serve_prefix_peer_tokens_total",
+    "prompt tokens whose K/V was adopted from a peer-exported chain "
+    "volume instead of local prefill or the local prefix store")
 SERVE_FIRST_TOKEN = DEFAULT.histogram(
     "oim_serve_first_token_seconds",
     "submit-to-first-token latency split by prefix-cache outcome "

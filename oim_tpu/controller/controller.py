@@ -283,9 +283,21 @@ class ControllerService(ControllerServicer):
         first = True
         for off in range(start, end, chunk) if start < end else [start]:
             stop = min(off + chunk, end)
-            msg = pb.ReadVolumeChunk(
-                data=raw_win[off - start:stop - start].tobytes(), offset=off
-            )
+            data = raw_win[off - start:stop - start].tobytes()
+            msg = pb.ReadVolumeChunk(data=data, offset=off)
+            if request.accept_compressed and data:
+                # Negotiated per-stream: only a client that declared it
+                # can decompress ever receives compressed bytes, and
+                # only when compression actually shrinks the chunk
+                # (cold KV/weight extents squeeze well; random-ish
+                # tensors don't — those ship raw). Level 1: the wire is
+                # the bottleneck this exists for, not CPU.
+                import zlib
+
+                packed = zlib.compress(data, 1)
+                if len(packed) < len(data):
+                    msg.data = packed
+                    msg.compressed = True
             if first:
                 msg.spec.CopyFrom(volume.spec)
                 msg.spec.dtype = msg.spec.dtype or str(arr.dtype)

@@ -284,6 +284,7 @@ class Feeder:
         warm_standby: bool = False,
         direct_data: bool = True,
         window_chunk_bytes: int = 0,
+        window_compress: bool = False,
         pool: channelpool.ChannelPool | None = None,
     ):
         local = controller is not None
@@ -324,6 +325,13 @@ class Feeder:
                 f"window_chunk_bytes must be positive (0 = default "
                 f"{self.WINDOW_CHUNK_BYTES}), got {window_chunk_bytes}")
         self.window_chunk_bytes = window_chunk_bytes or self.WINDOW_CHUNK_BYTES
+        # Opt-in wire compression for window reads (--window-compress):
+        # the request declares this client can decompress, the server
+        # compresses only chunks that actually shrink, and either side
+        # predating the field degrades to raw bytes (negotiated
+        # per-stream, mixed versions interop). Off by default — cold
+        # KV/weight extents over a thin wire are the case it pays for.
+        self.window_compress = bool(window_compress)
         self._pool = pool if pool is not None else channelpool.shared()
         # (pinned controller's address, resolved_at monotonic) — one entry:
         # the direct endpoint is a property of the controller, not of any
@@ -940,6 +948,7 @@ class Feeder:
             pb.ReadVolumeRequest(
                 volume_id=volume_id, offset=offset, length=length,
                 chunk_bytes=self.window_chunk_bytes,
+                accept_compressed=self.window_compress,
             ),
             metadata=[(CONTROLLER_ID_META, self.controller_id)],
             timeout=timeout,
@@ -1028,9 +1037,18 @@ class Feeder:
                     buf = bytearray(max(end - offset, 0))
                     view = memoryview(buf)
                 if chunk.data:
+                    data = chunk.data
+                    if getattr(chunk, "compressed", False):
+                        # Only ever set when this request declared
+                        # accept_compressed; offsets stay in
+                        # uncompressed byte space, so the placement
+                        # math below is unchanged.
+                        import zlib
+
+                        data = zlib.decompress(data)
                     rel = int(chunk.offset) - offset
-                    view[rel:rel + len(chunk.data)] = chunk.data
-                    end_rel = max(end_rel, rel + len(chunk.data))
+                    view[rel:rel + len(data)] = data
+                    end_rel = max(end_rel, rel + len(data))
         except BaseException:
             # EVERY consumer exit that leaves the pump running must
             # abandon it (cancel the RPC, release the put loop) — a

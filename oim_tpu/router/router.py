@@ -161,8 +161,8 @@ class RouterService:
         serialize behind — and the caller keeps the cache for the whole
         request, so a pre-first-token retry's re-pick never re-hashes."""
         for r in candidates:
-            if r.prefix_block < 1 or not r.prefix_hashes \
-                    or r.prefix_block in cache:
+            if r.prefix_block < 1 or r.prefix_block in cache \
+                    or not (r.prefix_hashes or r.prefix_hosted):
                 continue
             hashes = prefixhash.usable_hashes(prompt, r.prefix_block)
             if prefix_len > 0:
@@ -171,14 +171,29 @@ class RouterService:
         return cache
 
     @staticmethod
-    def _match_blocks(replica: Replica, hash_cache: dict) -> int:
-        """How many leading blocks of the request's prompt this replica
-        advertises (0 = no affinity)."""
+    def _match_blocks(replica: Replica,
+                      hash_cache: dict) -> tuple[int, int]:
+        """(blocks, hbm_blocks): how many leading blocks of the
+        request's prompt this replica holds in ANY resident tier
+        (HBM store or demoted host RAM — both serve without a
+        prefill), and how many it holds in HBM specifically. The
+        cost model reads the pair: at equal depth an HBM holder
+        beats a host holder (a host hit pays one H2D re-stage per
+        block). Volume-only advertisements do NOT count — an exported
+        chain is fetchable by ANY replica over the data path, so
+        herding toward its publisher buys nothing. (0, 0) = no
+        affinity."""
         hashes = hash_cache.get(replica.prefix_block, ())
+        resident = replica.prefix_hashes | replica.prefix_hosted
         for i in range(len(hashes) - 1, -1, -1):
-            if hashes[i] in replica.prefix_hashes:
-                return i + 1
-        return 0
+            if hashes[i] in resident:
+                hbm = 0
+                for j in range(i, -1, -1):
+                    if hashes[j] in replica.prefix_hashes:
+                        hbm = j + 1
+                        break
+                return i + 1, hbm
+        return 0, 0
 
     def _pick(self, exclude: frozenset | set = frozenset(),
               prompt=None, prefix_len: int = 0,
@@ -213,12 +228,15 @@ class RouterService:
                       for r in candidates]
             best = min(score for score, _ in scored)
             if affine and hash_cache:
-                # Longest advertised prefix wins; ties on match length
-                # go to the lower score, so two holders of one hot
-                # prefix still balance between themselves.
-                neg_blocks, score, i = min(
-                    (-self._match_blocks(r, hash_cache), score, i)
+                # Longest advertised prefix wins; at equal depth the
+                # tier breaks the tie (HBM holder over host holder —
+                # the host hit pays an H2D re-stage per block); then
+                # ties go to the lower score, so two equal holders of
+                # one hot prefix still balance between themselves.
+                neg_blocks, _, score, i = min(
+                    (-blocks, -hbm, score, i)
                     for i, (score, r) in enumerate(scored)
+                    for blocks, hbm in (self._match_blocks(r, hash_cache),)
                 )
                 if neg_blocks < 0 and score <= best + self.affinity_guard:
                     M.ROUTER_AFFINITY_PICKS.inc()

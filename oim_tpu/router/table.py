@@ -64,6 +64,15 @@ class Replica:
     # disabled) — they stay routable, just never attract affinity.
     prefix_block: int = 0
     prefix_hashes: frozenset = frozenset()
+    # KV-tier advertisement (serve/kvtier.py): chain hashes the replica
+    # holds DEMOTED in host RAM (a hit there pays an H2D re-stage, so
+    # the affinity pick prefers an HBM holder at equal depth), and the
+    # deepest hashes of chains it exported as content-addressed volumes
+    # (fetchable by any peer). Both empty for pre-tier replicas — the
+    # advertisement parse is tolerant exactly like the prefix one: a
+    # malformed tier map only disables tier awareness, never routing.
+    prefix_hosted: frozenset = frozenset()
+    prefix_volumes: frozenset = frozenset()
     # Weights-version advertisement (rolling upgrades): "" for replicas
     # that predate the field or run unversioned. The router only uses it
     # as a soft retry preference — a version is never a routability
@@ -95,6 +104,29 @@ class Replica:
                 block, hashes = 0, ()
         except (TypeError, ValueError):
             block, hashes = 0, ()
+        # The tiered advertisement (prefix_tiers: hash -> "hbm"|"host",
+        # prefix_volumes: deepest hash -> volume id). A new-router x
+        # old-replica row simply lacks the keys; a malformed map from
+        # a buggy replica degrades to the flat hash set above.
+        hosted: tuple = ()
+        volumes: tuple = ()
+        tier_map = snap.get("prefix_tiers")
+        if isinstance(tier_map, dict) and block >= 1 and all(
+                isinstance(k, str) and isinstance(v, str)
+                for k, v in tier_map.items()):
+            hosted = tuple(
+                k for k, v in tier_map.items() if v == "host")
+            if not hashes:
+                # A tier map can carry the whole advertisement; keep
+                # the flat set populated so pre-tier affinity logic
+                # (and mixed rows) sees the HBM chains either way.
+                hashes = tuple(
+                    k for k, v in tier_map.items() if v == "hbm")
+        vol_map = snap.get("prefix_volumes")
+        if isinstance(vol_map, dict) and all(
+                isinstance(k, str) and isinstance(v, str)
+                for k, v in vol_map.items()):
+            volumes = tuple(vol_map.keys())
         try:
             return cls(
                 replica_id=parts[1],
@@ -105,6 +137,8 @@ class Replica:
                 ready=bool(snap.get("ready", True)),
                 prefix_block=block,
                 prefix_hashes=frozenset(hashes),
+                prefix_hosted=frozenset(hosted),
+                prefix_volumes=frozenset(volumes),
                 version=(snap["version"]
                          if isinstance(snap.get("version"), str) else ""),
             )

@@ -94,6 +94,12 @@ from oim_tpu.common import (
 )
 from oim_tpu.common.logging import from_context
 from oim_tpu.models.llama import Config
+from oim_tpu.serve.kvtier import (
+    HostTier,
+    page_kv,
+    stage_page,
+    stage_pages,
+)
 from oim_tpu.serve.pagepool import PagePool
 from oim_tpu.serve.prefixcache import PrefixStore
 from oim_tpu.serve.spec import DRAFT_KEY_FOLD, AcceptanceValve, accept_tokens
@@ -354,6 +360,8 @@ class ServeEngine:
         prefix_block: int = 16,
         kv_page_tokens: int = 0,
         kv_pool_tokens: int = 0,
+        kv_host_bytes: int = 0,
+        kv_fetch=None,
         draft_params=None,
         draft_cfg: Config | None = None,
         spec_tokens: int = 0,
@@ -434,10 +442,39 @@ class ServeEngine:
                       * cfg.n_kv_heads * cfg.head_dim
                       * np.dtype(cfg.dtype).itemsize)
         self._pagepool = PagePool(n_pages, self.page_tokens, page_bytes)
+        # KV tiering (serve/kvtier.py): with a --kv-host-bytes budget,
+        # evicting a store-only prefix page D2H-copies its block into
+        # the host-RAM LRU instead of dropping the chain; a later chain
+        # hit H2D-restages it (move semantics — one tier per block).
+        self.kv_host_bytes = max(0, int(kv_host_bytes))
+        self._host_tier = (
+            HostTier(self.kv_host_bytes)
+            if prefix_on and self.kv_host_bytes else None)
         self._prefix = (
             PrefixStore(prefix_cache_bytes, self.prefix_block,
-                        self._pagepool)
+                        self._pagepool,
+                        demote=(self._demote_page
+                                if self._host_tier is not None else None))
             if prefix_on else None)
+        if self._host_tier is not None:
+            self._pagepool.register_tier("host", self._host_tier.stats)
+        # Fleet prefix sharing (serve/kvvolume.py): kv_fetch is the
+        # peer-fetch callback — called with (chain, m) when the local
+        # store + host tier matched only m blocks; whatever consecutive
+        # blocks it returns are H2D-adopted into fresh pages. None /
+        # empty / any failure => plain local recompute (the
+        # byte-identity fallback).
+        self._kv_fetch = kv_fetch if prefix_on else None
+        # Chains this engine exported as content-addressed volumes
+        # (deepest hash -> volume id), advertised in the heartbeat row
+        # so peers and freshly booted replicas can resolve them.
+        self._exported: dict[str, str] = {}
+        # Full cumulative-hash chains of recent admissions (deepest hash
+        # -> ordered chain, MRU last). hot_prefixes() advertises bare
+        # hashes; the volume exporter needs the ORDER that rebuilds a
+        # chain, which only the admitting request ever knew.
+        self._hot_chains: collections.OrderedDict[str, tuple] = \
+            collections.OrderedDict()
         self.params = jax.tree.map(jnp.asarray, params)
         # +1 physical page: id 0 is the reserved scratch/null page every
         # unmapped table entry points at (see init_page_pool).
@@ -539,6 +576,12 @@ class ServeEngine:
         # (admission wrote a row): the next step re-uploads once.
         self._dev: tuple | None = None
         self._pending: collections.deque[_Request] = collections.deque()
+        # Engine-thread command queue: the device pool's buffers are
+        # DONATED to the jitted step programs, so any D2H read of them
+        # (chain snapshots for volume export) must interleave with the
+        # engine's own dispatches — callers enqueue a thunk, the run
+        # loop services it between steps (_call_on_engine).
+        self._cmds: collections.deque = collections.deque()
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._stopping = False
@@ -710,6 +753,53 @@ class ServeEngine:
         return self._prefix.hot(self.ADVERTISE_PREFIXES if n is None
                                 else n)
 
+    def prefix_tiers(self, n: int | None = None) -> dict:
+        """Hash -> tier ("hbm" | "host") for the heartbeat
+        advertisement: the hottest store entries plus the hottest
+        demoted blocks. A hash resident in both tiers cannot happen
+        (move semantics), but hbm wins defensively. Empty when the
+        prefix cache is disabled — the row then carries no tier map
+        and old routers see exactly the pre-tier advertisement."""
+        limit = self.ADVERTISE_PREFIXES if n is None else n
+        out = {h: "hbm" for h in self.hot_prefixes(limit)}
+        if self._host_tier is not None:
+            for h in self._host_tier.hot(limit):
+                out.setdefault(h, "host")
+        return out
+
+    def host_stats(self) -> dict:
+        """Host-tier census (the chaos census' second rung); zeros
+        when tiering is off."""
+        if self._host_tier is None:
+            return {"entries": 0, "bytes": 0, "capacity_bytes": 0,
+                    "demotions": 0, "promotions": 0}
+        return self._host_tier.stats()
+
+    def evict_prefix_store(self) -> int:
+        """Drop every prefix-store reference NOW (bench/census;
+        store-only pages demote into the host tier first when tiering
+        is on). The demote hook D2H-reads the donated pool buffers, so
+        call only from the engine thread or on an idle/stopped engine.
+        Returns pages freed."""
+        if self._prefix is None:
+            return 0
+        return self._prefix.evict_all()
+
+    def evict_host_tier(self) -> int:
+        """Drop every demoted block NOW (drain/census). Returns blocks
+        dropped."""
+        if self._host_tier is None:
+            return 0
+        return self._host_tier.evict_all()
+
+    def set_kv_fetch(self, fn) -> None:
+        """(Re)wire the peer-fetch callback on a running engine — the
+        chaos harness swaps in fault-injecting wrappers; boots pass
+        ``kv_fetch`` to the ctor instead. No-op while the prefix cache
+        is disabled (the callback would never fire)."""
+        if self._prefix is not None:
+            self._kv_fetch = fn
+
     def prefix_stats(self) -> dict:
         """Prefix-store census (tests, debugging); zeros when disabled."""
         if self._prefix is None:
@@ -762,7 +852,7 @@ class ServeEngine:
         try:
             while True:
                 with self._lock:
-                    while (not self._pending
+                    while (not self._pending and not self._cmds
                            and not any(s is not None for s in self._slots)
                            and not (self._stopping or self._draining)):
                         self._work.wait()
@@ -772,10 +862,13 @@ class ServeEngine:
                     done = (self._stopping or self._draining) and not any(
                         s is not None for s in self._slots)
                 if done:
+                    self._fail_cmds()
                     return
                 if stop_now:
                     self._evict_all("drained")
+                    self._fail_cmds()
                     return
+                self._service_cmds()
                 self._admit()
                 if any(s is not None for s in self._slots):
                     self._decode_once()
@@ -788,12 +881,198 @@ class ServeEngine:
             with self._lock:
                 self._stopping = True
                 self._fail_pending_locked("error")
+            self._fail_cmds()
 
     def _fail_pending_locked(self, reason: str) -> None:
         while self._pending:
             req = self._pending.popleft()
             self._finish(req, reason)
         M.SERVE_QUEUE_DEPTH.set(0)
+
+    # -- engine-thread command queue ----------------------------------------
+
+    def _service_cmds(self) -> None:
+        while True:
+            with self._lock:
+                if not self._cmds:
+                    return
+                fn, box = self._cmds.popleft()
+            try:
+                box["result"] = fn()
+            except Exception as err:  # noqa: BLE001 - relayed to caller
+                box["error"] = err
+            box["done"].set()
+
+    def _fail_cmds(self) -> None:
+        while True:
+            with self._lock:
+                if not self._cmds:
+                    return
+                _, box = self._cmds.popleft()
+            box["error"] = Draining("engine stopped before the command ran")
+            box["done"].set()
+
+    def _call_on_engine(self, fn, timeout: float = 30.0):
+        """Run ``fn`` on the engine thread between steps and return its
+        result — the only legal way for another thread to read the
+        device pool (its buffers are donated to the step programs)."""
+        if threading.current_thread() is self._thread:
+            return fn()
+        box: dict = {"done": threading.Event(), "result": None,
+                     "error": None}
+        with self._lock:
+            if self._stopping or self._draining:
+                raise Draining("engine is draining; not taking commands")
+            self._cmds.append((fn, box))
+            self._work.notify()
+        if not box["done"].wait(timeout):
+            raise TimeoutError(
+                f"engine command did not run within {timeout}s")
+        if box["error"] is not None:
+            raise box["error"]
+        return box["result"]
+
+    # -- KV tiering / fleet prefix sharing -----------------------------------
+
+    def snapshot_chain(self, hashes, timeout: float = 30.0):
+        """D2H copies of a cached chain's blocks, in chain order —
+        the export path's read (serve/kvvolume.py packs them). Runs on
+        the engine thread via the command queue; the pages are pinned
+        (ref'd) for the copy so no eviction can free them mid-read.
+        None when the chain is not fully cached anymore."""
+        hashes = list(hashes)
+        if self._prefix is None or not hashes:
+            return None
+
+        def snap():
+            pages = self._prefix.gather(hashes)
+            if pages is None:
+                return None
+            self._pagepool.ref(pages)
+            try:
+                return [page_kv(self._cache, p) for p in pages]
+            finally:
+                self._pagepool.unref(pages)
+
+        return self._call_on_engine(snap, timeout=timeout)
+
+    def note_exported(self, deepest_hash: str, volume_id: str) -> None:
+        """Record a chain this replica exported (heartbeat rows
+        advertise the map so peers can resolve holder volumes)."""
+        with self._lock:
+            self._exported[str(deepest_hash)] = str(volume_id)
+
+    def exported_volumes(self) -> dict:
+        with self._lock:
+            return dict(self._exported)
+
+    def hot_chains(self, n: int = 4) -> list[tuple]:
+        """The full cumulative-hash chains of the most recent
+        admissions, MRU first — what the background exporter walks.
+        A returned chain may have partially evicted since admission;
+        export_chain() re-checks full residency via snapshot_chain."""
+        with self._lock:
+            chains = list(self._hot_chains.values())
+        chains.reverse()
+        return chains[:max(0, int(n))]
+
+    def _demote_page(self, key: str, page: int) -> None:
+        """PrefixStore demote hook: D2H the evicting store-only page
+        into the host tier (engine thread — every store mutation path
+        runs here, which is what makes the device read legal)."""
+        k, v = page_kv(self._cache, page)
+        self._host_tier.put(key, k, v)
+
+    def _alloc_one(self) -> int | None:
+        """One fresh page for a promotion/adoption, shedding cold
+        store references first under pressure (the _map_slot valve)."""
+        pages = self._pagepool.alloc(1)
+        if pages is None and self._prefix is not None:
+            self._prefix.release(1)
+            pages = self._pagepool.alloc(1)
+        return pages[0] if pages else None
+
+    def _install_block(self, key: str, page: int,
+                       shared: list[int]) -> None:
+        """Index one freshly staged page: the store takes its own ref
+        (install), the page's alloc-time ref becomes this admission's
+        pin — the same two-ref shape a gather+ref hit holds."""
+        self._prefix.install(key, page)
+        shared.append(page)
+
+    def _promote_tail(self, chain: list[str], m: int,
+                      shared: list[int]) -> int:
+        """Extend the HBM match with host-tier blocks: H2D re-stage
+        each consecutive demoted block into a fresh page (move
+        semantics — the host entry pops once the bytes are back on
+        device). Stops at the first gap or on pool pressure; returns
+        the new matched depth."""
+        if self._host_tier is None:
+            return m
+        while m < len(chain):
+            got = self._host_tier.get(chain[m])
+            if got is None:
+                break
+            page = self._alloc_one()
+            if page is None:
+                break
+            self._cache = stage_page(self._cache, page, got[0], got[1])
+            self._host_tier.pop(chain[m])
+            self._install_block(chain[m], page, shared)
+            m += 1
+        return m
+
+    def _adopt_peer(self, chain: list[str], m: int, shared: list[int],
+                    req: _Request) -> int:
+        """Fleet tier: ask the kv_fetch callback for the unmatched
+        chain tail and H2D-adopt whatever consecutive blocks it
+        returns. ANY failure — callback error, None, non-consecutive
+        blocks, pool pressure mid-adoption — leaves a valid shorter
+        prefix and the normal prefill computes the rest: fallback is
+        recompute, never a misaligned resume."""
+        try:
+            fetched = self._kv_fetch(chain, m)
+        except Exception as err:  # noqa: BLE001 - fallback is recompute
+            events.emit(events.KV_FETCH_FALLBACK,
+                        trace_id=self._trace_id(req), error=repr(err),
+                        matched_blocks=m, chain_blocks=len(chain))
+            return m
+        if fetched is None:
+            events.emit(events.KV_FETCH_FALLBACK,
+                        trace_id=self._trace_id(req),
+                        matched_blocks=m, chain_blocks=len(chain))
+            return m
+        keys, pages, ks, vs = [], [], [], []
+        for key, (k, v) in fetched:
+            if m + len(keys) >= len(chain) or key != chain[m + len(keys)]:
+                break  # only a consecutive continuation may adopt
+            page = self._alloc_one()
+            if page is None:
+                break
+            keys.append(key)
+            pages.append(page)
+            ks.append(k)
+            vs.append(v)
+        if not keys:
+            return m
+        try:
+            # One batched scatter for the whole adopted run — per-page
+            # dispatch overhead would eat the prefill this path saves.
+            self._cache = stage_pages(self._cache, pages, ks, vs)
+        except Exception as err:  # noqa: BLE001 - e.g. peer shape skew
+            self._pagepool.unref(pages)
+            events.emit(events.KV_FETCH_FALLBACK,
+                        trace_id=self._trace_id(req), error=repr(err),
+                        matched_blocks=m, chain_blocks=len(chain))
+            return m
+        for key, page in zip(keys, pages):
+            self._install_block(key, page, shared)
+        m += len(keys)
+        M.SERVE_PREFIX_PEER_TOKENS.inc(len(keys) * self.prefix_block)
+        events.emit(events.KV_PEER_FETCH,
+                    trace_id=self._trace_id(req), blocks=len(keys),
+                    tokens=len(keys) * self.prefix_block)
+        return m
 
     def _evict_all(self, reason: str) -> None:
         for i, req in enumerate(self._slots):
@@ -931,6 +1210,13 @@ class ServeEngine:
             if self._prefix is not None:
                 chain = prefixhash.usable_hashes(
                     req.prompt, self.prefix_block)
+                if chain:
+                    with self._lock:
+                        self._hot_chains[chain[-1]] = tuple(chain)
+                        self._hot_chains.move_to_end(chain[-1])
+                        while len(self._hot_chains) > \
+                                self.ADVERTISE_PREFIXES * 4:
+                            self._hot_chains.popitem(last=False)
                 m = self._prefix.match(chain)
                 if m:
                     got = self._prefix.gather(chain[:m])
@@ -942,6 +1228,14 @@ class ServeEngine:
                         # no eviction (LRU or pressure valve) can free
                         # them out from under this admission.
                         self._pagepool.ref(shared)
+                # Tier walk for the unmatched tail: host-RAM blocks
+                # re-stage H2D (promotion), then the fleet tier may
+                # extend further with peer-exported blocks; both leave
+                # pinned HBM pages behind, exactly like a store hit.
+                if m < len(chain):
+                    m = self._promote_tail(chain, m, shared)
+                if self._kv_fetch is not None and m < len(chain):
+                    m = self._adopt_peer(chain, m, shared, req)
             if not self._map_slot(req, free, n, m, shared):
                 return  # still the queue head; retried next loop pass
             # The draft half of the slot, best-effort: a request whose

@@ -40,7 +40,7 @@ class PagePool:
     """
 
     def __init__(self, n_pages: int, page_tokens: int, page_bytes: int = 0,
-                 track_metrics: bool = True):
+                 track_metrics: bool = True, tier: str = "hbm"):
         if n_pages < 1:
             raise ValueError(f"need >= 1 usable page, got {n_pages}")
         if page_tokens < 1:
@@ -48,6 +48,13 @@ class PagePool:
         self.n_pages = n_pages
         self.page_tokens = page_tokens
         self.page_bytes = page_bytes
+        # This pool's rung in the KV tier lattice (serve/kvtier.py):
+        # the device pool is "hbm"; sibling tiers (the host-RAM LRU,
+        # exported volumes) register a stats callable so ONE census
+        # call covers every rung — the zero-leak gates sum tiers
+        # without double counting because a block lives in exactly one.
+        self.tier = tier
+        self._tiers: dict[str, object] = {}
         # The oim_serve_kv_pages_* gauges describe the replica's ONE
         # serving pool; a secondary pool (the speculative-decoding
         # draft model's) keeps its census in stats() only.
@@ -125,10 +132,17 @@ class PagePool:
         with self._lock:
             return self.n_pages - len(self._free)
 
+    def register_tier(self, name: str, stats_fn) -> None:
+        """Attach a sibling tier's census: ``stats_fn()`` must return a
+        dict with at least ``entries`` and ``bytes``. Registered tiers
+        ride every ``stats()`` under ``tiers[name]``."""
+        self._tiers[name] = stats_fn
+
     def stats(self) -> dict:
         with self._lock:
             used = self.n_pages - len(self._free)
-            return {
+            out = {
+                "tier": self.tier,
                 "total_pages": self.n_pages,
                 "used_pages": used,
                 "free_pages": len(self._free),
@@ -137,6 +151,10 @@ class PagePool:
                 "page_tokens": self.page_tokens,
                 "page_bytes": self.page_bytes,
             }
+            tiers = dict(self._tiers)
+        if tiers:
+            out["tiers"] = {name: fn() for name, fn in tiers.items()}
+        return out
 
     def _update_locked(self) -> None:
         used = self.n_pages - len(self._free)

@@ -68,7 +68,8 @@ class PrefixStore:
     match is 0, retains are dropped) — the ``--prefix-cache-bytes 0``
     off switch costs nothing on the admission path."""
 
-    def __init__(self, capacity_bytes: int, block: int, pool: PagePool):
+    def __init__(self, capacity_bytes: int, block: int, pool: PagePool,
+                 demote=None):
         if block < 1:
             raise ValueError(f"prefix block must be >= 1, got {block}")
         if pool.page_tokens != block:
@@ -82,6 +83,15 @@ class PrefixStore:
         self.capacity_bytes = capacity_bytes
         self.block = block
         self.pool = pool
+        # Tier demotion hook (serve/kvtier.py): called as
+        # ``demote(key, page)`` when eviction is about to free a
+        # STORE-ONLY page (refcount 1 — pages a live slot still shares
+        # stay resident regardless), so the engine can D2H the block
+        # into the host tier instead of dropping the chain. Runs under
+        # the store lock on the eviction's calling thread, which the
+        # engine's discipline keeps on the engine thread (the device
+        # pool's buffers are donated — no other thread may read them).
+        self._demote = demote
         self._entries: OrderedDict[str, PrefixEntry] = OrderedDict()
         self._bytes = 0
         self._lock = threading.Lock()
@@ -161,18 +171,47 @@ class PrefixStore:
             while self._bytes > self.capacity_bytes and self._entries:
                 self._evict_lru_locked()
             M.SERVE_PREFIX_CACHE_BYTES.set(self._bytes)
+            M.KVTIER_HBM_PAGES.set(len(self._entries))
         return added
+
+    def install(self, key: str, page: int) -> bool:
+        """Index ONE block the engine just staged into ``page`` (a tier
+        promotion's H2D or a peer-fetch adoption): the store takes its
+        own pool reference, exactly like :meth:`retain`, and the entry
+        lands MRU. False (no ref taken) when the store is disabled, the
+        key is already resident, or one block exceeds the budget."""
+        with self._lock:
+            if self.capacity_bytes == 0 or key in self._entries:
+                return False
+            entry = PrefixEntry(key, page, self.pool.page_bytes)
+            if entry.nbytes > self.capacity_bytes:
+                return False
+            self.pool.ref([page])
+            self._entries[key] = entry
+            self._bytes += entry.nbytes
+            while self._bytes > self.capacity_bytes and self._entries:
+                self._evict_lru_locked()
+            M.SERVE_PREFIX_CACHE_BYTES.set(self._bytes)
+            M.KVTIER_HBM_PAGES.set(len(self._entries))
+            return True
 
     # -- eviction ----------------------------------------------------------
 
     def _evict_lru_locked(self) -> int:
         """Drop the LRU entry's store reference. Returns pages actually
         freed (0 when a live slot still shares the page — the page
-        outlives the entry until that slot retires)."""
+        outlives the entry until that slot retires). A store-only page
+        demotes (D2H into the host tier) BEFORE it frees, so eviction
+        moves the block down the tier lattice instead of destroying
+        it."""
         _, entry = self._entries.popitem(last=False)
         self._bytes -= entry.nbytes
+        if self._demote is not None \
+                and self.pool.refcount(entry.page) == 1:
+            self._demote(entry.key, entry.page)
         freed = self.pool.unref([entry.page])
         M.SERVE_PREFIX_CACHE_BYTES.set(self._bytes)
+        M.KVTIER_HBM_PAGES.set(len(self._entries))
         return freed
 
     def release(self, want_pages: int) -> int:
@@ -195,8 +234,14 @@ class PrefixStore:
                     continue  # shared with a live slot: frees nothing
                 del self._entries[key]
                 self._bytes -= entry.nbytes
+                if self._demote is not None:
+                    # Store-only by the refcount check above: capture
+                    # the block into the host tier before its page
+                    # returns to the pool (D2H on pressure, not drop).
+                    self._demote(entry.key, entry.page)
                 freed += self.pool.unref([entry.page])
             M.SERVE_PREFIX_CACHE_BYTES.set(self._bytes)
+            M.KVTIER_HBM_PAGES.set(len(self._entries))
         return freed
 
     def evict_all(self) -> int:

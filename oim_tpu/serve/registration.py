@@ -61,6 +61,22 @@ def load_snapshot(endpoint: str, engine) -> dict:
         if hashes:
             snap["prefix_block"] = engine.prefix_block
             snap["prefix_hashes"] = list(hashes)
+    # KV tiering (serve/kvtier.py): the per-chain tier map and the
+    # exported-volume map ride the same row, getattr-guarded twice
+    # over — a pre-tier engine publishes neither key, and a pre-tier
+    # ROUTER ignores both (Replica.parse reads only fields it knows),
+    # so every mixed-version pairing degrades to the PR 10 behavior.
+    tiers = getattr(engine, "prefix_tiers", None)
+    if callable(tiers):
+        tier_map = tiers()
+        if tier_map:
+            snap.setdefault("prefix_block", engine.prefix_block)
+            snap["prefix_tiers"] = tier_map
+    vols = getattr(engine, "exported_volumes", None)
+    if callable(vols):
+        vol_map = vols()
+        if vol_map:
+            snap["prefix_volumes"] = vol_map
     return snap
 
 

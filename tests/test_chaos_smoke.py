@@ -5,10 +5,12 @@ rungs (replica SIGKILL -> retry-before-first-token, black-holed channel
 OOM) plus the serve-free quorum-registry rungs (symmetric partition ->
 minority step-down + majority election + split-brain census 0; rolling
 restart of all 3 members -> writes resume per hop with ONE Watch stream
-surviving), each converging on its declared /debug/events heal
-signature with zero client-visible errors, byte-identical routed
-outputs, and a zero-leak census (bench.chaos_smoke() itself raises on
-any divergence). The compound rung, the leader-kill-under-load rung and
+surviving) and the KV peer-fetch rung (prefix adopted from a peer's
+exported volume, then the holder SIGKILLed mid-fetch -> recompute
+fallback, byte-identical), each converging on its declared
+/debug/events heal signature with zero client-visible errors,
+byte-identical routed outputs, and a zero-leak census
+(bench.chaos_smoke() itself raises on any divergence). The compound rung, the leader-kill-under-load rung and
 the rest of the ladder run under `make chaos` / `pytest -m slow`
 (tests/test_chaos.py)."""
 
@@ -24,7 +26,7 @@ def test_chaos_smoke_rungs_converge_and_fault_points_are_free():
     extras = bench.chaos_smoke()  # raises AssertionError on divergence
     assert extras["chaos_rung_names"] == [
         "replica_kill", "channel_blackhole", "pool_exhaustion",
-        "quorum_partition", "registry_rolling_restart"]
+        "quorum_partition", "registry_rolling_restart", "kv_peer_fetch"]
     assert extras["chaos_event_signature"] == [
         ["replica_kill", "router_mark_failed", "router_retry"],
         ["channel_blackhole", "router_mark_failed", "router_retry"],
@@ -33,6 +35,7 @@ def test_chaos_smoke_rungs_converge_and_fault_points_are_free():
          "registry_stepdown"],
         ["registry_rolling_restart", "registry_election",
          "registry_promotion"],
+        ["kv_peer_fetch", "kv_peer_fetch", "kv_fetch_fallback"],
     ]
     serve_free = {"quorum_partition", "registry_rolling_restart"}
     for rung in extras["chaos_report"]:

@@ -764,3 +764,107 @@ class TestCrossControllerPrestage:
         with pytest.raises(self._Abort) as err:
             self._call(proxy, self.READ, "host.B")
         assert err.value.code is grpc.StatusCode.NOT_FOUND
+
+
+class TestWindowCompression:
+    """Opt-in wire compression for ReadVolume windows (ISSUE 17,
+    --window-compress): negotiated PER STREAM — the request declares
+    the client can decompress, the server compresses a chunk only when
+    that actually shrinks it — so every mixed-version pairing interops:
+    an old client never receives compressed bytes, an old server's raw
+    chunks (compressed absent = False) read fine on a new client, and
+    offsets/total_bytes stay in uncompressed space throughout."""
+
+    @pytest.fixture
+    def cluster(self):
+        db = MemRegistryDB()
+        registry = registry_server("tcp://localhost:0",
+                                   RegistryService(db=db))
+        controller = controller_server(
+            "tcp://localhost:0", ControllerService(MallocBackend()))
+        db.set("host-0/address", controller.addr)
+        db.set("host-0/mesh", "1,2,3")
+        pool = ChannelPool()
+        yield registry, controller, pool
+        pool.close()
+        registry.force_stop()
+        controller.force_stop()
+
+    def _publish(self, registry, pool, tmp_path, volume_id, data):
+        feeder = Feeder(registry_address=registry.addr,
+                        controller_id="host-0", pool=pool)
+        path = tmp_path / f"{volume_id}.bin"
+        path.write_bytes(data)
+        feeder.publish(pb.MapVolumeRequest(
+            volume_id=volume_id,
+            file=pb.FileParams(path=str(path), format="raw")))
+        return feeder
+
+    def _chunks(self, controller, volume_id, accept: bool,
+                chunk_bytes: int = 16_384):
+        from oim_tpu.spec import ControllerStub
+
+        channel = tlsutil.dial(controller.addr, None)
+        try:
+            return list(ControllerStub(channel).ReadVolume(
+                pb.ReadVolumeRequest(volume_id=volume_id,
+                                     chunk_bytes=chunk_bytes,
+                                     accept_compressed=accept),
+                timeout=30))
+        finally:
+            channel.close()
+
+    def test_negotiated_stream_compresses_cold_extents(
+            self, cluster, tmp_path):
+        import zlib
+
+        registry, controller, pool = cluster
+        data = b"oim-kv-page " * 8_000  # squeezes like a cold KV extent
+        self._publish(registry, pool, tmp_path, "vol-z", data)
+        chunks = self._chunks(controller, "vol-z", accept=True)
+        assert len(chunks) > 1
+        assert all(c.compressed for c in chunks)
+        # Offsets stay in UNCOMPRESSED space: each chunk covers the
+        # window math's 16 KiB stride no matter what shipped.
+        assert [c.offset for c in chunks] == \
+            [i * 16_384 for i in range(len(chunks))]
+        assert chunks[0].total_bytes == len(data)
+        rebuilt = b"".join(zlib.decompress(c.data) for c in chunks)
+        assert rebuilt == data
+        wire = sum(len(c.data) for c in chunks)
+        assert wire < len(data) // 2  # the point of the flag
+
+    def test_old_client_never_receives_compressed_bytes(
+            self, cluster, tmp_path):
+        registry, controller, pool = cluster
+        data = b"oim-kv-page " * 8_000
+        self._publish(registry, pool, tmp_path, "vol-old", data)
+        chunks = self._chunks(controller, "vol-old", accept=False)
+        assert not any(c.compressed for c in chunks)
+        assert b"".join(c.data for c in chunks) == data
+
+    def test_incompressible_chunks_ship_raw_even_when_negotiated(
+            self, cluster, tmp_path):
+        registry, controller, pool = cluster
+        data = np.random.RandomState(11).bytes(80_000)  # won't shrink
+        self._publish(registry, pool, tmp_path, "vol-rand", data)
+        chunks = self._chunks(controller, "vol-rand", accept=True)
+        # compressed=False chunks are exactly what an OLD server sends
+        # (field absent reads False) — the raw path IS the old-server
+        # interop path, and the new client must take it per chunk.
+        assert not any(c.compressed for c in chunks)
+        assert b"".join(c.data for c in chunks) == data
+
+    def test_feeder_window_compress_end_to_end_byte_identical(
+            self, cluster, tmp_path):
+        registry, _, pool = cluster
+        data = b"shared system prompt kv " * 5_000
+        self._publish(registry, pool, tmp_path, "vol-e2e", data)
+        on = Feeder(registry_address=registry.addr, controller_id="host-0",
+                    pool=pool, window_compress=True)
+        off = Feeder(registry_address=registry.addr, controller_id="host-0",
+                     pool=pool)
+        assert _read_all(on, "vol-e2e") == data
+        assert _read_all(off, "vol-e2e") == data
+        w, total, _ = on.fetch_window("vol-e2e", 7_000, 9_000)
+        assert w.tobytes() == data[7_000:16_000] and total == len(data)

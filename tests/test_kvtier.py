@@ -1,0 +1,330 @@
+"""Ring-1 tests for KV tiering + fleet prefix sharing (serve/kvtier.py,
+serve/kvvolume.py).
+
+The invariants: a demote -> promote roundtrip never changes a single
+output token vs solo ``generate()`` (greedy AND sampled — K/V bytes
+survive the D2H/H2D hops bit-exact); the host tier is a plain LRU under
+``--kv-host-bytes`` with move semantics (a block lives in exactly one
+tier); a chain packs to IDENTICAL bytes and the SAME content address on
+every replica (export/import determinism — the fleet dedups on it); and
+the tiered heartbeat advertisement parses in every mixed-version
+pairing — new router x old replica, old router x new replica, and a
+malformed tier map from a buggy replica degrade, never break, routing.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from oim_tpu.models import generate as gen, llama
+from oim_tpu.router.table import Replica
+from oim_tpu.serve import ServeEngine, load_snapshot
+from oim_tpu.serve.kvtier import HostTier
+from oim_tpu.serve.kvvolume import (
+    chain_volume_id,
+    config_fingerprint,
+    pack_chain,
+    unpack_chain,
+)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama.tiny(vocab=64, dim=32, n_layers=2)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def solo_tokens(params, cfg, prompt, n_new, temperature=0.0, seed=0,
+                max_seq=64):
+    out = gen.generate(
+        params, np.asarray([prompt], np.int32), n_new, cfg,
+        temperature=temperature, rng=jax.random.PRNGKey(seed),
+        max_seq=max_seq)
+    return out[0, len(prompt):].tolist()
+
+
+def _engine(model, **kw):
+    params, cfg = model
+    kw.setdefault("max_batch", 2)
+    kw.setdefault("max_seq", 64)
+    kw.setdefault("queue_depth", 16)
+    kw.setdefault("prefix_block", 4)
+    return ServeEngine(params, cfg, **kw)
+
+
+def _block(i: int, nbytes: int = 64):
+    """A distinguishable host block: k and v of ``nbytes`` each."""
+    k = np.full(nbytes, i, np.uint8)
+    return k, k + 1
+
+
+# ---------------------------------------------------------------------------
+# Host tier: the LRU under --kv-host-bytes (direct, engine-free).
+
+
+class TestHostTier:
+    def test_lru_eviction_under_byte_budget(self):
+        tier = HostTier(3 * 128, track_metrics=False)
+        for i in range(3):
+            assert tier.put(f"h{i}", *_block(i))
+        assert len(tier) == 3
+        tier.get("h0")  # MRU-touch: h1 becomes the LRU victim
+        assert tier.put("h3", *_block(3))
+        assert "h1" not in tier and "h0" in tier
+        assert tier.stats()["bytes"] == 3 * 128
+
+    def test_block_over_budget_is_dropped_not_wedged(self):
+        tier = HostTier(100, track_metrics=False)
+        assert tier.put("big", *_block(0, nbytes=64)) is False
+        assert len(tier) == 0 and tier.stats()["bytes"] == 0
+        assert tier.put("fits", *_block(1, nbytes=32)) is True
+
+    def test_capacity_zero_disables(self):
+        tier = HostTier(0, track_metrics=False)
+        assert tier.put("h0", *_block(0)) is False
+        assert tier.get("h0") is None
+        assert tier.stats() == {
+            "entries": 0, "bytes": 0, "capacity_bytes": 0,
+            "demotions": 0, "promotions": 0}
+
+    def test_pop_is_the_promotion_half_of_move_semantics(self):
+        tier = HostTier(1 << 16, track_metrics=False)
+        tier.put("h0", *_block(0))
+        k, v = tier.get("h0")
+        assert k[0] == 0 and v[0] == 1
+        assert tier.pop("h0") is True
+        assert "h0" not in tier and tier.stats()["bytes"] == 0
+        assert tier.stats()["promotions"] == 1
+        assert tier.pop("h0") is False  # idempotent on absence
+
+    def test_reput_same_key_replaces_bytes_once(self):
+        tier = HostTier(1 << 16, track_metrics=False)
+        tier.put("h0", *_block(0, nbytes=64))
+        tier.put("h0", *_block(9, nbytes=256))
+        assert len(tier) == 1
+        assert tier.stats()["bytes"] == 512
+        k, _ = tier.get("h0")
+        assert k[0] == 9
+
+    def test_hot_is_mru_first_and_evict_all_zeroes(self):
+        tier = HostTier(1 << 16, track_metrics=False)
+        for i in range(4):
+            tier.put(f"h{i}", *_block(i))
+        tier.get("h1")
+        assert tier.hot(2) == ["h1", "h3"]
+        assert tier.evict_all() == 4
+        assert len(tier) == 0 and tier.stats()["bytes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Demote -> promote roundtrip through a real engine: byte identity.
+
+
+class TestDemotePromote:
+    def test_roundtrip_byte_identity_greedy_and_sampled(self, model):
+        """Evicting the store demotes the chain D2H; the next request
+        promotes it H2D into fresh pages — and neither hop may change
+        one output token, greedy or sampled."""
+        params, cfg = model
+        eng = _engine(model, kv_host_bytes=1 << 20)
+        shared = np.random.RandomState(3).randint(1, 64, 13).tolist()
+        reqs = [
+            (shared + [7], 5, 0.0, 0),   # seeds 3 blocks in the store
+            (shared + [9], 5, 0.0, 1),   # greedy, served via promotion
+            (shared + [10], 5, 0.8, 2),  # sampled, served via promotion
+        ]
+        try:
+            eng.submit(reqs[0][0],
+                       max_new=reqs[0][1]).result(timeout=120)
+            assert eng.evict_prefix_store() == 3
+            host = eng.host_stats()
+            assert host["entries"] == 3 and host["demotions"] == 3
+            assert eng.pool_stats()["used_pages"] == 0
+            outs = []
+            for p, n, t, s in reqs[1:]:
+                h = eng.submit(p, max_new=n, temperature=t, seed=s)
+                outs.append((h.result(timeout=120), h.stats))
+        finally:
+            eng.stop(timeout=30)
+        for (p, n, t, s), (out, stats) in zip(reqs[1:], outs):
+            assert out == solo_tokens(params, cfg, p, n, t, s), (p, t, s)
+        # The first post-demote request promoted all 3 blocks (12
+        # reused tokens); the second hit them back in HBM.
+        assert [st["prefix_tokens"] for _, st in outs] == [12, 12]
+        host = eng.host_stats()
+        assert host["promotions"] == 3
+        # Move semantics: promoted blocks left the host tier.
+        assert host["entries"] == 0 and host["bytes"] == 0
+
+    def test_demote_disabled_without_budget(self, model):
+        """kv_host_bytes=0 is the off switch: eviction drops chains
+        outright, exactly the pre-tier behavior."""
+        eng = _engine(model)  # no kv_host_bytes
+        try:
+            eng.submit([1, 2, 3, 4, 5], max_new=2).result(timeout=120)
+            eng.evict_prefix_store()
+            assert eng.host_stats() == {
+                "entries": 0, "bytes": 0, "capacity_bytes": 0,
+                "demotions": 0, "promotions": 0}
+        finally:
+            eng.stop(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# Volume export/import: determinism and the refuse-on-defect contract.
+
+
+class TestVolumeDeterminism:
+    def _chain(self, rs=4):
+        rng = np.random.RandomState(rs)
+        hashes = [f"h{i:02d}" for i in range(3)]
+        blocks = [(rng.rand(2, 4, 1, 8).astype(np.float32),
+                   rng.rand(2, 4, 1, 8).astype(np.float32))
+                  for _ in hashes]
+        fp = {"n_layers": 2, "n_kv_heads": 1, "head_dim": 8,
+              "dtype": "float32", "page_tokens": 4}
+        return hashes, blocks, fp
+
+    def test_pack_is_deterministic_and_unpack_roundtrips(self):
+        hashes, blocks, fp = self._chain()
+        blob_a = pack_chain(hashes, blocks, 4, fp)
+        blob_b = pack_chain(hashes, blocks, 4, fp)
+        assert blob_a == blob_b
+        got_hashes, got_blocks, block = unpack_chain(blob_a, fp)
+        assert got_hashes == hashes and block == 4
+        for (k, v), (gk, gv) in zip(blocks, got_blocks):
+            np.testing.assert_array_equal(k, gk)
+            np.testing.assert_array_equal(v, gv)
+
+    def test_volume_id_is_a_pure_function_of_the_chain(self):
+        hashes, _, _ = self._chain()
+        assert chain_volume_id(hashes) == chain_volume_id(list(hashes))
+        assert chain_volume_id(hashes) == f"kvchain-{hashes[-1]}"
+        with pytest.raises(ValueError):
+            chain_volume_id([])
+
+    def test_two_engines_export_identical_bytes_and_id(self, model):
+        """The fleet dedup claim: the SAME prefix on two replicas packs
+        to the SAME bytes under the SAME content address, so the
+        controller stores one copy no matter who exports."""
+        eng_a = _engine(model)
+        eng_b = _engine(model)
+        prompt = np.random.RandomState(5).randint(1, 64, 14).tolist()
+        try:
+            eng_a.submit(prompt, max_new=2).result(timeout=120)
+            eng_b.submit(prompt, max_new=2).result(timeout=120)
+            (chain_a,) = eng_a.hot_chains(1)
+            (chain_b,) = eng_b.hot_chains(1)
+            assert chain_a == chain_b
+            fp = config_fingerprint(eng_a.cfg, eng_a.page_tokens)
+            blob_a = pack_chain(chain_a,
+                                eng_a.snapshot_chain(chain_a), 4, fp)
+            blob_b = pack_chain(chain_b,
+                                eng_b.snapshot_chain(chain_b), 4, fp)
+        finally:
+            eng_a.stop(timeout=30)
+            eng_b.stop(timeout=30)
+        assert blob_a == blob_b
+        assert chain_volume_id(chain_a) == chain_volume_id(chain_b)
+
+    def test_unpack_refuses_every_defect(self):
+        hashes, blocks, fp = self._chain()
+        blob = pack_chain(hashes, blocks, 4, fp)
+        with pytest.raises(ValueError, match="magic"):
+            unpack_chain(b"JUNK" + blob[4:])
+        with pytest.raises(ValueError, match="truncated"):
+            unpack_chain(blob[:-8])
+        other = dict(fp, head_dim=16)
+        with pytest.raises(ValueError, match="fingerprint"):
+            unpack_chain(blob, other)
+        # Without a fingerprint pin, unpack trusts the manifest.
+        got, _, _ = unpack_chain(blob, None)
+        assert got == hashes
+
+    def test_pack_refuses_ragged_or_mismatched_chains(self):
+        hashes, blocks, fp = self._chain()
+        with pytest.raises(ValueError, match="one block per hash"):
+            pack_chain(hashes, blocks[:-1], 4, fp)
+        with pytest.raises(ValueError, match="empty"):
+            pack_chain([], [], 4, fp)
+        ragged = blocks[:-1] + [(blocks[-1][0][:, :2], blocks[-1][1])]
+        with pytest.raises(ValueError, match="ragged"):
+            pack_chain(hashes, ragged, 4, fp)
+
+
+# ---------------------------------------------------------------------------
+# Mixed-version advertisement: both directions of the upgrade.
+
+
+class TestTieredAdvertisement:
+    BASE = {"endpoint": "h:1", "free_slots": 1, "queue_depth": 0,
+            "max_batch": 2, "ready": True}
+
+    def test_new_router_old_replica_has_empty_tier_view(self):
+        """A pre-tier replica's row (no tier keys at all) parses with
+        empty hosted/volume sets — routing exactly as before."""
+        row = dict(self.BASE, prefix_block=4, prefix_hashes=["a", "b"])
+        rep = Replica.parse("serve/r0", json.dumps(row))
+        assert rep.prefix_hashes == {"a", "b"}
+        assert rep.prefix_hosted == frozenset()
+        assert rep.prefix_volumes == frozenset()
+
+    def test_new_router_new_replica_reads_tiers_and_volumes(self):
+        row = dict(self.BASE, prefix_block=4, prefix_hashes=["a", "b"],
+                   prefix_tiers={"a": "hbm", "b": "host"},
+                   prefix_volumes={"b": "kvchain-b"})
+        rep = Replica.parse("serve/r0", json.dumps(row))
+        assert rep.prefix_hosted == {"b"}
+        assert rep.prefix_volumes == {"b"}
+        assert rep.prefix_hashes == {"a", "b"}
+
+    def test_tier_map_alone_carries_the_advertisement(self):
+        """A row whose only prefix payload is the tier map still feeds
+        the flat hash set (pre-tier affinity logic keeps working)."""
+        row = dict(self.BASE, prefix_block=4,
+                   prefix_tiers={"a": "hbm", "b": "host"})
+        rep = Replica.parse("serve/r0", json.dumps(row))
+        assert rep.prefix_hashes == {"a"}
+        assert rep.prefix_hosted == {"b"}
+
+    def test_malformed_tier_maps_degrade_never_break(self):
+        """A buggy replica's garbage tier map only disables tier
+        awareness; the row stays routable with the flat hash set."""
+        for bad_tiers in ({"a": 3}, ["a"], "hbm", {1: "hbm"}):
+            row = dict(self.BASE, prefix_block=4,
+                       prefix_hashes=["a"], prefix_tiers=bad_tiers,
+                       prefix_volumes={"a": 7})
+            rep = Replica.parse("serve/r0", json.dumps(row))
+            assert rep is not None and rep.ready
+            assert rep.prefix_hashes == {"a"}
+            assert rep.prefix_hosted == frozenset()
+            assert rep.prefix_volumes == frozenset()
+
+    def test_old_router_new_replica_row_is_additive(self, model):
+        """The other direction: a tiered engine's snapshot still
+        carries every pre-tier field with pre-tier types, so an old
+        router that reads only the fields it knows routes normally."""
+        eng = _engine(model, kv_host_bytes=1 << 20)
+        try:
+            eng.submit([1, 2, 3, 4, 5, 6, 7, 8, 9],
+                       max_new=2).result(timeout=120)
+            eng.evict_prefix_store()  # demote: the row gains host rows
+            eng.note_exported("deadbeef", "kvchain-deadbeef")
+            snap = load_snapshot("h:1", eng)
+        finally:
+            eng.stop(timeout=30)
+        json.dumps(snap)  # the row must stay a plain JSON object
+        assert snap["endpoint"] == "h:1"
+        assert snap["prefix_block"] == 4
+        assert isinstance(snap["prefix_tiers"], dict)
+        assert set(snap["prefix_tiers"].values()) <= {"hbm", "host"}
+        assert snap["prefix_volumes"] == {"deadbeef": "kvchain-deadbeef"}
+        # An old parser sees exactly the PR 10 shape in the old keys.
+        old_view = {k: v for k, v in snap.items()
+                    if k not in ("prefix_tiers", "prefix_volumes")}
+        rep = Replica.parse("serve/r0", json.dumps(old_view))
+        assert rep is not None and rep.ready

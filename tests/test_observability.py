@@ -459,6 +459,36 @@ class TestMetricsDrift:
         assert metrics.AUTOSCALE_ALERT_TO_READY.buckets == (
             0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
 
+    def test_kvtier_metrics_declared_and_shaped(self):
+        """The KV-tier metric names are API (ISSUE 17): capacity
+        dashboards graph the per-tier gauges unlabeled, runbooks
+        rate() demotion/promotion/export counters unlabeled, and the
+        peer-fetch counter stays labeled BY OUTCOME (hit/miss/error)
+        — `oimctl --top` sums it across outcomes for its KV-TIER
+        column, so a label rename breaks the operator view."""
+        for gauge, name in (
+                (metrics.KVTIER_HBM_PAGES, "oim_kvtier_hbm_pages"),
+                (metrics.KVTIER_HOST_PAGES, "oim_kvtier_host_pages"),
+                (metrics.KVTIER_HOST_BYTES, "oim_kvtier_host_bytes")):
+            assert isinstance(gauge, Gauge)
+            assert gauge.name == name
+            assert gauge.labelnames == ()
+        for counter, name in (
+                (metrics.KVTIER_DEMOTIONS, "oim_kvtier_demotions_total"),
+                (metrics.KVTIER_PROMOTIONS,
+                 "oim_kvtier_promotions_total"),
+                (metrics.KVTIER_EXPORTS, "oim_kvtier_exports_total"),
+                (metrics.SERVE_PREFIX_PEER_TOKENS,
+                 "oim_serve_prefix_peer_tokens_total")):
+            assert isinstance(counter, Counter)
+            assert counter.name == name
+            assert counter.labelnames == ()
+        assert isinstance(metrics.SERVE_PREFIX_PEER_FETCHES, Counter)
+        assert (metrics.SERVE_PREFIX_PEER_FETCHES.name
+                == "oim_serve_prefix_peer_fetches_total")
+        assert (metrics.SERVE_PREFIX_PEER_FETCHES.labelnames
+                == ("outcome",))
+
 
 class TestTelemetrySnapshotPayload:
     def test_rows_carry_mergeable_histograms(self):
