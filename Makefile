@@ -9,7 +9,7 @@
 PY ?= python
 RUFF := $(shell command -v ruff 2>/dev/null)
 
-.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke slo-smoke autoscale-smoke prefix-smoke paged-smoke spec-smoke kvtier-smoke chaos chaos-smoke quorum-smoke control-plane-bench
+.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke slo-smoke autoscale-smoke prefix-smoke paged-smoke spec-smoke kvtier-smoke chaos chaos-smoke quorum-smoke control-plane-bench scalesim-smoke
 
 # drift and tsan are standalone conveniences; the full pytest target
 # already runs both (SpecDrift + the TSAN stream test build in-fixture).
@@ -181,6 +181,15 @@ quorum-smoke:
 # re-publish vs batched Heartbeat.
 control-plane-bench:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --control-plane
+
+# Control-plane scale smoke (seconds): one 3-member quorum registry
+# carrying 50 LiteReplica rows (real registration/heartbeat/telemetry/
+# Watch clients, decode stubbed) with 8 Watch consumers; gates leader-
+# kill convergence, zero shed streams, and every knee-curve column.
+# The full 10/100/1000 curve runs under `make control-plane-bench`.
+# Also runs in tier-1 as tests/test_scalesim_smoke.py.
+scalesim-smoke:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --control-plane --smoke
 
 demo:
 	bash scripts/demo_cluster.sh demo

@@ -178,7 +178,21 @@ def main(argv=None) -> int:
         return 0
 
     if args.control_plane:
+        if args.smoke:
+            # The tier-1 scale-sim smoke (make scalesim-smoke /
+            # tests/test_scalesim_smoke.py): one 50-lite-replica point
+            # with the knee gates — convergence after a leader kill,
+            # zero shed watch streams, every curve column present.
+            extras = control_plane_scale_bench(smoke=True)
+            print(json.dumps({
+                "metric": "scalesim_smoke",
+                "value": extras["leader_kill_convergence_s"],
+                "unit": "s",
+                "extras": extras,
+            }))
+            return 0
         extras = control_plane_bench()
+        extras.update(control_plane_scale_bench())
         print(json.dumps({
             "metric": "getvalues_drop_x",
             "value": extras["getvalues_drop_x"],
@@ -2435,6 +2449,299 @@ def control_plane_bench(publishers: int = 1000, consumers: int = 6,
         "lease_renews_per_s_batch": round(publishers / batch_wall, 1),
         "lease_batch_speedup_x": round(republish_wall / batch_wall, 2),
     }
+
+
+def _hist_delta(before: dict, after: dict) -> dict:
+    """Mergeable-snapshot delta (after - before): what ONE measured
+    window observed, on the shared grid."""
+    return {"le": list(after["le"]),
+            "counts": [a - b for a, b in
+                       zip(after["counts"], before["counts"])],
+            "sum": after["sum"] - before["sum"]}
+
+
+def _q_ms(snap: dict, q: float):
+    """Bucket quantile of a delta snapshot in milliseconds, or None
+    when the window saw no observations (None stays valid JSON; NaN
+    would not)."""
+    from oim_tpu.obs.merge import quantile, total
+
+    if total(snap) <= 0:
+        return None
+    return round(quantile(snap, q) * 1000, 3)
+
+
+def _serialize_once_paired(row_values: list, streams: int = 8) -> dict:
+    """The watch-hub serialize-once before/after, reconstructed as a
+    paired micro-measure over the SAME deltas: per-stream mode builds
+    and serializes one WatchEvent per (delta, stream) — the pre-change
+    hub fanned protos out and each stream's generator serialized its
+    own copy — vs once mode serializing each delta a single time and
+    fanning the bytes. Returns wall seconds for both and the ratio."""
+    from oim_tpu.spec import pb
+
+    def proto(seq: int, value: str) -> "pb.WatchEvent":
+        return pb.WatchEvent(
+            kind=1, value=pb.Value(path=f"serve/lite-{seq:04d}",
+                                   value=value, lease_seconds=5.0),
+            resume_token=f"bench:{seq}")
+
+    sinks: list[list[bytes]] = [[] for _ in range(streams)]
+    t0 = time.monotonic()
+    for seq, value in enumerate(row_values):
+        for sink in sinks:
+            sink.append(proto(seq, value).SerializeToString())
+    per_stream_wall = time.monotonic() - t0
+
+    sinks = [[] for _ in range(streams)]
+    t0 = time.monotonic()
+    for seq, value in enumerate(row_values):
+        wire = proto(seq, value).SerializeToString()
+        for sink in sinks:
+            sink.append(wire)
+    once_wall = time.monotonic() - t0
+    return {
+        "streams": streams,
+        "deltas": len(row_values),
+        "fanout_per_stream_s": round(per_stream_wall, 4),
+        "fanout_serialize_once_s": round(once_wall, 4),
+        "serialize_once_x": round(per_stream_wall / max(once_wall, 1e-9),
+                                  2),
+    }
+
+
+def _merge_paired(snaps: list, refreshes: int = 50) -> dict:
+    """Incremental vs from-scratch fleet-histogram fold, paired over
+    the same refresh sequence: ``refreshes`` single-row updates against
+    a fleet of ``len(snaps)`` rows, folding after each — the oimctl
+    --top --watch refresh shape. Scratch re-sums every row per refresh
+    (the pre-change merged() cost), incremental patches one row out and
+    in. Counts-exact equivalence is asserted on the final fold."""
+    from oim_tpu.obs.merge import FleetHistogram
+
+    def build() -> "FleetHistogram":
+        fleet = FleetHistogram()
+        for i, snap in enumerate(snaps):
+            fleet.update(f"lite-{i:04d}", _copy_snap(snap))
+        return fleet
+
+    def _copy_snap(snap: dict) -> dict:
+        return {"le": list(snap["le"]), "counts": list(snap["counts"]),
+                "sum": snap["sum"]}
+
+    def bump(snap: dict, step: int) -> dict:
+        out = _copy_snap(snap)
+        idx = step % (len(out["counts"]) - 1)
+        out["counts"] = [c + (1 if j >= idx else 0)
+                        for j, c in enumerate(out["counts"])]
+        out["sum"] += 0.01
+        return out
+
+    results = {}
+    for mode in ("scratch", "incremental"):
+        fleet = build()
+        fold = (fleet.merged_scratch if mode == "scratch"
+                else fleet.merged)
+        fold()  # warm: the first incremental fold builds the tree
+        t0 = time.monotonic()
+        for step in range(refreshes):
+            rid = f"lite-{step % len(snaps):04d}"
+            fleet.update(rid, bump(snaps[step % len(snaps)], step))
+            fold()
+        results[mode] = time.monotonic() - t0
+        results[f"{mode}_final"] = fold()
+    a, b = results["scratch_final"], results["incremental_final"]
+    assert a["counts"] == b["counts"], \
+        "incremental fold diverged from the scratch oracle"
+    return {
+        "fleet_rows": len(snaps),
+        "merge_refreshes": refreshes,
+        "merge_scratch_ms_per_refresh":
+            round(results["scratch"] * 1000 / refreshes, 3),
+        "merge_incremental_ms_per_refresh":
+            round(results["incremental"] * 1000 / refreshes, 3),
+        "merge_incremental_x":
+            round(results["scratch"]
+                  / max(results["incremental"], 1e-9), 2),
+    }
+
+
+def control_plane_scale_bench(counts=(10, 100, 1000), smoke: bool = False,
+                              consumers: int = 8,
+                              burst_rounds: int = 3) -> dict:
+    """The control-plane knee curve: one quorum-3 registry under 10 /
+    100 / 1000 LiteReplicas (real registration + heartbeat + telemetry
+    + Watch traffic, decode stubbed — chaos/sim.py), each point
+    measured in a FRESH ClusterSim:
+
+    * watch fan-out p50/p99 (oim_watch_fanout_seconds over a
+      deterministic full-fleet ``beat_all`` burst, ``consumers`` Watch
+      streams attached) + queue high-water + shed count;
+    * registry commit p50/p99 under the same heartbeat fan-in
+      (oim_registry_commit_seconds{phase=total});
+    * fleet fold cost per --top refresh, incremental vs scratch, on the
+      point's REAL telemetry rows;
+    * router pick p50/p99 against a live ReplicaTable at N rows;
+    * leader-kill convergence: kill the quorum leader mid-load, wall
+      until a registry write commits again.
+
+    Paired before/afters ride the largest point: serialize-once watch
+    fan-out and the incremental fold must each hold >= 2x there (the
+    tentpole's acceptance bar; enforced in full mode — smoke's 50-row
+    point instead gates convergence, zero sheds, and column presence —
+    tests/test_scalesim_smoke.py runs that in tier-1)."""
+    import json as _json
+
+    from oim_tpu.chaos.sim import ClusterSim, wait_for
+    from oim_tpu.common import metrics as M
+    from oim_tpu.router.router import RouterService
+    from oim_tpu.router.table import ReplicaTable
+
+    if smoke:
+        counts = (50,)
+    points = []
+    for n in counts:
+        sim = ClusterSim(replicas=0, registry_quorum=3, lite_replicas=n,
+                         # Long natural cadence: the measured fan-in is
+                         # the bench's own beat_all bursts, not the
+                         # background drivers racing them.
+                         lite_interval_s=120.0, lite_volume_keys=2,
+                         # One box hosts 3 registries + N publishers +
+                         # the consumers; a synchronized 1000-row sweep
+                         # stalls the scheduler past the default 0.4s
+                         # grace and the leader thrash drowns the
+                         # signal. Real deployments tune timeouts to
+                         # load; so does the bench.
+                         election_timeout_s=2.0)
+        with sim:
+            watchers = [sim.registry_watcher("serve")
+                        for _ in range(consumers)]
+            for w in watchers:
+                wait_for(lambda: len(w.rows) >= n, timeout=60)
+
+            fanout0 = M.WATCH_FANOUT_SECONDS.merged_snapshot()
+            commit0 = M.REGISTRY_COMMIT_SECONDS.merged_snapshot(
+                {"phase": "total"})
+            sheds0 = M.WATCH_SHED_STREAMS.value
+            t0 = time.monotonic()
+            for _ in range(burst_rounds):
+                sim.lite.beat_all()
+            burst_wall = time.monotonic() - t0
+            # beat_all returns only after every SetValue committed and
+            # its apply fanned out (the hub serializes + enqueues
+            # inside apply_kv), so the fan-out/commit deltas below are
+            # complete the moment the burst's wall clock stops.
+            fanout = _hist_delta(fanout0,
+                                 M.WATCH_FANOUT_SECONDS.merged_snapshot())
+            commit = _hist_delta(
+                commit0,
+                M.REGISTRY_COMMIT_SECONDS.merged_snapshot(
+                    {"phase": "total"}))
+            sheds = M.WATCH_SHED_STREAMS.value - sheds0
+            queue_peak = M.WATCH_QUEUE_DEPTH.value
+
+            # The point's real telemetry rows feed the fold pair.
+            tele = sim.registry_watcher("telemetry")
+            wait_for(lambda: len(tele.rows) >= n, timeout=60)
+            snaps = []
+            for value in list(tele.rows.values())[:n]:
+                row = _json.loads(value)
+                hist = row.get("hist", {})
+                if "first_token" in hist:
+                    snaps.append(hist["first_token"])
+            merge = _merge_paired(snaps, refreshes=20 if smoke else 50)
+
+            # Router pick against a live table at N rows.
+            table = ReplicaTable(sim.registry_address, interval=5.0)
+            table.start()
+            try:
+                wait_for(lambda: len(table.replicas()) >= n, timeout=60)
+                router = RouterService(table, pool=sim.pool)
+                picks = sorted(
+                    _timed_pick(router)
+                    for _ in range(100 if smoke else 400))
+                pick_p50 = picks[len(picks) // 2]
+                pick_p99 = picks[int(len(picks) * 0.99) - 1]
+            finally:
+                table.stop()
+
+            # Leader kill under load: wall until a write commits again.
+            # A quiet-window step-down can leave the quorum momentarily
+            # leaderless — wait for a seated leader so the kill always
+            # measures a real failover, not an election already under
+            # way.
+            wait_for(lambda: sim.registry_leader() is not None,
+                     timeout=30)
+            sim.kill_registry_leader()
+            t0 = time.monotonic()
+            wait_for(lambda: sim.registry_write(
+                f"bench/conv-{n}", "x", lease_seconds=30.0),
+                timeout=30, interval=0.1)
+            convergence_s = time.monotonic() - t0
+
+            for w in watchers + [tele]:
+                w.stop()
+            beat_errors = sim.lite.beat_errors
+        point = {
+            "lite_replicas": n,
+            "burst_rows": n * burst_rounds,
+            "burst_wall_s": round(burst_wall, 3),
+            "fanin_rows_per_s": round(n * burst_rounds / burst_wall, 1),
+            "watch_streams": consumers,
+            "watch_fanout_p50_ms": _q_ms(fanout, 0.50),
+            "watch_fanout_p99_ms": _q_ms(fanout, 0.99),
+            "watch_queue_peak": queue_peak,
+            "watch_shed_streams": sheds,
+            "commit_p50_ms": _q_ms(commit, 0.50),
+            "commit_p99_ms": _q_ms(commit, 0.99),
+            "pick_p50_us": round(pick_p50 * 1e6, 1),
+            "pick_p99_us": round(pick_p99 * 1e6, 1),
+            "leader_kill_convergence_s": round(convergence_s, 3),
+            "lite_beat_errors": beat_errors,
+        }
+        point.update(merge)
+        points.append(point)
+
+    largest = points[-1]
+    paired = _serialize_once_paired(
+        [_json.dumps({"beat": i, "free_slots": 1, "queue_depth": 0})
+         for i in range(largest["lite_replicas"])],
+        streams=consumers)
+    out = {
+        "scale_points": points,
+        "scale_counts": list(counts),
+        **{f"knee_{k}": v for k, v in paired.items()},
+    }
+    out["serialize_once_x"] = paired["serialize_once_x"]
+    out["merge_incremental_x"] = largest["merge_incremental_x"]
+    out["leader_kill_convergence_s"] = \
+        largest["leader_kill_convergence_s"]
+    out["watch_shed_streams"] = sum(
+        p["watch_shed_streams"] for p in points)
+    required = ("watch_fanout_p99_ms", "commit_p99_ms", "pick_p99_us",
+                "merge_incremental_x", "leader_kill_convergence_s")
+    for p in points:
+        missing = [c for c in required if c not in p]
+        assert not missing, f"curve point lost columns: {missing}"
+    if smoke:
+        # The tier-1 smoke gates (tests/test_scalesim_smoke.py).
+        assert out["leader_kill_convergence_s"] < 15.0, \
+            "quorum did not converge after leader kill"
+        assert out["watch_shed_streams"] == 0, \
+            "a watch consumer was shed at smoke scale"
+    else:
+        # The tentpole acceptance bar at the largest point.
+        assert out["serialize_once_x"] >= 2.0, \
+            f"serialize-once fan-out only {out['serialize_once_x']}x"
+        assert out["merge_incremental_x"] >= 2.0, \
+            f"incremental fold only {out['merge_incremental_x']}x"
+    return out
+
+
+def _timed_pick(router) -> float:
+    t0 = time.monotonic()
+    router.pick()
+    return time.monotonic() - t0
 
 
 def obs_overhead(params, cfg, rounds: int = 8, n_requests: int = 48,

@@ -592,6 +592,28 @@ def _run_registry_rolling_restart(sim: ClusterSim,
             "puts_seen": watcher.puts_seen, "signature": healed}
 
 
+def _run_rolling_restart_lite(sim: ClusterSim, rng: random.Random) -> dict:
+    """The rolling-restart schedule re-run under a 100-replica lite
+    fleet's live heartbeat fan-in: every quorum member restarts while
+    ~50 serve-row renewals per second keep committing, and the fleet
+    must ride the roll out — every ``serve/`` row still live in a Watch
+    view afterwards (leases renewed across each hop, no replica
+    silently expired), on top of the base rung's zero-missed-deltas
+    marker assertions."""
+    fleet_view = sim.registry_watcher("serve")
+    assert wait_for(lambda: len(fleet_view.rows) == sim.n_lite,
+                    timeout=30), \
+        f"lite fleet never fully registered: {len(fleet_view.rows)}"
+    report = _run_registry_rolling_restart(sim, rng)
+    assert wait_for(lambda: len(fleet_view.rows) == sim.n_lite,
+                    timeout=30), \
+        f"serve rows lost across the roll: {len(fleet_view.rows)} " \
+        f"of {sim.n_lite}"
+    report["lite_replicas"] = sim.n_lite
+    report["lite_beat_errors"] = sim.lite.beat_errors
+    return report
+
+
 def _run_autoscale(sim: ClusterSim, rng: random.Random) -> dict:
     """The thesis rung, the full closed loop: routed load saturates a
     one-slot fleet, the monitor's burn-rate alert fires, the LEADER
@@ -805,6 +827,11 @@ RUNGS: tuple[Rung, ...] = (
          (events.REGISTRY_ELECTION, events.REGISTRY_PROMOTION),
          _run_registry_rolling_restart,
          dict(replicas=0, registry_quorum=3)),
+    Rung("registry_rolling_restart_lite",
+         (events.REGISTRY_ELECTION, events.REGISTRY_PROMOTION),
+         _run_rolling_restart_lite,
+         dict(replicas=0, registry_quorum=3, lite_replicas=100,
+              lite_interval_s=2.0, lite_volume_keys=2)),
     Rung("feeder_failover",
          (events.FEEDER_FAILOVER, events.VOLUME_HEALED),
          _run_feeder_failover, dict(replicas=0, controllers=2)),

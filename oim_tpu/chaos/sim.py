@@ -48,6 +48,8 @@ from oim_tpu.common import events, tlsutil
 from oim_tpu.common.channelpool import ChannelPool
 from oim_tpu.common.meshcoord import MeshCoord
 from oim_tpu.common.metrics import MetricsServer
+from oim_tpu.common.pathutil import REGISTRY_SERVE
+from oim_tpu.common.telemetry import RegistryRowPublisher, TelemetryRegistration
 from oim_tpu.spec import ServeStub, pb
 
 # One mesh coordinate for every sim controller: the feeder's failover
@@ -407,6 +409,244 @@ class _SimWatcher:
         self._thread.join(timeout=5.0)
 
 
+# Synthetic latency grid for lite-replica telemetry rows: the serve
+# token-latency shape at coarse resolution — ten ints per row keeps a
+# thousand heartbeats' JSON small while still exercising the full
+# merge/quantile path in oimctl --top and the SLO plane.
+_LITE_LE = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+
+
+class LiteReplica:
+    """A control-plane-complete serve replica with decode stubbed out.
+
+    Everything the control plane SEES from a real replica is real: a
+    TTL-leased ``serve/<id>`` load row (the router-table feed) whose
+    value changes every beat — so each heartbeat is a genuine SetValue
+    journal write, quorum commit, and Watch fan-out, exactly the fan-in
+    the 1k-replica bench loads the registry with; a ``telemetry/<id>``
+    row carrying mergeable latency histograms that grow in bursts, so
+    it exercises BOTH renewal paths (full republish on change, batched
+    Heartbeat between); and a content-addressed KV-volume advertisement
+    (``prefix_tiers``/``prefix_volumes``) riding the serve row, so a
+    thousand-replica fleet carries thousands of volume keys through the
+    table. What's missing is everything expensive: no engine, no jax,
+    no listener, no HBM — one box hosts hundreds of these.
+
+    Beats are DRIVEN (``beat()``), never threaded per replica: at 1000
+    rows a thread each would be 1000 idle stacks. ``LiteFleet`` shards
+    a fleet over a handful of driver threads instead.
+    """
+
+    def __init__(self, rid: str, registry_address: str, *, pool=None,
+                 interval: float = 2.0, metrics_endpoint: str = "",
+                 volume_keys: int = 0, max_batch: int = 8, seed: int = 0):
+        import random
+
+        self.rid = rid
+        self.max_batch = max_batch
+        self._rng = random.Random(f"{seed}:{rid}")
+        self._beats = 0
+        self._free_slots = max_batch
+        self._queue_depth = 0
+        self._hist = {
+            "first_token": {"le": list(_LITE_LE),
+                            "counts": [0] * (len(_LITE_LE) + 1), "sum": 0.0},
+            "inter_token": {"le": list(_LITE_LE),
+                            "counts": [0] * (len(_LITE_LE) + 1), "sum": 0.0},
+        }
+        # Stable per-replica volume advertisement: hash -> volume id,
+        # the shape serve/kvtier.py exports and router/table.py parses.
+        self._volumes = {
+            f"{rid}-chain-{j:02d}": f"kv-{rid}-{j:02d}"
+            for j in range(volume_keys)
+        }
+        outer = self
+
+        class _LoadRow(RegistryRowPublisher):
+            THREAD_NAME = "oim-lite-serve"
+
+            def snapshot(self) -> dict:
+                return outer._load_snapshot()
+
+        # republish_every=1 mirrors ServeRegistration: a load row's
+        # value changes every beat, so renewal IS re-publication.
+        self.row = _LoadRow(
+            f"{REGISTRY_SERVE}/{rid}", registry_address,
+            interval=interval, pool=pool, republish_every=1)
+        self.telemetry = TelemetryRegistration(
+            rid, "serve", metrics_endpoint or f"lite://{rid}",
+            registry_address, interval=interval, pool=pool,
+            collect=self._collect)
+
+    def _load_snapshot(self) -> dict:
+        snap = {
+            # Unroutable by design: the scale bench times table parses
+            # and router picks, it never dials a lite replica.
+            "endpoint": f"lite://{self.rid}",
+            "free_slots": self._free_slots,
+            "queue_depth": self._queue_depth,
+            "max_batch": self.max_batch,
+            "ready": True,
+        }
+        if self._volumes:
+            snap["prefix_block"] = 16
+            snap["prefix_tiers"] = {h: "hbm" for h in self._volumes}
+            snap["prefix_volumes"] = dict(self._volumes)
+        return snap
+
+    def _observe(self, key: str, value: float) -> None:
+        import bisect
+
+        snap = self._hist[key]
+        idx = bisect.bisect_left(_LITE_LE, value)
+        counts = snap["counts"]
+        for j in range(idx, len(counts)):
+            counts[j] += 1
+        snap["sum"] += value
+
+    def _collect(self) -> dict:
+        # Fresh nested containers every call: RegistryRowPublisher
+        # detects change by comparing the last published body — handing
+        # it our mutable dicts would alias last-published and current
+        # and silently pin the row on the batched-renewal path forever.
+        return {"hist": {
+            key: {"le": list(s["le"]), "counts": list(s["counts"]),
+                  "sum": s["sum"]}
+            for key, s in self._hist.items()
+        }}
+
+    def register(self) -> None:
+        """First publication of both rows (the boot beat)."""
+        self.row.beat_once()
+        self.telemetry.beat_once()
+
+    def beat(self) -> None:
+        """One heartbeat: the decode stub moves the load counters every
+        beat (each serve-row renewal is a real journal write) and grows
+        the latency histograms only in bursts (the telemetry row
+        batch-renews between — both renewal paths stay exercised)."""
+        self._beats += 1
+        rng = self._rng
+        self._queue_depth = rng.randint(0, 3)
+        self._free_slots = rng.randint(0, self.max_batch)
+        if self._beats % 3 == 1:
+            self._observe("first_token", rng.uniform(0.01, 0.4))
+            for _ in range(rng.randint(1, 4)):
+                self._observe("inter_token", rng.uniform(0.002, 0.06))
+        self.row.beat_once()
+        self.telemetry.beat_once()
+
+    def stop(self, deregister: bool = True) -> None:
+        self.row.stop(deregister=deregister)
+        self.telemetry.stop(deregister=deregister)
+
+
+class LiteFleet:
+    """N lite replicas beaten by a handful of driver threads.
+
+    Each driver owns a shard and paces one replica's beat every
+    ``interval / shard_size`` seconds — a smooth, phase-spread heartbeat
+    fan-in rather than N-at-once thundering herds, which is what a real
+    fleet's jittered registration converges to. Registration and
+    deregistration also run shard-parallel (a thousand serial SetValues
+    would dominate bench setup). Beats that land mid-registry-restart
+    count in ``beat_errors`` and retry on the next cycle; the row lease
+    (2.5x interval) rides out a rolling restart's per-node downtime.
+    """
+
+    def __init__(self, registry_address: str, count: int, *, pool=None,
+                 interval: float = 2.0, drivers: int = 8,
+                 volume_keys: int = 0, metrics_endpoint: str = "",
+                 seed: int = 0):
+        self.interval = interval
+        self.replicas = [
+            LiteReplica(
+                f"lite-{i:04d}", registry_address, pool=pool,
+                interval=interval, volume_keys=volume_keys,
+                metrics_endpoint=metrics_endpoint, seed=seed)
+            for i in range(count)
+        ]
+        drivers = max(1, min(drivers, count or 1))
+        self._shards = [self.replicas[i::drivers] for i in range(drivers)]
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._err_lock = threading.Lock()
+        self.beat_errors = 0
+
+    def __len__(self) -> int:
+        return len(self.replicas)
+
+    def _each_shard(self, fn) -> None:
+        threads = [
+            threading.Thread(target=fn, args=(shard,), daemon=True)
+            for shard in self._shards
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120.0)
+
+    def start(self) -> "LiteFleet":
+        def boot(shard):
+            for rep in shard:
+                if self._stop.is_set():
+                    return
+                rep.register()
+
+        self._each_shard(boot)
+        for i, shard in enumerate(self._shards):
+            t = threading.Thread(
+                target=self._drive, args=(shard,),
+                name=f"oim-lite-fleet-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def _drive(self, shard) -> None:
+        import grpc
+
+        pace = self.interval / max(1, len(shard))
+        i = 0
+        while not self._stop.is_set():
+            try:
+                shard[i % len(shard)].beat()
+            except grpc.RpcError:
+                # Registry mid-restart / mid-election: the next cycle's
+                # beat retries, the lease absorbs the gap.
+                with self._err_lock:
+                    self.beat_errors += 1
+            i += 1
+            if self._stop.wait(pace):
+                return
+
+    def beat_all(self) -> None:
+        """One synchronous beat of every replica (shard-parallel): the
+        bench's deterministic fan-in burst, independent of pacing."""
+        import grpc
+
+        def sweep(shard):
+            for rep in shard:
+                try:
+                    rep.beat()
+                except grpc.RpcError:
+                    with self._err_lock:
+                        self.beat_errors += 1
+
+        self._each_shard(sweep)
+
+    def stop(self, deregister: bool = True) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=10.0)
+        self._threads.clear()
+
+        def drop(shard):
+            for rep in shard:
+                rep.stop(deregister=deregister)
+
+        self._each_shard(drop)
+
+
 class ClusterSim:
     """The parameterizable in-process cluster (see module docstring).
 
@@ -432,6 +672,10 @@ class ClusterSim:
         max_seq: int = 64,
         queue_depth: int = 64,
         engine_kwargs: list[dict] | None = None,
+        lite_replicas: int = 0,
+        lite_interval_s: float = 2.0,
+        lite_volume_keys: int = 0,
+        lite_drivers: int = 8,
     ):
         self.n_replicas = replicas
         self.registry_pair = registry_pair
@@ -447,6 +691,13 @@ class ClusterSim:
         self.engine_defaults = dict(
             max_batch=max_batch, max_seq=max_seq, queue_depth=queue_depth)
         self.engine_kwargs = engine_kwargs or []
+        # Decode-stubbed replicas (LiteReplica): real serve/telemetry
+        # rows, no engines — the 1k-scale control-plane substrate.
+        self.n_lite = lite_replicas
+        self.lite_interval_s = lite_interval_s
+        self.lite_volume_keys = lite_volume_keys
+        self.lite_drivers = lite_drivers
+        self.lite: LiteFleet | None = None
         self.pool = ChannelPool()
         self.registry_address = ""
         self.registries: list = []   # [(service, server, manager)]
@@ -567,6 +818,14 @@ class ClusterSim:
             if not wait_for(registered, timeout=15):
                 raise AssertionError("controllers never registered")
 
+        if self.n_lite:
+            self.lite = LiteFleet(
+                self.registry_address, self.n_lite, pool=self.pool,
+                interval=self.lite_interval_s, drivers=self.lite_drivers,
+                volume_keys=self.lite_volume_keys,
+                metrics_endpoint=(
+                    f"127.0.0.1:{self.metrics_srv.port}")).start()
+
         for i in range(self.n_replicas):
             kwargs = dict(self.engine_defaults)
             if i < len(self.engine_kwargs):
@@ -580,10 +839,10 @@ class ClusterSim:
                 self.registry_address, interval=self.table_interval_s,
                 pool=self.pool)
             self.table.refresh()
-            if len(self.table) != self.n_replicas:
+            if len(self.table) != self.n_replicas + self.n_lite:
                 raise AssertionError(
                     f"routing table has {len(self.table)} of "
-                    f"{self.n_replicas} replicas")
+                    f"{self.n_replicas + self.n_lite} replicas")
             self.table.start()
             self.router = router_server(
                 "tcp://127.0.0.1:0",
@@ -605,6 +864,9 @@ class ClusterSim:
             self.table.stop()
         for handle in self.replicas:
             handle.shutdown()
+        if self.lite is not None:
+            self.lite.stop()
+            self.lite = None
         for handle in self.controllers:
             handle.shutdown()
         for _, server, manager in self.registries:

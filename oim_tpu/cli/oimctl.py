@@ -361,23 +361,35 @@ def _series_quantiles(samples, name: str, labels: dict,
 
 
 def top_row(row_id: str, status: str, role: str, target: str,
-            snap: dict | None = None, http_get=_http_get) -> dict:
+            snap: dict | None = None, http_get=_http_get,
+            parse_cache: dict | None = None) -> dict:
     """One `--top` table row: scrape ``target``'s /metrics +
     /debug/events and distill the columns. STALE/unreachable rows
     degrade to placeholders — a dead daemon must still show up (that it
-    is dead IS the signal), not break the table."""
+    is dead IS the signal), not break the table. ``parse_cache`` (a
+    --watch session's dict, target -> (scrape text, parsed samples))
+    skips re-parsing a scrape whose text is byte-identical to the last
+    refresh's — an idle daemon's scrape does not change between beats,
+    and at hundreds of rows the parse dominates the fetch."""
     import json
 
     row = {"id": row_id, "status": status, "role": role, "qps": None,
            "ft_ms": (None, None), "it_ms": (None, None), "queue": None,
            "slots": None, "cache_hit": None, "prefix_hit": None,
            "pages": None, "kvtier": None, "accept": None,
-           "repl_lag": None, "spread": None, "events": {}}
+           "repl_lag": None, "commit_ms": (None, None),
+           "pick_ms": (None, None), "spread": None, "events": {}}
     if status != "ALIVE" or not target:
         return row
     try:
-        _, _, samples = parse_prometheus_text(
-            http_get(f"http://{target}/metrics"))
+        text = http_get(f"http://{target}/metrics")
+        cached = (parse_cache or {}).get(target)
+        if cached is not None and cached[0] == text:
+            samples = cached[1]
+        else:
+            _, _, samples = parse_prometheus_text(text)
+            if parse_cache is not None:
+                parse_cache[target] = (text, samples)
         events_doc = json.loads(
             http_get(f"http://{target}/debug/events?limit=512"))
     except (SystemExit, ValueError):
@@ -446,7 +458,20 @@ def top_row(row_id: str, status: str, role: str, target: str,
     if role == "registry":
         row["repl_lag"] = _series_value(
             samples, "oim_replication_lag_records")
+        # Commit pipeline latency (quorum mode): append -> majority ack
+        # -> applied. Dash for pair-mode/standalone registries, whose
+        # histogram has no observations.
+        p50, p99 = _series_quantiles(
+            samples, "oim_registry_commit_seconds", {"phase": "total"})
+        if p50 == p50 or p99 == p99:
+            row["commit_ms"] = (p50 * 1e3, p99 * 1e3)
     if role == "router":
+        # Per-request pick cost: the table-scan control-plane tax the
+        # 10/100/1000-row curve pins (bench.py --control-plane).
+        p50, p99 = _series_quantiles(
+            samples, "oim_router_pick_seconds", {})
+        if p50 == p50 or p99 == p99:
+            row["pick_ms"] = (p50 * 1e3, p99 * 1e3)
         replicas = {
             lbls["replica"]
             for n, lbls, v in samples
@@ -473,11 +498,7 @@ def fleet_top_row(entries) -> dict:
     5-tuples."""
     from oim_tpu.obs import merge
 
-    row = {"id": "ALL", "status": "-", "role": "fleet", "qps": None,
-           "ft_ms": (None, None), "it_ms": (None, None), "queue": None,
-           "slots": None, "cache_hit": None, "prefix_hit": None,
-           "pages": None, "kvtier": None, "accept": None,
-           "repl_lag": None, "spread": None, "events": {}}
+    row = _empty_fleet_row()
     snapshots: dict[str, list] = {"first_token": [], "inter_token": []}
     contributors = 0
     for entry in entries:
@@ -500,6 +521,75 @@ def fleet_top_row(entries) -> dict:
     if contributors:
         row["spread"] = contributors
     return row
+
+
+def _empty_fleet_row() -> dict:
+    return {"id": "ALL", "status": "-", "role": "fleet", "qps": None,
+            "ft_ms": (None, None), "it_ms": (None, None), "queue": None,
+            "slots": None, "cache_hit": None, "prefix_hit": None,
+            "pages": None, "kvtier": None, "accept": None,
+            "repl_lag": None, "commit_ms": (None, None),
+            "pick_ms": (None, None), "spread": None, "events": {}}
+
+
+class _FleetFold:
+    """The --watch session's persistent ALL-row fold: one SnapshotFold
+    per latency key, patched ONLY for rows whose beat stamp moved since
+    the last refresh (incremental, metered as
+    oim_top_merge_seconds{mode=incremental} inside obs/merge.py) —
+    fleet_top_row's from-scratch fold re-sums every row every refresh,
+    which at 1000 rows costs more than the rest of the render. Rows
+    fold at their CURRENT published snapshot (set on change, drop on
+    departure — same semantics as the one-shot scratch path, which the
+    equivalence test in tests/test_obs_merge.py pins), not the
+    SLO plane's monotone departed-epoch banking."""
+
+    _KEYS = (("first_token", "ft_ms"), ("inter_token", "it_ms"))
+
+    def __init__(self):
+        from oim_tpu.obs.merge import SnapshotFold
+
+        self._folds = {key: SnapshotFold() for key, _ in self._KEYS}
+        self._beats: dict[str, object] = {}
+        self._contrib: set[str] = set()
+
+    def row(self, entries) -> dict:
+        from oim_tpu.obs import merge
+
+        seen = set()
+        for entry in entries:
+            rid = entry[0]
+            snap = entry[4] if len(entry) > 4 else None
+            if not isinstance(snap, dict):
+                continue
+            seen.add(rid)
+            beat = snap.get("beat")
+            if beat is not None and self._beats.get(rid) == beat:
+                continue  # unchanged since last refresh: zero fold work
+            self._beats[rid] = beat
+            hist = snap.get("hist")
+            hist = hist if isinstance(hist, dict) else {}
+            if any(key in hist for key, _ in self._KEYS):
+                self._contrib.add(rid)
+            else:
+                self._contrib.discard(rid)
+            for key, _ in self._KEYS:
+                self._folds[key].set(rid, hist.get(key))
+        for rid in list(self._beats):
+            if rid not in seen:
+                del self._beats[rid]
+                self._contrib.discard(rid)
+                for fold in self._folds.values():
+                    fold.drop(rid)
+        row = _empty_fleet_row()
+        for key, col in self._KEYS:
+            merged = self._folds[key].merged()
+            if merged is not None and merge.total(merged) > 0:
+                row[col] = (merge.quantile(merged, 0.5) * 1e3,
+                            merge.quantile(merged, 0.99) * 1e3)
+        if self._contrib:
+            row["spread"] = len(self._contrib)
+        return row
 
 
 def render_top(rows: list[dict]) -> str:
@@ -532,7 +622,7 @@ def render_top(rows: list[dict]) -> str:
     headers = ("ID", "ROLE", "STATUS", "QPS", "FIRST-TOK(ms)",
                "INTER-TOK(ms)", "QUEUE", "SLOTS", "PAGES", "KV-TIER",
                "ACCEPT", "CACHE-HIT", "PREFIX-HIT", "REPL-LAG",
-               "SPREAD", "EVENTS")
+               "COMMIT(ms)", "PICK(ms)", "SPREAD", "EVENTS")
     table = [headers]
     for r in rows:
         top_events = sorted(r["events"].items(),
@@ -547,6 +637,8 @@ def render_top(rows: list[dict]) -> str:
             fmt(r["cache_hit"], "{:.0%}"),
             fmt(r.get("prefix_hit"), "{:.0%}"),
             fmt(r["repl_lag"], "{:g}"),
+            fmt_pair(r.get("commit_ms", (None, None))),
+            fmt_pair(r.get("pick_ms", (None, None))),
             fmt(r["spread"], "{:g}"),
             ",".join(f"{t}:{n}" for t, n in top_events) or "-",
         ))
@@ -846,7 +938,24 @@ def print_autopsy(with_failover, trace_id: str) -> None:
     print(autopsy.render(report))
 
 
-def print_top(with_failover, watch: float = 0.0) -> None:
+def _entry_badness(entry) -> float:
+    """Worst-first sort key for --top: a row's first-token p99 from the
+    histogram snapshot it already published to the registry — no scrape
+    needed, so --limit can trim BEFORE the per-row HTTP fan-out.  Rows
+    with no latency histogram (registry/router daemons, cold replicas)
+    sort last."""
+    from oim_tpu.obs import merge
+
+    snap = entry[4] if len(entry) > 4 else None
+    hist = snap.get("hist") if isinstance(snap, dict) else None
+    sample = hist.get("first_token") if isinstance(hist, dict) else None
+    if sample is None or merge.total(sample) <= 0:
+        return float("-inf")
+    return merge.quantile(sample, 0.99)
+
+
+def print_top(with_failover, watch: float = 0.0,
+              limit: int = 0) -> None:
     """Poll every advertised telemetry endpoint and render one cluster
     table — a synthesized ALL row (fleet-merged percentiles from the
     rows' histogram snapshots) above the per-daemon rows, and a FIRING
@@ -854,7 +963,10 @@ def print_top(with_failover, watch: float = 0.0) -> None:
     on that period until interrupted — discovering rows over one Watch
     stream when the registry supports it (one stream for the whole
     session, not two GetValues reads per refresh), degrading to the
-    GetValues poll otherwise."""
+    GetValues poll otherwise.  ``limit`` > 0 renders only the N worst
+    rows (first-token p99, descending, id tie-break) — the ALL row
+    still folds EVERY registered replica, so the fleet percentiles are
+    not biased by the trim."""
     import time
 
     import grpc as grpc_mod
@@ -866,6 +978,13 @@ def print_top(with_failover, watch: float = 0.0) -> None:
     # reads.
     alert_watcher = AlertWatch(with_failover) if watch > 0 else None
     fleet_watcher = FleetWatch(with_failover) if watch > 0 else None
+    # Per-session scrape parse cache: a --watch refresh where a row's
+    # /metrics text is byte-identical to the previous scrape (idle
+    # daemon between beats) skips re-parsing it (top_row checks).
+    parse_cache: dict[str, tuple[str, list]] = {}
+    # Watch mode folds the ALL row incrementally (only rows whose beat
+    # stamp moved are re-merged); one-shot mode scratch-folds once.
+    fleet_fold = _FleetFold() if watch > 0 else None
     first = True
     try:
         while True:
@@ -891,9 +1010,19 @@ def print_top(with_failover, watch: float = 0.0) -> None:
                 except grpc_mod.RpcError:
                     fleet = []  # dash-degrade, never break the table
             first = False
-            rows = [top_row(*entry) for entry in entries]
+            # The ALL row folds over every entry BEFORE any trim; only
+            # the scraped per-daemon rows honor --limit.
+            all_row = (fleet_fold.row(entries) if fleet_fold is not None
+                       else fleet_top_row(entries)) if entries else None
+            shown = sorted(
+                entries,
+                key=lambda e: (-_entry_badness(e), e[0]))
+            if limit > 0:
+                shown = shown[:limit]
+            rows = [top_row(*entry, parse_cache=parse_cache)
+                    for entry in shown]
             if rows:
-                rows.insert(0, fleet_top_row(entries))
+                rows.insert(0, all_row)
             if watch > 0:
                 print("\033[2J\033[H", end="")  # clear + home, like top(1)
             print(fleet_banner(fleet))
@@ -1000,6 +1129,17 @@ def main(argv: list[str] | None = None) -> int:
              "registry Watch stream when available (push deltas, no "
              "per-refresh GetValues); degrades to polling against a "
              "pre-Watch registry",
+    )
+    parser.add_argument(
+        "--limit",
+        type=int,
+        default=0,
+        metavar="N",
+        help="with --top: render only the N worst rows (first-token "
+             "p99 from each row's published snapshot, descending, id "
+             "tie-break; 0 = all). The ALL row still folds every "
+             "registered replica, so fleet percentiles are unbiased "
+             "by the trim",
     )
     parser.add_argument(
         "--alerts",
@@ -1135,7 +1275,7 @@ def main(argv: list[str] | None = None) -> int:
     if args.autopsy is not None:
         print_autopsy(with_failover, args.autopsy)
     if args.top:
-        print_top(with_failover, watch=args.watch)
+        print_top(with_failover, watch=args.watch, limit=args.limit)
     if not requested_registry_ops and args.metrics is None \
             and args.events is None:
         raise SystemExit(

@@ -57,6 +57,11 @@ REGISTRY_STEPDOWN = "registry_stepdown"
 # snapshot resync (or to GetValues polling against a pre-Watch
 # registry).
 WATCH_RESYNC = "watch_resync"
+# The hub closed a Watch stream because the consumer overflowed its
+# bounded queue (registry/watch.py slow-consumer shed). Carries the
+# stream's prefix and queue high-water mark so a shed at 1k-replica
+# scale is diagnosable from /debug/events instead of silent.
+WATCH_STREAM_SHED = "watch_stream_shed"
 ROUTER_RETRY = "router_retry"
 ROUTER_MARK_FAILED = "router_mark_failed"
 # The replica table aged past --max-stale (registry outage outlasting

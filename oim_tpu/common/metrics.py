@@ -492,6 +492,68 @@ WATCH_EVENTS = DEFAULT.counter(
     "Watch events delivered to consumers, by kind "
     "(put/delete/expired/sync)",
     labelnames=("kind",))
+# Control-plane self-metrics: the paths every fleet consumer rides
+# (Watch fan-out, quorum commit, election convergence, telemetry fold,
+# router pick), instrumented so bench.py --control-plane can publish
+# the 10/100/1000-replica knee curve and oimctl --top can show where
+# the control plane bends.
+WATCH_FANOUT_SECONDS = DEFAULT.histogram(
+    "oim_watch_fanout_seconds",
+    "wall seconds one committed delta took to serialize (once) and "
+    "enqueue onto every attached Watch stream — the write path's "
+    "fan-out tax; bucket exemplars carry the mutation's trace id",
+    buckets=(0.000001, 0.0000025, 0.000005, 0.00001, 0.000025, 0.00005,
+             0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.05,
+             0.25))
+WATCH_QUEUE_DEPTH = DEFAULT.gauge(
+    "oim_watch_queue_depth_peak",
+    "deepest per-stream Watch queue observed at the most recent "
+    "fan-out (0 = every consumer keeping up; approaching queue_max = "
+    "a shed is imminent)")
+WATCH_SHED_STREAMS = DEFAULT.counter(
+    "oim_watch_shed_streams_total",
+    "Watch streams closed because a slow consumer overflowed its "
+    "bounded queue (each shed also lands a watch_stream_shed flight-"
+    "recorder event with the prefix and queue high-water mark)")
+REGISTRY_COMMIT_SECONDS = DEFAULT.histogram(
+    "oim_registry_commit_seconds",
+    "quorum write pipeline on the leader, by phase: ack = append until "
+    "a majority holds the record, apply = majority-ack until the DB "
+    "mutation (and its Watch fan-out) lands, total = append until "
+    "client-visible; exemplars carry the proposing RPC's trace id",
+    labelnames=("phase",),
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5))
+REGISTRY_ELECTION_SECONDS = DEFAULT.histogram(
+    "oim_registry_election_seconds",
+    "campaign start to leadership on this member (won elections only) "
+    "— the convergence half of leader-kill recovery; the other half is "
+    "the election timeout that started the campaign",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0))
+REGISTRY_READ_LAG = DEFAULT.gauge(
+    "oim_registry_read_lag_records",
+    "committed records this follower has not yet applied (received-"
+    "but-unflushed + known-committed-but-unreceived): the raft read-"
+    "index gap — a follower GetValues can trail the leader's commit "
+    "by one ack round-trip (doc/architecture.md, Control plane at "
+    "scale); 0 on leaders")
+TOP_MERGE_SECONDS = DEFAULT.histogram(
+    "oim_top_merge_seconds",
+    "one fleet-histogram fold (obs/merge.py) by mode: scratch = "
+    "re-merge every contributor snapshot, incremental = apply only "
+    "changed rows to the running per-grid aggregate (what --top "
+    "--watch re-renders cost)",
+    labelnames=("mode",),
+    buckets=(0.00001, 0.000025, 0.00005, 0.0001, 0.00025, 0.0005,
+             0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25))
+ROUTER_PICK_SECONDS = DEFAULT.histogram(
+    "oim_router_pick_seconds",
+    "wall seconds one router pick spent scoring the replica table "
+    "(affinity hash + least-loaded scan) — linear in table rows, the "
+    "per-request control-plane tax bench.py --control-plane curves at "
+    "10/100/1000 rows",
+    buckets=(0.000001, 0.0000025, 0.000005, 0.00001, 0.000025, 0.00005,
+             0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.01))
 # Direct data path (feeder/driver.py + common/channelpool.py): windows
 # served controller-direct vs through the registry proxy, per-window
 # throughput, and the pooled-channel census.

@@ -27,12 +27,22 @@ final snapshot is banked into a base and the fresh one counts on top,
 never producing a negative delta. A replica whose lease lapses keeps
 its last contribution frozen in the merge (its history still happened);
 only an explicit ``forget`` drops it.
+
+Folding is INCREMENTAL: ``SnapshotFold`` keeps per-grid running
+aggregates that contributors patch in and out on row change, so
+``merged()`` is O(grids) per render instead of O(replicas) — at 1k
+telemetry rows the from-scratch fold was the ``--top --watch`` render
+knee (bench.py --control-plane records the paired before/after; the
+``oim_top_merge_seconds{mode}`` histogram times both paths).
 """
 
 from __future__ import annotations
 
 import math
+import time
 from typing import Iterable, Sequence
+
+from oim_tpu.common import metrics as M
 
 # Sum comparisons tolerate float re-serialization jitter; a genuine
 # reset drops the sum by whole observations, not by rounding noise.
@@ -170,6 +180,84 @@ def is_reset(prev: dict, cur: dict) -> bool:
     return sum_c < sum_p - max(_SUM_EPS, abs(sum_p) * 1e-9)
 
 
+class SnapshotFold:
+    """Incremental ``merge_snapshots``: contributors register snapshots
+    under a key; per-grid running aggregates make ``merged()`` O(grids)
+    instead of O(contributors), and ``set``/``drop`` cost O(buckets).
+    For any sequence of set/drop calls, ``merged()`` equals
+    ``merge_snapshots`` over the surviving contributions (bucket counts
+    exactly — they are integer sums; the observation sum to float
+    patch-out jitter), which tests/test_obs_merge.py pins
+    property-style."""
+
+    def __init__(self) -> None:
+        self._snaps: dict[object, dict] = {}
+        # grid -> {"counts": running cumulative sums, "sum": float,
+        #          "n": contributor count} — dropped when n reaches 0.
+        self._agg: dict[tuple[float, ...], dict] = {}
+
+    def _patch_out(self, key: object) -> None:
+        old = self._snaps.pop(key, None)
+        if old is None:
+            return
+        grid = tuple(old["le"])
+        agg = self._agg[grid]
+        agg["n"] -= 1
+        if agg["n"] == 0:
+            del self._agg[grid]
+            return
+        counts = agg["counts"]
+        for i, c in enumerate(old["counts"]):
+            counts[i] -= c
+        agg["sum"] -= old["sum"]
+
+    def set(self, key: object, snap: dict | None) -> None:
+        """Register/replace one contributor. ``None`` (or a snapshot
+        ``validate`` rejects) drops it — the same skip-don't-poison
+        stance ``merge_snapshots`` takes on malformed rows."""
+        self._patch_out(key)
+        if snap is None:
+            return
+        try:
+            le, counts, total_sum = validate(snap)
+        except ValueError:
+            return
+        self._snaps[key] = {"le": list(le), "counts": list(counts),
+                            "sum": total_sum}
+        agg = self._agg.get(le)
+        if agg is None:
+            self._agg[le] = {"counts": list(counts), "sum": total_sum,
+                             "n": 1}
+        else:
+            running = agg["counts"]
+            for i, c in enumerate(counts):
+                running[i] += c
+            agg["sum"] += total_sum
+            agg["n"] += 1
+
+    def drop(self, key: object) -> None:
+        self._patch_out(key)
+
+    def keys(self) -> list:
+        return list(self._snaps)
+
+    def merged(self) -> dict | None:
+        """The majority-grid aggregate (same grid election as
+        ``merge_snapshots``), or None with no contributors."""
+        t0 = time.monotonic()
+        if not self._agg:
+            return None
+        grid = max(self._agg,
+                   key=lambda g: (self._agg[g]["n"],
+                                  self._agg[g]["counts"][-1], g))
+        agg = self._agg[grid]
+        out = {"le": list(grid), "counts": list(agg["counts"]),
+               "sum": agg["sum"]}
+        M.TOP_MERGE_SECONDS.labels(mode="incremental").observe(
+            time.monotonic() - t0)
+        return out
+
+
 class FleetHistogram:
     """Counter-reset-aware fold of per-replica cumulative snapshots.
 
@@ -178,7 +266,9 @@ class FleetHistogram:
     snapshots + departed replicas' closed epochs, summed). Replicas
     publishing a different ``le`` grid than the fleet majority are
     excluded from ``merged()`` (the mixed-version dash stance) but keep
-    their own history."""
+    their own history. A ``SnapshotFold`` mirrors every contribution so
+    ``merged()`` costs O(grids) however often it renders; the
+    from-scratch oracle survives as ``merged_scratch()``."""
 
     def __init__(self) -> None:
         self._last: dict[str, dict] = {}
@@ -190,6 +280,9 @@ class FleetHistogram:
         # deltas until fresh traffic re-exceeded the forgotten totals,
         # blinding alerting for hours after a rolling restart.
         self._departed: dict[tuple[float, ...], dict] = {}
+        # Incremental mirror: ("live", rid) carries replica(rid),
+        # ("departed", grid) carries that grid's departed bank.
+        self._fold = SnapshotFold()
 
     def update(self, replica_id: str, snap: dict) -> None:
         le, counts, total_sum = validate(snap)
@@ -205,6 +298,7 @@ class FleetHistogram:
                 # than mis-bucketed.
                 self._base.pop(replica_id, None)
         self._last[replica_id] = clean
+        self._fold.set(("live", replica_id), self.replica(replica_id))
 
     def forget(self, replica_id: str) -> None:
         """Close a replica's epoch (explicit deregistration): its id
@@ -219,8 +313,10 @@ class FleetHistogram:
             bank = self._departed.get(grid)
             self._departed[grid] = folded if bank is None \
                 else add(bank, folded)
+            self._fold.set(("departed", grid), self._departed[grid])
         self._last.pop(replica_id, None)
         self._base.pop(replica_id, None)
+        self._fold.drop(("live", replica_id))
 
     def replica(self, replica_id: str) -> dict | None:
         """One replica's epoch-folded histogram (base + live)."""
@@ -235,7 +331,15 @@ class FleetHistogram:
 
     def merged(self) -> dict | None:
         """The fleet histogram (live replicas + departed epochs), or
-        None when nothing has ever published."""
+        None when nothing has ever published. Served from the
+        incremental fold: O(grids), however many replicas contribute."""
+        return self._fold.merged()
+
+    def merged_scratch(self) -> dict | None:
+        """The from-scratch reference fold — re-merges every
+        contributor per call, O(replicas). Kept as the equivalence
+        oracle ``merged()`` is tested against and as the baseline side
+        of the bench's paired incremental-vs-scratch comparison."""
         folded = [self.replica(rid) for rid in self._last]
         folded.extend(self._departed.values())
         return merge_snapshots(folded)
@@ -244,7 +348,10 @@ class FleetHistogram:
 def merge_snapshots(snaps: Iterable[dict | None]) -> dict | None:
     """Merge snapshots that share the majority ``le`` grid; None/invalid
     entries and minority-grid snapshots are skipped (ties break toward
-    the grid holding more observations). None when nothing merges."""
+    the grid holding more observations, then the larger grid — a total
+    order, so the incremental fold elects identically). None when
+    nothing merges."""
+    t0 = time.monotonic()
     by_grid: dict[tuple[float, ...], list[dict]] = {}
     for snap in snaps:
         if snap is None:
@@ -259,10 +366,12 @@ def merge_snapshots(snaps: Iterable[dict | None]) -> dict | None:
         return None
     grid = max(by_grid,
                key=lambda g: (len(by_grid[g]),
-                              sum(s["counts"][-1] for s in by_grid[g])))
+                              sum(s["counts"][-1] for s in by_grid[g]), g))
     out = zero(grid)
     for snap in by_grid[grid]:
         out = add(out, snap)
+    M.TOP_MERGE_SECONDS.labels(mode="scratch").observe(
+        time.monotonic() - t0)
     return out
 
 

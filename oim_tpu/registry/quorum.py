@@ -60,7 +60,8 @@ import time
 
 import grpc
 
-from oim_tpu.common import backoff, events, faultinject, metrics as M
+from oim_tpu.common import backoff, events, faultinject, tracing
+from oim_tpu.common import metrics as M
 from oim_tpu.common.channelpool import ChannelPool
 from oim_tpu.common.logging import from_context
 from oim_tpu.registry.db import get_registry_entries
@@ -94,6 +95,18 @@ class QuorumUnavailable(Exception):
     """The proposal could not reach a majority (partitioned leader,
     mid-flight step-down, shutdown). The write was never acknowledged
     or made visible anywhere."""
+
+
+def _position_ahead(reply, request) -> bool:
+    """True when a vote reply advertises a log position STRICTLY ahead
+    of the soliciting candidate's (VoteRequest) position — the same
+    term-first, offsets-only-within-one-journal comparison the vote
+    rule uses (same term + different log_id compares equal)."""
+    if reply.last_log_term != request.last_log_term:
+        return reply.last_log_term > request.last_log_term
+    if reply.log_id == request.log_id:
+        return reply.last_log_offset > request.last_log_offset
+    return False
 
 
 class _Partitioned(Exception):
@@ -175,6 +188,15 @@ class QuorumManager:
         self._cond = threading.Condition(self._lock)
         self._apply_lock = threading.Lock()
         self._uncommitted: dict[int, object] = {}
+        # Commit-pipeline timing: offset -> (append monotonic, trace id
+        # of the proposing RPC), popped when the record commits so
+        # oim_registry_commit_seconds can split ack/apply phases and
+        # anchor exemplars. Cleared wherever _uncommitted is.
+        self._append_meta: dict[int, tuple[float, str]] = {}
+        # Campaign start (monotonic) while an election this member
+        # opened is in flight: oim_registry_election_seconds observes
+        # it at _become_leader (won elections only).
+        self._campaign_t0 = 0.0
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._call = None  # in-flight follower stream, cancellable
@@ -298,6 +320,8 @@ class QuorumManager:
                 raise NotLeader(self._leader_addr)
             self.log._append(rec)
             self._uncommitted[rec.offset] = rec
+            self._append_meta[rec.offset] = (
+                time.monotonic(), tracing.trace_id())
             position = (rec.offset, self.log.log_id)
         # A single-member "quorum" (and the leader's own vote toward
         # majority) may already satisfy commitment.
@@ -344,14 +368,26 @@ class QuorumManager:
                 recs = [self._uncommitted.pop(o)
                         for o in range(self.commit_offset, target)
                         if o in self._uncommitted]
+                meta = [self._append_meta.pop(rec.offset, None)
+                        for rec in recs]
             # Apply OUTSIDE self._lock (apply_kv fans out to Watch
             # streams) and WITHOUT the service write lock: in quorum
             # mode every client write funnels through propose (this is
             # the only applier, serialized by _apply_lock), and the one
             # direct-DB writer (the registry's own telemetry row) is
             # idempotent against its own journaled copy landing here.
+            acked = time.monotonic()
             for rec in recs:
                 self._apply_record(rec)
+            applied = time.monotonic()
+            for m in meta:
+                if m is None:
+                    continue  # appended by a previous leader's tenure
+                t0, trace = m
+                commit = M.REGISTRY_COMMIT_SECONDS
+                commit.labels(phase="ack").observe(acked - t0, trace)
+                commit.labels(phase="apply").observe(applied - acked, trace)
+                commit.labels(phase="total").observe(applied - t0, trace)
             with self._cond:
                 self.commit_offset = target
                 M.REGISTRY_COMMIT_INDEX.set(float(target))
@@ -380,6 +416,7 @@ class QuorumManager:
         M.REGISTRY_TERM.set(float(self.term))
         self._election_deadline = self._draw_deadline()
         self._uncommitted.clear()
+        self._append_meta.clear()
         self._cond.notify_all()  # fail in-flight proposals
         if was_leader:
             M.REGISTRY_ROLE.set(0.0)
@@ -398,6 +435,7 @@ class QuorumManager:
             self.role = FOLLOWER
             self._leader_addr = ""
             self._uncommitted.clear()
+            self._append_meta.clear()
             self._election_deadline = self._draw_deadline()
             self._cond.notify_all()
             term = self.term
@@ -418,27 +456,44 @@ class QuorumManager:
         self._campaign(reason=reason or "admin", force=True)
         return self.role == LEADER
 
-    def _gather_votes(self, request, vote_timeout: float) -> int:
-        """Solicit every peer in parallel; returns grants (the self
-        vote included). Higher terms in replies are adopted."""
+    def _gather_votes(self, request,
+                      vote_timeout: float) -> tuple[int, bool]:
+        """Solicit every peer in parallel; returns (grants, ahead) —
+        grants includes the self vote, ahead is True when any reply
+        (granted or not) advertised a log position strictly ahead of
+        the candidate's. Higher terms in replies are adopted."""
         grants = [1]
+        ahead = [False]
+        finished = [0]
         vote_lock = threading.Lock()
         done = threading.Event()
 
         def solicit(target: str) -> None:
+            # Every solicitation resolves (reply, error, or the RPC
+            # deadline) — `done` fires only when ALL have, never on a
+            # majority short-circuit: the ahead-position evidence this
+            # round exists to collect may be a DENY from the slowest
+            # live peer, and returning early would elect without it.
             try:
-                self._check_reachable(target)
-                reply = RegistryStub(self._peer_channel(target)).Vote(
-                    request, timeout=vote_timeout)
-            except (_Partitioned, grpc.RpcError):
-                return
-            with self._lock:
-                self._adopt_term(reply.term,
-                                 f"higher term from {target} vote reply")
-            if reply.granted:
+                try:
+                    self._check_reachable(target)
+                    reply = RegistryStub(self._peer_channel(target)).Vote(
+                        request, timeout=vote_timeout)
+                except (_Partitioned, grpc.RpcError):
+                    return
+                with self._lock:
+                    self._adopt_term(
+                        reply.term,
+                        f"higher term from {target} vote reply")
                 with vote_lock:
-                    grants[0] += 1
-                    if grants[0] >= self.majority:
+                    if _position_ahead(reply, request):
+                        ahead[0] = True
+                    if reply.granted:
+                        grants[0] += 1
+            finally:
+                with vote_lock:
+                    finished[0] += 1
+                    if finished[0] == len(self.peers):
                         done.set()
 
         threads = [threading.Thread(target=solicit, args=(p,), daemon=True)
@@ -447,7 +502,7 @@ class QuorumManager:
             t.start()
         done.wait(vote_timeout)
         with vote_lock:
-            return grants[0]
+            return grants[0], ahead[0]
 
     def _campaign(self, reason: str = "", force: bool = False) -> None:
         try:
@@ -460,6 +515,14 @@ class QuorumManager:
         vote_timeout = max(self.election_timeout_s / 2.0, 0.2)
         with self._lock:
             if self.role == LEADER:
+                return
+            if self._wiped_rejoining_locked():
+                # Wiped + mid-rejoin: this member has observed a leader
+                # advertise committed records it does not hold yet.
+                # Standing now could seat a leader missing committed
+                # state (see _wiped_rejoining_locked); wait out the
+                # resync instead.
+                self._election_deadline = self._draw_deadline()
                 return
             my_term = self.term + 1
             last_log_term, last_offset, log_id = self._log_position()
@@ -476,7 +539,18 @@ class QuorumManager:
                 last_log_term=last_log_term,
                 last_log_offset=last_offset, log_id=log_id,
                 prevote=True)
-            if self._gather_votes(prevote, vote_timeout) < self.majority:
+            pre_grants, pre_ahead = self._gather_votes(prevote,
+                                                       vote_timeout)
+            if pre_ahead:
+                # A live peer is ahead of this member. Yield before
+                # bumping any term: the ahead member's own deadline
+                # elects it with its full journal, and this member
+                # resyncs from it — standing here could seat a leader
+                # missing records only that peer still holds.
+                with self._lock:
+                    self._election_deadline = self._draw_deadline()
+                return
+            if pre_grants < self.majority:
                 return  # stay a quiet follower; probe/retry later
         with self._lock:
             if self.role == LEADER or self.term >= my_term:
@@ -484,6 +558,7 @@ class QuorumManager:
             self.term = my_term
             self.voted_for = self.node_id
             self.role = CANDIDATE
+            self._campaign_t0 = time.monotonic()
             self._save_state()
         M.REGISTRY_TERM.set(float(my_term))
         events.emit(events.REGISTRY_ELECTION, epoch=my_term,
@@ -492,13 +567,24 @@ class QuorumManager:
             term=my_term, candidate_id=self.node_id,
             last_log_term=last_log_term, last_log_offset=last_offset,
             log_id=log_id)
-        grants = self._gather_votes(request, vote_timeout)
+        grants, ahead = self._gather_votes(request, vote_timeout)
         with self._lock:
             if self.role != CANDIDATE or self.term != my_term:
                 return  # superseded mid-campaign
-            if grants >= self.majority:
+            if grants >= self.majority and not ahead:
                 self._become_leader()
             else:
+                if ahead:
+                    # Majority or not, a live voter advertised a log
+                    # position ahead of this candidate's. With
+                    # in-memory members a committed record can survive
+                    # on a single peer (wiped rejoiners vote virgin
+                    # positions), so seating this candidate could
+                    # erase it on resync — yield and let the ahead
+                    # member's own election timeout elect it instead.
+                    from_context().warning(
+                        "election yielded: a voter is ahead",
+                        term=my_term, grants=grants)
                 self.role = FOLLOWER
                 self._election_deadline = self._draw_deadline()
 
@@ -510,6 +596,29 @@ class QuorumManager:
         if self.log_term >= self._received_term:
             return self.log_term, self.log.next_offset, self.log.log_id
         return self._received_term, self._received, self._received_log_id
+
+    def _wiped_rejoining_locked(self) -> bool:
+        """Caller holds ``self._lock``. True while this member is a
+        wiped rejoiner: its own position is virgin (never led, never
+        completed a resync) yet it has already seen a leader advertise
+        committed records. In-memory members lose their journal across
+        a restart, so Raft's durable-log premise does not hold here: a
+        restarted-empty candidate plus a restarted-empty voter form a
+        majority that can elect a leader MISSING committed records,
+        whose snapshot resync then erases them from the one member
+        that still held them (the 100-replica rolling-restart rung
+        reproduced exactly this under heartbeat fan-in load). Until
+        its first resync lands (SNAPSHOT_END), such a member neither
+        stands for election nor endorses another virgin candidate. A
+        genuine cold boot has no commit evidence anywhere, so first
+        elections are unaffected; and a member that merely heard a
+        campaign (a term, no commit offset) is likewise unaffected.
+        Virginity is judged by _log_position — the exact position this
+        member would campaign and vote with — so the guard can never
+        disagree with the VoteRequest it suppresses."""
+        last_log_term, last_offset, _ = self._log_position()
+        virgin = last_log_term == 0 and last_offset == 0
+        return virgin and self._leader_commit > 0
 
     def _become_leader(self) -> None:
         """Caller holds ``self._lock`` and verified a majority of
@@ -527,6 +636,7 @@ class QuorumManager:
         self.log_term = self.term
         self.commit_offset = 0
         self._uncommitted.clear()
+        self._append_meta.clear()
         self._match = {}
         now = time.monotonic()
         # Fresh grace for every peer: the step-down check must not fire
@@ -539,6 +649,11 @@ class QuorumManager:
         M.REGISTRY_ROLE.set(1.0)
         M.REGISTRY_COMMIT_INDEX.set(0.0)
         M.REGISTRY_PROMOTIONS.inc()
+        M.REGISTRY_READ_LAG.set(0.0)  # leaders serve committed state
+        if self._campaign_t0:
+            M.REGISTRY_ELECTION_SECONDS.observe(
+                time.monotonic() - self._campaign_t0)
+            self._campaign_t0 = 0.0
         events.emit(events.REGISTRY_PROMOTION, epoch=self.term,
                     node=self.node_id, reason="election won")
         from_context().warning("elected LEADER", term=self.term,
@@ -568,7 +683,7 @@ class QuorumManager:
                 granted = (not has_live_leader
                            and request.term >= self.term
                            and self._candidate_up_to_date(request))
-                return pb.VoteReply(term=self.term, granted=granted)
+                return self._vote_reply_locked(granted)
         with self._lock:
             if request.term > self.term:
                 self._adopt_term(
@@ -587,7 +702,18 @@ class QuorumManager:
                 # against the candidate it just endorsed.
                 self._election_deadline = self._draw_deadline()
                 self._leader_addr = request.candidate_id
-            return pb.VoteReply(term=self.term, granted=granted)
+            return self._vote_reply_locked(granted)
+
+    def _vote_reply_locked(self, granted: bool):
+        """Caller holds ``self._lock``. Every vote reply — granted or
+        not, pre-vote or real — advertises the voter's own log position
+        so the candidate can yield the election when a live voter is
+        ahead of it (see _campaign)."""
+        my_term, my_offset, my_log_id = self._log_position()
+        return pb.VoteReply(term=self.term, granted=granted,
+                            last_log_term=my_term,
+                            last_log_offset=my_offset,
+                            log_id=my_log_id)
 
     def _candidate_up_to_date(self, request) -> bool:
         """Caller holds ``self._lock``. Raft's election restriction:
@@ -596,6 +722,14 @@ class QuorumManager:
         id (mismatched ids compare on term alone; see module
         docstring)."""
         my_term, my_offset, my_log_id = self._log_position()
+        if (request.last_log_term == 0 and request.last_log_offset == 0
+                and self._wiped_rejoining_locked()):
+            # A virgin candidate soliciting a wiped rejoiner: neither
+            # holds the committed records this voter KNOWS exist
+            # (_leader_commit > 0) — granting could seat a leader
+            # whose resync erases them. Non-virgin candidates fall
+            # through to the ordinary position comparison.
+            return False
         if request.last_log_term != my_term:
             return request.last_log_term > my_term
         if request.log_id == my_log_id:
@@ -668,6 +802,21 @@ class QuorumManager:
                 + (f" leader={self._leader_addr}"
                    if self._leader_addr else ""),
             )
+        # A follower opening a stream declares everything it holds
+        # (from_offset within this journal; nothing, when its log_id
+        # differs or it resyncs from scratch). Clamp its match entry to
+        # that claim: on_ack keeps the running max, so without this a
+        # follower that restarted EMPTY would still be counted at its
+        # pre-restart offset and records could commit on a majority
+        # that no longer holds them — the rolling-restart data-loss
+        # seen at 100-replica heartbeat fan-in.
+        if request.node_id:
+            held = (request.from_offset
+                    if request.log_id == self.log.log_id else 0)
+            with self._lock:
+                prev = self._match.get(request.node_id, 0)
+                if held < prev:
+                    self._match[request.node_id] = held
         # Pin the journal this stream serves: a step-down + re-election
         # while the generator is suspended in a yield would otherwise
         # resume collecting from the FRESH journal at the stale cursor,
@@ -945,6 +1094,18 @@ class QuorumManager:
                 M.REPL_LAG_RECORDS.set(float(len(self._pending)))
                 M.REPL_LAG_SECONDS.set(0.0)
                 M.REGISTRY_COMMIT_INDEX.set(float(self._leader_commit))
+                M.REGISTRY_READ_LAG.set(float(self._read_lag_locked()))
+
+    def _read_lag_locked(self) -> int:
+        """Committed records this follower cannot yet serve: the
+        received-but-unapplied tail plus records it knows committed but
+        has not received. This is the raft read-index gap — between a
+        record landing here (acked, counted toward the leader's
+        majority) and the NEXT leader contact advertising the commit,
+        a follower GetValues trails the leader by one ack round-trip
+        (doc/architecture.md, Control plane at scale)."""
+        return (len(self._pending)
+                + max(0, self._leader_commit - self._received))
 
     def _flush_pending(self) -> None:
         """Apply buffered records the leader has since committed — the
@@ -956,6 +1117,9 @@ class QuorumManager:
                              if r.offset >= self._leader_commit]
         for rec in ready:
             self._apply_record(rec)
+        if ready:
+            with self._lock:
+                M.REGISTRY_READ_LAG.set(float(self._read_lag_locked()))
 
     def _send_ack(self, leader: str) -> None:
         """Report the held offset to the leader (best-effort); a higher

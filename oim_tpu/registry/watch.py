@@ -26,7 +26,19 @@ analog):
 * **Slow consumers are closed, not waited on.** Each stream owns a
   bounded queue; publishing never blocks the registry's write path. An
   overflowed stream is aborted RESOURCE_EXHAUSTED and the client
-  resumes with its last token.
+  resumes with its last token. Every shed lands a ``watch_stream_shed``
+  flight-recorder event (prefix + queue high-water mark) and bumps
+  ``oim_watch_shed_streams_total`` — at 1k-replica scale a silent shed
+  is indistinguishable from a healthy idle stream.
+* **Serialize once, fan out bytes.** A delta's resume token embeds only
+  the hub-global sequence number, so the wire frame is identical for
+  every stream: the hub serializes each committed delta ONCE at publish
+  and live streams yield the shared bytes (the gRPC layer passes
+  pre-serialized frames through). Only the per-stream synthetic events
+  — RESET/SYNC markers and snapshot PUTs, whose tokens are
+  stream-relative — are still built per stream. Publish cost is
+  ``oim_watch_fanout_seconds``; before this the fan-out tax was
+  streams x serialization.
 * **Keepalives.** An idle stream yields a SYNC marker every
   ``keepalive`` seconds, so consumers (the router's replica table) can
   treat stream silence as registry trouble without a separate probe.
@@ -38,9 +50,11 @@ import collections
 import os
 import queue
 import threading
+import time
 
 import grpc
 
+from oim_tpu.common import events, tracing
 from oim_tpu.common import metrics as M
 from oim_tpu.common.pathutil import path_has_prefix
 from oim_tpu.registry.db import get_registry_entries
@@ -60,7 +74,7 @@ _KIND_LABEL = {KIND_PUT: "put", KIND_DELETE: "delete",
 class _Delta:
     """One committed mutation, as the ring and stream queues carry it."""
 
-    __slots__ = ("seq", "kind", "path", "value", "lease")
+    __slots__ = ("seq", "kind", "path", "value", "lease", "wire")
 
     def __init__(self, seq: int, kind: int, path: str, value: str,
                  lease: float):
@@ -69,12 +83,17 @@ class _Delta:
         self.path = path
         self.value = value
         self.lease = lease
+        # The serialize-once wire frame: every stream's copy of this
+        # delta is byte-identical (the resume token embeds only the
+        # hub-global seq), so the hub serializes at first fan-out and
+        # live streams yield these shared bytes.
+        self.wire: bytes | None = None
 
 
 class _Stream:
     """One attached watcher: its prefix scope and bounded queue."""
 
-    __slots__ = ("parts", "queue", "dead")
+    __slots__ = ("parts", "queue", "dead", "high_water")
 
     def __init__(self, parts: list[str], maxsize: int):
         self.parts = parts
@@ -82,6 +101,10 @@ class _Stream:
         # Set when the queue overflowed (slow consumer): the serving
         # generator aborts the stream instead of the registry blocking.
         self.dead = threading.Event()
+        # Deepest this stream's queue has been (post-put depth): the
+        # shed event's diagnostic payload, and what oim_watch_queue_
+        # depth_peak reports fleet-wide.
+        self.high_water = 0
 
 
 class WatchConsumer:
@@ -188,6 +211,7 @@ class WatchHub:
 
     def _publish(self, kind: int, path: str, value: str,
                  lease: float) -> None:
+        t0 = time.monotonic()
         with self._lock:
             self._seq += 1
             delta = _Delta(self._seq, kind, path, value, lease)
@@ -197,14 +221,40 @@ class WatchHub:
             elif path not in self._dead:
                 self._dead.add(path)
             streams = list(self._streams)
+        fanned = False
+        peak = 0
         for stream in streams:
             if stream.dead.is_set() or not path_has_prefix(path, stream.parts):
                 continue
+            if delta.wire is None:
+                # Serialize ONCE for the whole fan-out: every stream's
+                # frame for this delta is byte-identical.
+                delta.wire = self._proto(delta).SerializeToString()
+            fanned = True
             try:
                 stream.queue.put_nowait(delta)
             except queue.Full:
-                # Never block the write path on a watcher: close it.
-                stream.dead.set()
+                # Never block the write path on a watcher: close it
+                # (loudly — the shed must be diagnosable at scale).
+                self._shed(stream)
+                continue
+            depth = stream.queue.qsize()
+            if depth > stream.high_water:
+                stream.high_water = depth
+            if depth > peak:
+                peak = depth
+        if fanned:
+            M.WATCH_QUEUE_DEPTH.set(float(peak))
+            M.WATCH_FANOUT_SECONDS.observe(
+                time.monotonic() - t0, exemplar=tracing.trace_id())
+
+    def _shed(self, stream: _Stream) -> None:
+        stream.dead.set()
+        M.WATCH_SHED_STREAMS.inc()
+        events.emit(events.WATCH_STREAM_SHED,
+                    prefix="/".join(stream.parts),
+                    queue_high_water=stream.high_water,
+                    queue_max=self.queue_max)
 
     # -- the expiry sweeper ------------------------------------------------
 
@@ -255,8 +305,7 @@ class WatchHub:
         except ValueError:
             return None
 
-    def _event(self, delta: _Delta) -> pb.WatchEvent:
-        M.WATCH_EVENTS.labels(kind=_KIND_LABEL[delta.kind]).inc()
+    def _proto(self, delta: _Delta) -> pb.WatchEvent:
         event = pb.WatchEvent(kind=delta.kind,
                               resume_token=self._token(delta.seq))
         if delta.kind != KIND_SYNC:
@@ -264,6 +313,24 @@ class WatchHub:
             event.value.value = delta.value
             event.value.lease_seconds = delta.lease
         return event
+
+    def _event(self, delta: _Delta) -> pb.WatchEvent:
+        """A per-stream synthetic event (RESET/SYNC markers, snapshot
+        PUTs): these carry stream-relative tokens, so they cannot share
+        a wire frame."""
+        M.WATCH_EVENTS.labels(kind=_KIND_LABEL[delta.kind]).inc()
+        return self._proto(delta)
+
+    def _wire(self, delta: _Delta) -> bytes:
+        """The shared serialize-once frame for a ring delta (the gRPC
+        response serializer passes bytes through untouched). Ring
+        deltas published before any stream attached serialize here on
+        first delivery."""
+        M.WATCH_EVENTS.labels(kind=_KIND_LABEL[delta.kind]).inc()
+        wire = delta.wire
+        if wire is None:
+            wire = delta.wire = self._proto(delta).SerializeToString()
+        return wire
 
     def serve(self, request, context):
         """Generator behind ``Registry.Watch`` (authorization already
@@ -288,7 +355,7 @@ class WatchHub:
                 for delta in ring:
                     if delta.seq > resume_seq \
                             and path_has_prefix(delta.path, parts):
-                        yield self._event(delta)
+                        yield self._wire(delta)
             else:
                 # Full snapshot of the live entries under the prefix.
                 # RESET first: the consumer must forget its view and
@@ -324,7 +391,7 @@ class WatchHub:
                 if delta.seq <= last_sent:
                     continue  # duplicated by the replay/snapshot race
                 last_sent = delta.seq
-                yield self._event(delta)
+                yield self._wire(delta)
         finally:
             with self._lock:
                 if stream in self._streams:

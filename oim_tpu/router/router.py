@@ -61,6 +61,7 @@ import collections
 import itertools
 import random
 import threading
+import time
 
 import grpc
 
@@ -200,8 +201,25 @@ class RouterService:
               hash_cache: dict | None = None,
               prefer_version: str = ""
               ) -> tuple[Replica | None, bool]:
-        """(replica, was_affinity_pick); the one pick implementation.
-        ``hash_cache`` is the per-request hash memo (block size ->
+        """(replica, was_affinity_pick) — times the one pick
+        implementation: the scan is linear in table rows, so
+        oim_router_pick_seconds is the per-request control-plane tax
+        bench.py --control-plane curves at 10/100/1000 rows."""
+        t0 = time.monotonic()
+        try:
+            return self._pick_inner(exclude, prompt, prefix_len,
+                                    hash_cache, prefer_version)
+        finally:
+            M.ROUTER_PICK_SECONDS.observe(time.monotonic() - t0,
+                                          exemplar=tracing.trace_id())
+
+    def _pick_inner(self, exclude: frozenset | set = frozenset(),
+                    prompt=None, prefix_len: int = 0,
+                    hash_cache: dict | None = None,
+                    prefer_version: str = ""
+                    ) -> tuple[Replica | None, bool]:
+        """The one pick implementation. ``hash_cache`` is the
+        per-request hash memo (block size ->
         chain hashes) — _route passes one dict across retry attempts.
         ``prefer_version`` is the rolling-upgrade pin: a retry re-pick
         prefers replicas advertising the FIRST attempt's weights version

@@ -175,6 +175,20 @@ class ControllerServicer:
         context.abort(grpc.StatusCode.UNIMPLEMENTED, "ReadVolume not implemented")
 
 
+def _stream_serializer(reply_cls):
+    """Response serializer for server-streaming methods that passes
+    pre-serialized frames (bytes) through untouched. The Watch hub
+    serializes each delta ONCE and fans the shared bytes out to every
+    attached stream (registry/watch.py); without the passthrough, the
+    gRPC layer would re-serialize per stream and erase the win."""
+    serialize = reply_cls.SerializeToString
+
+    def to_wire(message):
+        return message if isinstance(message, bytes) else serialize(message)
+
+    return to_wire
+
+
 def _add_service(
     server: grpc.Server, servicer, service: str, methods: dict,
     stream_methods: dict | None = None,
@@ -191,7 +205,7 @@ def _add_service(
         handlers[name] = grpc.unary_stream_rpc_method_handler(
             getattr(servicer, name),
             request_deserializer=req_cls.FromString,
-            response_serializer=reply_cls.SerializeToString,
+            response_serializer=_stream_serializer(reply_cls),
         )
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(service, handlers),)

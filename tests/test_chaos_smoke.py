@@ -10,9 +10,9 @@ exported volume, then the holder SIGKILLed mid-fetch -> recompute
 fallback, byte-identical), each converging on its declared
 /debug/events heal signature with zero client-visible errors,
 byte-identical routed outputs, and a zero-leak census
-(bench.chaos_smoke() itself raises on any divergence). The compound rung, the leader-kill-under-load rung and
-the rest of the ladder run under `make chaos` / `pytest -m slow`
-(tests/test_chaos.py)."""
+(bench.chaos_smoke() itself raises on any divergence). The compound
+rung, the leader-kill-under-load rung and the rest of the ladder run
+under `make chaos` / `pytest -m slow` (tests/test_chaos.py)."""
 
 import sys
 from pathlib import Path

@@ -218,3 +218,74 @@ class TestMetricsBridge:
         fleet = merge.FleetHistogram()
         fleet.update("r0", wire)
         assert merge.total(fleet.merged()) == 1
+
+
+class TestIncrementalFoldEquivalence:
+    """The --top --watch fold's correctness contract: for ANY sequence
+    of contributor updates — restarts (counter resets), departures,
+    grid changes — the incremental fold must equal the from-scratch
+    oracle at every step. Bucket counts compare exactly (integer sums);
+    the observation sum tolerates float patch-out jitter."""
+
+    GRIDS = ((0.01, 0.1, 1.0), (0.005, 0.05, 0.5, 5.0))
+
+    def _rand_snap(self, rng, le=None):
+        le = list(le if le is not None else rng.choice(self.GRIDS))
+        counts, c = [], 0
+        for _ in range(len(le) + 1):
+            c += rng.randrange(0, 5)
+            counts.append(c)
+        return {"le": le, "counts": counts,
+                "sum": round(rng.uniform(0, 10), 6)}
+
+    @staticmethod
+    def _same(inc, scratch):
+        if scratch is None or merge.total(scratch) == 0:
+            assert inc is None or merge.total(inc) == merge.total(
+                scratch or {"le": [], "counts": [0], "sum": 0.0})
+            return
+        assert inc is not None
+        assert inc["le"] == scratch["le"]
+        assert inc["counts"] == scratch["counts"]
+        assert abs(inc["sum"] - scratch["sum"]) < 1e-6
+
+    def test_snapshot_fold_matches_scratch_every_step(self):
+        rng = random.Random(11)
+        fold = merge.SnapshotFold()
+        live: dict[str, dict] = {}
+        for _ in range(300):
+            key = f"r{rng.randrange(8)}"
+            if rng.random() < 0.25 and live:
+                victim = rng.choice(sorted(live))
+                fold.drop(victim)
+                live.pop(victim)
+            else:
+                s = self._rand_snap(rng)  # may also CHANGE key's grid
+                fold.set(key, s)
+                live[key] = s
+            self._same(fold.merged(),
+                       merge.merge_snapshots(list(live.values())))
+
+    def test_fleet_histogram_incremental_matches_scratch_oracle(self):
+        """FleetHistogram.merged() (SnapshotFold-backed) against its
+        own merged_scratch() through restart epochs and departures —
+        the pairing bench.py --control-plane times."""
+        rng = random.Random(13)
+        fleet = merge.FleetHistogram()
+        hists: dict[str, object] = {}
+        grid = self.GRIDS[0]
+        for step in range(200):
+            rid = f"r{rng.randrange(6)}"
+            roll = rng.random()
+            if roll < 0.1 and rid in hists:
+                fleet.forget(rid)
+                hists.pop(rid)
+            else:
+                if rid not in hists or roll < 0.2:
+                    # Fresh registry = a restart: counters reset.
+                    hists[rid] = Registry().histogram(
+                        "ft", buckets=grid)
+                hists[rid].observe(rng.uniform(0.001, 2.0))
+                fleet.update(rid, hists[rid].merged_snapshot())
+            inc, scratch = fleet.merged(), fleet.merged_scratch()
+            self._same(inc, scratch)

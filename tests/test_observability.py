@@ -489,6 +489,45 @@ class TestMetricsDrift:
         assert (metrics.SERVE_PREFIX_PEER_FETCHES.labelnames
                 == ("outcome",))
 
+    def test_control_plane_metrics_declared_and_shaped(self):
+        """The control-plane self-metric names are API (ISSUE 18):
+        bench.py --control-plane curves them at 10/100/1000 replicas
+        and oimctl --top's COMMIT/PICK columns parse them off /metrics
+        scrapes — a rename or label change silently blanks both. The
+        commit histogram stays labeled BY PHASE (ack/apply/total) and
+        the fold histogram BY MODE (scratch/incremental); the rest are
+        unlabeled."""
+        assert isinstance(metrics.WATCH_FANOUT_SECONDS, Histogram)
+        assert (metrics.WATCH_FANOUT_SECONDS.name
+                == "oim_watch_fanout_seconds")
+        assert metrics.WATCH_FANOUT_SECONDS.labelnames == ()
+        assert isinstance(metrics.WATCH_QUEUE_DEPTH, Gauge)
+        assert (metrics.WATCH_QUEUE_DEPTH.name
+                == "oim_watch_queue_depth_peak")
+        assert isinstance(metrics.WATCH_SHED_STREAMS, Counter)
+        assert (metrics.WATCH_SHED_STREAMS.name
+                == "oim_watch_shed_streams_total")
+        assert metrics.WATCH_SHED_STREAMS.labelnames == ()
+        assert isinstance(metrics.REGISTRY_COMMIT_SECONDS, Histogram)
+        assert (metrics.REGISTRY_COMMIT_SECONDS.name
+                == "oim_registry_commit_seconds")
+        assert metrics.REGISTRY_COMMIT_SECONDS.labelnames == ("phase",)
+        assert isinstance(metrics.REGISTRY_ELECTION_SECONDS, Histogram)
+        assert (metrics.REGISTRY_ELECTION_SECONDS.name
+                == "oim_registry_election_seconds")
+        assert metrics.REGISTRY_ELECTION_SECONDS.labelnames == ()
+        assert isinstance(metrics.REGISTRY_READ_LAG, Gauge)
+        assert (metrics.REGISTRY_READ_LAG.name
+                == "oim_registry_read_lag_records")
+        assert metrics.REGISTRY_READ_LAG.labelnames == ()
+        assert isinstance(metrics.TOP_MERGE_SECONDS, Histogram)
+        assert metrics.TOP_MERGE_SECONDS.name == "oim_top_merge_seconds"
+        assert metrics.TOP_MERGE_SECONDS.labelnames == ("mode",)
+        assert isinstance(metrics.ROUTER_PICK_SECONDS, Histogram)
+        assert (metrics.ROUTER_PICK_SECONDS.name
+                == "oim_router_pick_seconds")
+        assert metrics.ROUTER_PICK_SECONDS.labelnames == ()
+
 
 class TestTelemetrySnapshotPayload:
     def test_rows_carry_mergeable_histograms(self):

@@ -196,6 +196,93 @@ class TestCommit:
                          or 0) > 10)
 
 
+class TestYieldToData:
+    def test_position_ahead_comparison(self):
+        """``_position_ahead``: the term-first comparison a candidate
+        runs over EVERY vote reply — a voter strictly ahead makes the
+        candidate yield the election instead of seating itself and
+        erasing the voter's committed records on resync. Same term in
+        DIFFERENT journals compares equal (offsets are journal-local),
+        so cold boots — all positions (0,0) — are unaffected."""
+        from oim_tpu.registry.quorum import _position_ahead
+
+        def req(term, off, log_id="L"):
+            return pb.VoteRequest(last_log_term=term,
+                                  last_log_offset=off, log_id=log_id)
+
+        def rep(term, off, log_id="L"):
+            return pb.VoteReply(last_log_term=term,
+                                last_log_offset=off, log_id=log_id)
+
+        assert _position_ahead(rep(2, 1), req(1, 99))
+        assert _position_ahead(rep(1, 5), req(1, 3))
+        assert not _position_ahead(rep(1, 3), req(1, 5))
+        assert not _position_ahead(rep(1, 9, "other"), req(1, 1))
+        assert not _position_ahead(rep(0, 0), req(0, 0))
+
+    def test_vote_reply_advertises_voter_position(self):
+        """Every vote reply — granted or DENIED — carries the voter's
+        own log position: the deny from a data-holding voter is the
+        evidence a wiped-rejoining candidate yields to."""
+        with Cluster() as c:
+            li = c.await_leader()
+            c.stubs[li].SetValue(pb.SetValueRequest(value=pb.Value(
+                path="q/evidence", value="1")), timeout=10)
+            voter = c.managers[(li + 1) % 3]
+            assert wait_for(lambda: voter._log_position()[1] > 0)
+            reply = voter.on_vote(pb.VoteRequest(
+                term=voter.term + 1, candidate_id="wiped-node",
+                last_log_term=0, last_log_offset=0, log_id="fresh"),
+                None)
+            assert not reply.granted
+            term, offset, log_id = voter._log_position()
+            assert (reply.last_log_term, reply.last_log_offset,
+                    reply.log_id) == (term, offset, log_id)
+
+
+class TestFollowerReadLag:
+    def test_follower_reads_trail_commit_by_one_ack_round_trip(self):
+        """Follower GetValues serves LOCAL applied state — no
+        read-index round-trip — so a committed write is invisible
+        there until the next leader contact advertises the commit;
+        oim_registry_read_lag_records counts that gap. Gate the
+        follower's apply step to hold the window open (records still
+        arrive and ack, so the leader's majority math is untouched),
+        observe the stale read and the non-zero lag, then release and
+        watch it drain to zero."""
+        with Cluster() as c:
+            li = c.await_leader()
+            fi = (li + 1) % 3
+            follower = c.managers[fi]
+            real_flush = follower._flush_pending
+            follower._flush_pending = lambda: None
+            try:
+                c.stubs[li].SetValue(pb.SetValueRequest(value=pb.Value(
+                    path="q/lag", value="v", lease_seconds=60)),
+                    timeout=10)
+                # Committed (SetValue returned): the leader serves it...
+                assert c.services[li].db.get("q/lag") == "v"
+                # ...while the gated follower's GetValues misses it.
+                got = {v.path for v in c.stubs[fi].GetValues(
+                    pb.GetValuesRequest(path="q"), timeout=5).values}
+                assert "q/lag" not in got, \
+                    "follower applied through the gate?"
+
+                def lag():
+                    with follower._lock:
+                        return follower._read_lag_locked()
+
+                assert wait_for(lambda: lag() > 0), \
+                    "read-lag never surfaced the held-open gap"
+            finally:
+                follower._flush_pending = real_flush
+            assert wait_for(
+                lambda: c.services[fi].db.get("q/lag") == "v"), \
+                "released follower never applied the committed write"
+            assert wait_for(lambda: lag() == 0), \
+                "read-lag never drained after release"
+
+
 class TestStepDown:
     def test_leader_without_majority_steps_down_and_in_flight_fails(self):
         with Cluster(commit_timeout_s=5.0) as c:

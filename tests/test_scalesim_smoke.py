@@ -1,0 +1,38 @@
+"""Tier-1 wiring of `make scalesim-smoke`: the control-plane scale
+bench's smoke point — ONE in-process quorum registry (3 members)
+carrying 50 LiteReplica rows (real registration/heartbeat/telemetry/
+Watch clients, decode stubbed) with 8 Watch consumers attached — runs
+inside the normal (non-slow) test pass and gates the control plane's
+scale behavior: the leader is killed and a quorum write must converge
+within the smoke deadline, NO Watch consumer may be shed, and every
+knee-curve column (fan-out p99, commit p99, pick p99, incremental-fold
+speedup, convergence) must be present and non-degenerate
+(bench.control_plane_scale_bench(smoke=True) itself raises on any
+violation). The full 10/100/1000 curve runs under
+`make control-plane-bench`."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def test_scalesim_smoke_knees_gate():
+    import bench
+
+    extras = bench.control_plane_scale_bench(smoke=True)
+    points = extras["scale_points"]
+    assert [p["lite_replicas"] for p in points] == [50]
+    point = points[0]
+    # The gates the bench already enforced, restated so a silently
+    # weakened bench cannot pass tier-1.
+    assert point["leader_kill_convergence_s"] < 15.0
+    assert extras["watch_shed_streams"] == 0
+    for column in ("watch_fanout_p99_ms", "commit_p99_ms",
+                   "pick_p99_us", "merge_incremental_x",
+                   "leader_kill_convergence_s"):
+        assert point[column] is not None, f"column {column} degenerate"
+    # 8 consumers attached and every one of them survived the bursts.
+    assert point["watch_streams"] == 8
+    # The paired serialize-once comparison ran at the smoke point too.
+    assert extras["serialize_once_x"] > 0

@@ -455,3 +455,60 @@ class TestTableWatchMode:
             table.stop()
         finally:
             server.force_stop()
+
+
+class TestSerializeOnceFanout:
+    """The hub's write-path contract at scale: one committed delta is
+    serialized ONCE and every attached stream's frame is the same bytes
+    object (bench.py --control-plane pairs the two modes; this pins the
+    mechanism)."""
+
+    def _hub(self, **kwargs):
+        return W.WatchHub(service=None, **kwargs)
+
+    def test_fanout_shares_one_wire_frame(self):
+        hub = self._hub()
+        streams = [W._Stream(["serve"], maxsize=8) for _ in range(3)]
+        hub._streams.extend(streams)
+        hub.publish_kv("serve/r0", "v0", 5.0)
+        deltas = [s.queue.get_nowait() for s in streams]
+        assert deltas[0] is deltas[1] is deltas[2], \
+            "streams queued distinct delta copies"
+        wire = deltas[0].wire
+        assert wire is not None, "fan-out did not eager-serialize"
+        assert wire == hub._proto(deltas[0]).SerializeToString(), \
+            "cached frame diverges from a fresh serialization"
+        # Delivery serves the SAME bytes object — no re-serialization.
+        assert hub._wire(deltas[0]) is wire
+
+    def test_no_matching_stream_skips_serialization(self):
+        """A delta no attached stream wants stays unserialized until a
+        resuming watcher actually replays it from the ring."""
+        hub = self._hub()
+        hub._streams.append(W._Stream(["serve"], maxsize=8))
+        hub.publish_kv("other/x", "v", 5.0)
+        assert hub._ring[-1].wire is None
+
+    def test_shed_lands_flight_recorder_event_with_high_water(self):
+        """A shed must be diagnosable at scale: the stream dies, the
+        counter moves, and a watch_stream_shed event records WHICH
+        prefix and how deep the queue ran."""
+        from oim_tpu.common import events as E
+        from oim_tpu.common import metrics as M
+
+        hub = self._hub(queue_max=2)
+        stream = W._Stream(["serve"], maxsize=2)
+        hub._streams.append(stream)
+        rec = E.recorder()
+        shed_before = len(rec.events(type_=E.WATCH_STREAM_SHED))
+        metric_before = M.WATCH_SHED_STREAMS.value
+        for i in range(3):
+            hub.publish_kv(f"serve/r{i}", "v", 5.0)
+        assert stream.dead.is_set(), "overflowed stream not shed"
+        assert M.WATCH_SHED_STREAMS.value == metric_before + 1
+        shed = rec.events(type_=E.WATCH_STREAM_SHED)
+        assert len(shed) == shed_before + 1
+        attrs = shed[-1].attrs
+        assert attrs["prefix"] == "serve"
+        assert attrs["queue_high_water"] == 2
+        assert attrs["queue_max"] == 2
