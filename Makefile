@@ -9,7 +9,7 @@
 PY ?= python
 RUFF := $(shell command -v ruff 2>/dev/null)
 
-.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke slo-smoke autoscale-smoke prefix-smoke paged-smoke spec-smoke kvtier-smoke chaos chaos-smoke quorum-smoke control-plane-bench scalesim-smoke
+.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke slo-smoke autoscale-smoke prefix-smoke paged-smoke spec-smoke kvtier-smoke shard-smoke chaos chaos-smoke quorum-smoke control-plane-bench scalesim-smoke
 
 # drift and tsan are standalone conveniences; the full pytest target
 # already runs both (SpecDrift + the TSAN stream test build in-fixture).
@@ -99,6 +99,19 @@ paged-smoke:
 # tests/test_spec_smoke.py.
 spec-smoke:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --serve --smoke --spec-tokens 4
+
+# Sharded-decode acceptance loop (seconds): ONE logical replica spans
+# 2 tensor-parallel members over a CPU mesh of fake XLA devices.
+# Gates: every rank's restore stages ONLY its slice of the one
+# published weights volume; a model whose weights+pool exceed one
+# member's HBM budget is REFUSED at shard=1 ("shard wider") and serves
+# byte-identically at shard=2; routed requests byte-identical to solo
+# generate() through a real router; SIGKILLing a non-rank-0 member's
+# lease flips the replica not-ready; zero-leak census on every member
+# pool; the ICI-allreduce histogram gains samples. Also runs in tier-1
+# as tests/test_shard_smoke.py.
+shard-smoke:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --serve --smoke --shard 2
 
 # KV-tiering + fleet-prefix-sharing acceptance loop (seconds): replica
 # A exports a finished 28-block prefix chain as a content-addressed

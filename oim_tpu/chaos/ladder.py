@@ -50,6 +50,12 @@ kv_peer_fetch       prefix-holder + controller         peer adoption
                                                        recompute; byte-
                                                        identity; both
                                                        tiers census 0
+shard_member_kill   SIGKILL a non-rank-0 member of     lease lapse flips
+                    a 2-way sharded replica            the replica not-
+                    mid-stream                         ready; router
+                                                       rotates to the
+                                                       survivor; restage
+                                                       = cache hit
 autoscale           latency SLO fires under load;      alert -> scale-up;
                     leader autoscaler killed           standby takeover
                     mid-episode                        by lease; resolve
@@ -785,6 +791,77 @@ def _run_autoscale(sim: ClusterSim, rng: random.Random) -> dict:
             "takeover_by": takeovers[0]["attrs"]["autoscaler"]}
 
 
+def _run_shard_member_kill(sim: ClusterSim, rng: random.Random) -> dict:
+    """SIGKILL one non-rank-0 member of the 2-way sharded replica r0
+    mid-stream: its ``serve/r0.member.1`` lease outlives the corpse, and
+    the LAPSE (not the kill) flips the whole replica not-ready — a mesh
+    missing a member cannot decode — so the router rotates every
+    subsequent pick onto the solo survivor r1 with zero client-visible
+    errors and byte-identical outputs. Heal is drain + re-prestage: the
+    rebooted member re-maps its slice of the SAME content-addressed
+    weights volume (an O(1) stage-cache HIT, zero source re-reads),
+    restores only its 1/N of the split leaves, re-takes its lease, and
+    the replica returns to the table."""
+    from oim_tpu.serve import weights as W
+
+    sim.warm()
+    r0, r1 = sim.replicas
+    assert r0.engine.shard == 2, "rung misconfigured: r0 not sharded"
+    assert r0.engine.stats()["ready"], "sharded replica booted not-ready"
+    # The fleet's original weights prestage (what every booting member
+    # maps before slicing out its rank's tree).
+    params, _ = model()
+    path = sim.tmpfile(W.pack_params(params))
+    feeder = sim.feeder()
+    W.publish_weights(feeder, "shard-weights", path)
+    reqs = _reqs(rng, 5)
+    results, errors = sim.routed_load(reqs[:2])
+    assert not errors, f"warm load failed: {errors[0]!r}"
+    mark = sim.mark_faults()
+    r0.kill_member(1)
+    assert wait_for(lambda: not r0.engine.stats()["ready"], timeout=10), \
+        "member lease lapse never flipped the replica not-ready"
+    assert wait_for(
+        lambda: all(r.replica_id != "r0" for r in sim.table.replicas()),
+        timeout=10), "not-ready sharded replica never left the table"
+    done_r0 = r0.completed()
+    results, errors = sim.routed_load(reqs)
+    assert not errors, \
+        f"client saw errors across the member kill: {errors[0]!r}"
+    checked = sim.assert_byte_identity(reqs, results)
+    assert r0.completed() == done_r0, \
+        "router sent traffic to the degraded sharded replica"
+    assert r1.completed() >= len(reqs), \
+        "survivor never absorbed the rotated stream"
+    # Heal: the member's re-prestage of identical content must be the
+    # O(1) cache path — proven by the hit counter, not wall clock —
+    # and its restore stages ONLY its slice (split leaves cut 1/N).
+    hits_before = M.STAGE_CACHE_HITS.value
+    feeder.unpublish("shard-weights")
+    W.publish_weights(feeder, "shard-weights", path)
+    assert M.STAGE_CACHE_HITS.value == hits_before + 1, \
+        "member re-prestage was not a stage-cache hit"
+    W.restore_weights(feeder, "shard-weights", shard=2, rank=1)
+    staged = W.LAST_RESTORE["bytes_staged"]
+    assert 0 < staged < W.LAST_RESTORE["total_bytes"], \
+        f"member restore staged {staged} of {W.LAST_RESTORE} — not a slice"
+    r0.restart_member(1)
+    assert wait_for(lambda: r0.engine.stats()["ready"], timeout=10), \
+        "restarted member never healed readiness"
+    assert wait_for(
+        lambda: any(r.replica_id == "r0" for r in sim.table.replicas()),
+        timeout=10), "healed sharded replica never rejoined the table"
+    post = _reqs(rng, 2)
+    results, errors = sim.routed_load(post)
+    assert not errors, f"post-heal load failed: {errors[0]!r}"
+    checked += sim.assert_byte_identity(post, results)
+    sim.wait_heal(
+        [events.SHARD_MEMBER_LOST, events.SHARD_MEMBER_HEALED], mark)
+    return {"requests": len(reqs) + len(post), "byte_identical": checked,
+            "restage_cache_hit": True, "member_slice_bytes": staged,
+            "full_weights_bytes": W.LAST_RESTORE["total_bytes"]}
+
+
 @dataclasses.dataclass(frozen=True)
 class Rung:
     """One scripted fault schedule: its sim shape, its seeded driver,
@@ -848,6 +925,11 @@ RUNGS: tuple[Rung, ...] = (
          dict(replicas=2, controllers=1,
               engine_kwargs=[dict(kv_host_bytes=1 << 20),
                              dict(kv_host_bytes=1 << 20)])),
+    Rung("shard_member_kill",
+         (events.SHARD_MEMBER_LOST, events.SHARD_MEMBER_HEALED),
+         _run_shard_member_kill,
+         dict(replicas=2, controllers=1,
+              engine_kwargs=[dict(shard=2), dict()])),
     Rung("autoscale",
          (events.SLO_ALERT_FIRED, events.AUTOSCALE_SCALE_UP,
           events.AUTOSCALE_TAKEOVER, events.SLO_ALERT_RESOLVED,
@@ -868,7 +950,7 @@ RUNGS: tuple[Rung, ...] = (
 # restart over 3 registries only; the full leader-kill-under-load rung
 # runs in `make chaos`).
 SMOKE_RUNGS = ("replica_kill", "channel_blackhole", "pool_exhaustion",
-               "kv_peer_fetch", "quorum_partition",
+               "kv_peer_fetch", "shard_member_kill", "quorum_partition",
                "registry_rolling_restart")
 
 

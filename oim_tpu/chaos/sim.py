@@ -124,6 +124,7 @@ class ReplicaHandle:
         self.server = None
         self.service = None
         self.registration = None
+        self.members = None
         self.alive = False
 
     def boot(self, endpoint: str = "tcp://127.0.0.1:0") -> None:
@@ -133,6 +134,7 @@ class ReplicaHandle:
             ServeService,
         )
         from oim_tpu.serve.service import serve_server
+        from oim_tpu.serve.shard import ShardMembers
 
         kwargs = dict(self.engine_kwargs)
         if kwargs.pop("_draft", False):
@@ -141,6 +143,14 @@ class ReplicaHandle:
             kwargs.setdefault("draft_cfg", dcfg)
         params, cfg = model()
         self.engine = ServeEngine(params, cfg, name=self.rid, **kwargs)
+        if self.engine.shard > 1:
+            # Member leases BEFORE the serve row's first beat: the row's
+            # ready field folds in member_counts(), and registering
+            # not-ready would make the router skip a healthy boot.
+            self.members = ShardMembers(
+                self.rid, self.engine.shard, self.sim.registry_address,
+                interval=self.sim.heartbeat_s, pool=self.sim.pool).start()
+            self.engine.set_member_watch(self.members.member_counts)
         self.service = ServeService(self.engine)
         self.server = serve_server(endpoint, self.service)
         self.registration = ServeRegistration(
@@ -162,6 +172,8 @@ class ReplicaHandle:
         REPLICA_DRAIN would pollute the heal signatures the ladder
         asserts first-occurrence order on."""
         self.registration.stop(deregister=False)
+        if self.members is not None:
+            self.members.stop(deregister=False)
         self.server.force_stop()
         self.engine.stop(drain=False, timeout=30, quiet=True)
         self.alive = False
@@ -173,6 +185,8 @@ class ReplicaHandle:
         self.registration.announce_draining()
         self.engine.stop(drain=True, timeout=60)
         self.registration.stop(deregister=True)
+        if self.members is not None:
+            self.members.stop(deregister=True)
         self.server.stop(grace=5.0)
         self.alive = False
 
@@ -190,6 +204,20 @@ class ReplicaHandle:
 
         addr = self.server.addr
         self.server = serve_server(f"tcp://{addr}", self.service)
+
+    def kill_member(self, rank: int) -> None:
+        """SIGKILL one non-rank-0 member of a sharded replica: its
+        ``serve/<id>.member.<k>`` heartbeats stop mid-lease, nothing
+        deregisters, and when the TTL lapses the engine's stats() flips
+        the WHOLE replica not-ready (a mesh missing a member cannot
+        decode) — the shard_member_kill rung's fault lever."""
+        self.members.stop_member(rank)
+
+    def restart_member(self, rank: int) -> None:
+        """The killed member rebooted and re-staged its weight slice (a
+        stage-cache hit — same content-addressed volume): a fresh
+        publisher re-takes its lease and readiness heals."""
+        self.members.restart_member(rank)
 
     def restart(self, endpoint: str | None = None) -> None:
         """A fresh replica process at the same id (new engine, empty

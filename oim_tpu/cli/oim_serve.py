@@ -356,6 +356,21 @@ def main(argv: list[str] | None = None) -> int:
              "0 = the target pool's token count; the draft's pages are "
              "smaller in bytes). A request whose draft pages can't be "
              "mapped decodes plainly instead of waiting")
+    parser.add_argument(
+        "--shard", type=int, default=1,
+        help="tensor-parallel width: ONE logical replica spans this "
+             "many member devices over ICI (attention heads and MLP "
+             "columns split Megatron-style, one allreduce per layer; "
+             "greedy output stays byte-identical to --shard 1). With "
+             "--serve-id, each member holds its own TTL lease under "
+             "serve/<id>.member.<k>; a lapsed member flips the replica "
+             "not-ready so routers rotate away")
+    parser.add_argument(
+        "--member-hbm-budget", type=int, default=0,
+        help="per-member HBM byte budget: refuse to boot (with the "
+             "shard width that WOULD fit) when one member's weight "
+             "slice + KV pool slice exceeds it — a deterministic "
+             "admission gate, not an OOM. 0 disables the check")
     parser.add_argument("--stream-tokens", type=int, default=1,
                         help="token-stream granularity: the first token "
                              "flushes immediately, later deltas batch up "
@@ -451,6 +466,8 @@ def main(argv: list[str] | None = None) -> int:
         draft_cfg=draft_mcfg,
         spec_tokens=args.spec_tokens,
         spec_pool_tokens=args.spec_pool_tokens,
+        shard=args.shard,
+        member_hbm_budget=args.member_hbm_budget,
     )
     server = serve_server(
         args.endpoint,
@@ -462,6 +479,7 @@ def main(argv: list[str] | None = None) -> int:
     )
 
     registration = None
+    members = None
     if args.serve_id:
         from oim_tpu.serve import ServeRegistration
 
@@ -475,6 +493,20 @@ def main(argv: list[str] | None = None) -> int:
                 f"--serve-id would advertise the wildcard address "
                 f"{advertise!r}; pass --advertise host:port with the "
                 f"address routers should dial")
+        if args.shard > 1:
+            # Member leases BEFORE the serve row's first beat, so the
+            # row registers ready (the row's readiness folds in the
+            # member census; a row published first would flap
+            # not-ready -> ready on its opening beats).
+            from oim_tpu.serve.shard import ShardMembers
+
+            members = ShardMembers(
+                args.serve_id, args.shard, args.registry,
+                interval=args.heartbeat, tls=load_tls_flags(args))
+            members.start()
+            engine.set_member_watch(members.member_counts)
+            log.info("member leases registered", shard=args.shard,
+                     serve_id=args.serve_id)
         registration = ServeRegistration(
             args.serve_id, advertise, engine,
             args.registry, interval=args.heartbeat,
@@ -534,6 +566,8 @@ def main(argv: list[str] | None = None) -> int:
     engine.stop(drain=True, timeout=args.drain_timeout)
     if registration is not None:
         registration.stop(deregister=True)
+    if members is not None:
+        members.stop(deregister=True)
     server.stop()
     obs.stop()
     return 0

@@ -77,6 +77,16 @@ def serve_health_rows(stub: RegistryStub) -> list[tuple[str, str, str, str]]:
         if not isinstance(snap, dict):
             snap = {}
         status = "ALIVE" if value.path in live else "STALE"
+        if "member" in snap:
+            # A sharded replica's member lease (serve/<id>.member.<k>):
+            # a liveness beacon, not a routing target — no endpoint, no
+            # load snapshot. STALE here is exactly the signal that
+            # flips the owning replica not-ready.
+            load = (f"member={snap.get('member', '?')}/"
+                    f"{snap.get('shard', '?')} "
+                    f"state={snap.get('state', '?')}")
+            rows.append((value.path, status, "-", load))
+            continue
         load = (f"free={snap.get('free_slots', '?')}/"
                 f"{snap.get('max_batch', '?')} "
                 f"queue={snap.get('queue_depth', '?')} "
@@ -376,7 +386,7 @@ def top_row(row_id: str, status: str, role: str, target: str,
     row = {"id": row_id, "status": status, "role": role, "qps": None,
            "ft_ms": (None, None), "it_ms": (None, None), "queue": None,
            "slots": None, "cache_hit": None, "prefix_hit": None,
-           "pages": None, "kvtier": None, "accept": None,
+           "pages": None, "kvtier": None, "accept": None, "shard": None,
            "repl_lag": None, "commit_ms": (None, None),
            "pick_ms": (None, None), "spread": None, "events": {}}
     if status != "ALIVE" or not target:
@@ -451,6 +461,19 @@ def top_row(row_id: str, status: str, role: str, target: str,
             # needs to show instead of the healthy lifetime ratio.
             row["accept"] = rolling if rolling is not None \
                 else sacc / sprop
+        # Tensor-parallel member census: ready/total where total folds
+        # in stale (lease-lapsed) members — "1/2" IS the degraded-but-
+        # routed-away signal the rung pins. Dash for solo replicas
+        # (both gauges 0: the engine never armed a member watch) and
+        # for pre-shard scrapes lacking the series entirely — the
+        # PAGES/KV-TIER mixed-version stance.
+        sready = _series_value(
+            samples, "oim_serve_shard_members", {"state": "ready"})
+        sstale = _series_value(
+            samples, "oim_serve_shard_members", {"state": "stale"})
+        if sready is not None and sstale is not None \
+                and sready + sstale > 0:
+            row["shard"] = (sready, sready + sstale)
     hits = _series_value(samples, "oim_stage_cache_hits_total")
     misses = _series_value(samples, "oim_stage_cache_misses_total")
     if hits is not None and misses is not None and hits + misses > 0:
@@ -527,7 +550,7 @@ def _empty_fleet_row() -> dict:
     return {"id": "ALL", "status": "-", "role": "fleet", "qps": None,
             "ft_ms": (None, None), "it_ms": (None, None), "queue": None,
             "slots": None, "cache_hit": None, "prefix_hit": None,
-            "pages": None, "kvtier": None, "accept": None,
+            "pages": None, "kvtier": None, "accept": None, "shard": None,
             "repl_lag": None, "commit_ms": (None, None),
             "pick_ms": (None, None), "spread": None, "events": {}}
 
@@ -620,9 +643,10 @@ def render_top(rows: list[dict]) -> str:
         return f"{cell}+{peer:g}" if peer else cell
 
     headers = ("ID", "ROLE", "STATUS", "QPS", "FIRST-TOK(ms)",
-               "INTER-TOK(ms)", "QUEUE", "SLOTS", "PAGES", "KV-TIER",
-               "ACCEPT", "CACHE-HIT", "PREFIX-HIT", "REPL-LAG",
-               "COMMIT(ms)", "PICK(ms)", "SPREAD", "EVENTS")
+               "INTER-TOK(ms)", "QUEUE", "SLOTS", "SHARD", "PAGES",
+               "KV-TIER", "ACCEPT", "CACHE-HIT", "PREFIX-HIT",
+               "REPL-LAG", "COMMIT(ms)", "PICK(ms)", "SPREAD",
+               "EVENTS")
     table = [headers]
     for r in rows:
         top_events = sorted(r["events"].items(),
@@ -631,6 +655,7 @@ def render_top(rows: list[dict]) -> str:
             r["id"], r["role"], r["status"], fmt(r["qps"]),
             fmt_pair(r["ft_ms"]), fmt_pair(r["it_ms"]),
             fmt(r["queue"], "{:g}"), fmt(r["slots"]),
+            fmt_pages(r.get("shard")),
             fmt_pages(r.get("pages")),
             fmt_kvtier(r.get("kvtier")),
             fmt(r.get("accept"), "{:.0%}"),

@@ -102,6 +102,14 @@ AUTOSCALE_SCALE_UP = "autoscale_scale_up"
 AUTOSCALE_SCALE_DOWN = "autoscale_scale_down"
 AUTOSCALE_UPGRADE_FLIP = "autoscale_upgrade_flip"
 AUTOSCALE_TAKEOVER = "autoscale_takeover"
+# Tensor-parallel serving (serve/shard.py): a sharded replica observed a
+# member's TTL lease lapse (its stats() flips the whole replica
+# not-ready — a mesh missing one member cannot decode) / observed every
+# member lease live again after drain + re-prestage. One event per
+# TRANSITION, not per heartbeat, so a chaos rung can assert the exact
+# lost -> healed pair.
+SHARD_MEMBER_LOST = "shard_member_lost"
+SHARD_MEMBER_HEALED = "shard_member_healed"
 
 DEFAULT_CAPACITY = 2048
 

@@ -31,6 +31,50 @@ from oim_tpu.ops.norms import rmsnorm
 from oim_tpu.ops.rope import apply_rope, rope_frequencies
 
 
+def _reduce(x, axis: str | None):
+    """Sum a partial projection product over the tensor-parallel mesh
+    axis (no-op when unsharded). The ONLY point activations cross ICI
+    in the sharded decode path: with wq/wk/wv column-split and
+    wo/w_down row-split, every other tensor in a layer is either fully
+    local (per-head attention, gated MLP halves) or replicated (the
+    residual stream), so one psum after the attention-out projection
+    and one after the FFN-down projection reassemble the exact sums
+    the unsharded matmuls compute — same terms, reassociated — which
+    is why greedy decode stays token-identical under sharding (see
+    doc/architecture.md "Sharded decode")."""
+    if axis is None:
+        return x
+    from oim_tpu.parallel.collectives import psum
+
+    return psum(x, axis)
+
+
+def shard_config(cfg: Config, n: int) -> Config:
+    """The PER-MEMBER view of ``cfg`` on an ``n``-way tensor-parallel
+    mesh: 1/n of the query and KV heads (the GQA group size g =
+    n_heads/n_kv_heads is preserved, so contiguous head slices keep
+    every query head aligned with its own KV head). The returned cfg is
+    what the shard_map BODY runs with — reshapes inside
+    ``decode_step``/``prefill_into_pages``/``verify_step`` must match
+    the member-local array slices, not the global shapes."""
+    import dataclasses
+
+    if n < 1:
+        raise ValueError(f"shard count must be >= 1, got {n}")
+    if n == 1:
+        return cfg
+    if cfg.n_experts:
+        raise ValueError(
+            "tensor-parallel decode does not support MoE configs yet "
+            f"(n_experts={cfg.n_experts})")
+    if cfg.n_heads % n or cfg.n_kv_heads % n:
+        raise ValueError(
+            f"shard count {n} must divide n_heads ({cfg.n_heads}) and "
+            f"n_kv_heads ({cfg.n_kv_heads})")
+    return dataclasses.replace(
+        cfg, n_heads=cfg.n_heads // n, n_kv_heads=cfg.n_kv_heads // n)
+
+
 def _no_drop(cfg: Config) -> Config:
     """MoE inference must not drop tokens: training groups tokens per call
     and caps expert capacity, but a decode step has so few tokens that the
@@ -84,11 +128,16 @@ def _cache_attention(q, ck, cv, pos, cfg: Config):
     return out.reshape(B, T, H, hd).astype(q.dtype)
 
 
-def cached_forward(params, tokens, cache, pos, cfg: Config):
+def cached_forward(params, tokens, cache, pos, cfg: Config,
+                   axis: str | None = None):
     """Forward ``tokens`` [B,T] occupying absolute positions pos..pos+T-1.
 
     Returns (logits [B,T,vocab] f32, updated cache). Serves both prefill
-    (T = prompt length, pos = 0) and decode (T = 1).
+    (T = prompt length, pos = 0) and decode (T = 1). Under ``axis`` the
+    body runs inside a shard_map over that tensor-parallel mesh axis:
+    ``cfg`` must be the member-local view (:func:`shard_config`) and
+    params/cache the member-local slices — two psums per layer
+    reassemble the projections (see :func:`_reduce`).
     """
     B, T = tokens.shape
     S = cache["k"].shape[2]
@@ -113,10 +162,10 @@ def cached_forward(params, tokens, cache, pos, cfg: Config):
         ck = lax.dynamic_update_slice_in_dim(ck, k, pos, axis=1)
         cv = lax.dynamic_update_slice_in_dim(cv, v, pos, axis=1)
         attn = _cache_attention(q, ck, cv, pos, cfg)
-        x = x + attn.reshape(B, T, cfg.q_dim) @ layer["wo"]
+        x = x + _reduce(attn.reshape(B, T, cfg.q_dim) @ layer["wo"], axis)
         h = rmsnorm(x, layer["mlp_norm"])
         ffn, _ = _ffn(h, layer, cfg)
-        return x + ffn, (ck, cv)
+        return x + _reduce(ffn, axis), (ck, cv)
 
     x, (ck, cv) = lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
     x = rmsnorm(x, params["final_norm"])
@@ -161,7 +210,8 @@ def init_page_pool(cfg: Config, n_pages: int, page_tokens: int):
 
 
 def prefill_into_pages(params, tokens, n_tokens, pool, page_table,
-                       start, cfg: Config, page_tokens: int):
+                       start, cfg: Config, page_tokens: int,
+                       axis: str | None = None):
     """Prefill ``tokens`` [1, T] (first ``n_tokens`` real, rest pad — the
     engine buckets prompt lengths so one compiled program serves many)
     through the slot's ``page_table`` [n_blocks] into the page pool,
@@ -219,10 +269,10 @@ def prefill_into_pages(params, tokens, n_tokens, pool, page_table,
         ck = pk[page_table].reshape(1, S, cfg.n_kv_heads, cfg.head_dim)
         cv = pv[page_table].reshape(1, S, cfg.n_kv_heads, cfg.head_dim)
         attn = _cache_attention(q, ck, cv, start, cfg)
-        x = x + attn.reshape(B, T, cfg.q_dim) @ layer["wo"]
+        x = x + _reduce(attn.reshape(B, T, cfg.q_dim) @ layer["wo"], axis)
         h = rmsnorm(x, layer["mlp_norm"])
         ffn, _ = _ffn(h, layer, cfg)
-        return x + ffn, (pk, pv)
+        return x + _reduce(ffn, axis), (pk, pv)
 
     x, (pk, pv) = lax.scan(body, x, (params["layers"], pool["k"], pool["v"]))
     x = rmsnorm(x, params["final_norm"])
@@ -233,7 +283,7 @@ def prefill_into_pages(params, tokens, n_tokens, pool, page_table,
 
 
 def decode_step(params, tokens, pool, page_tables, pos, cfg: Config,
-                page_tokens: int):
+                page_tokens: int, axis: str | None = None):
     """One lockstep decode step over the whole slot batch: ``tokens`` [B]
     int32 (each slot's previous token) at absolute positions ``pos`` [B],
     written and attended through ``page_tables`` [B, n_blocks]. Returns
@@ -280,10 +330,10 @@ def decode_step(params, tokens, pool, page_tables, pos, cfg: Config,
         ck = pk[page_tables].reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
         cv = pv[page_tables].reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
         attn = _cache_attention(q, ck, cv, pos, cfg)
-        x = x + attn.reshape(B, 1, cfg.q_dim) @ layer["wo"]
+        x = x + _reduce(attn.reshape(B, 1, cfg.q_dim) @ layer["wo"], axis)
         h = rmsnorm(x, layer["mlp_norm"])
         ffn, _ = _ffn(h, layer, cfg)
-        return x + ffn, (pk, pv)
+        return x + _reduce(ffn, axis), (pk, pv)
 
     x, (pk, pv) = lax.scan(body, x, (params["layers"], pool["k"], pool["v"]))
     x = rmsnorm(x, params["final_norm"])
@@ -292,7 +342,7 @@ def decode_step(params, tokens, pool, page_tables, pos, cfg: Config,
 
 
 def verify_step(params, tokens, pool, page_tables, pos, cfg: Config,
-                page_tokens: int):
+                page_tokens: int, axis: str | None = None):
     """The multi-token sibling of ``decode_step``: forward ``tokens``
     [B, T] (each row's previous token followed by T-1 speculated
     candidates) at absolute positions pos..pos+T-1 (``pos`` [B]),
@@ -348,10 +398,10 @@ def verify_step(params, tokens, pool, page_tables, pos, cfg: Config,
         ck = pk[page_tables].reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
         cv = pv[page_tables].reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
         attn = _cache_attention(q, ck, cv, pos, cfg)
-        x = x + attn.reshape(B, T, cfg.q_dim) @ layer["wo"]
+        x = x + _reduce(attn.reshape(B, T, cfg.q_dim) @ layer["wo"], axis)
         h = rmsnorm(x, layer["mlp_norm"])
         ffn, _ = _ffn(h, layer, cfg)
-        return x + ffn, (pk, pv)
+        return x + _reduce(ffn, axis), (pk, pv)
 
     x, (pk, pv) = lax.scan(body, x, (params["layers"], pool["k"], pool["v"]))
     x = rmsnorm(x, params["final_norm"])

@@ -183,7 +183,8 @@ class GenHandle:
 
 
 @functools.lru_cache(maxsize=64)
-def _target_programs(cfg: Config, page: int, max_seq: int):
+def _target_programs(cfg: Config, page: int, max_seq: int,
+                     shard: int = 1):
     """The engine's two jitted target programs — one lockstep decode
     step, one bucketed prefill — built ONCE per geometry and shared by
     every ServeEngine in the process. jit caches on the function
@@ -200,15 +201,38 @@ def _target_programs(cfg: Config, page: int, max_seq: int):
     cached depth, while the page table already references the store's
     pages. The page-table operand has ONE fixed shape, so there is no
     (tail x prefix) bucket product. The RNG chain matches solo
-    generate(): one split after prefill, one per decode step."""
+    generate(): one split after prefill, one per decode step.
+
+    ``shard > 1`` runs the SAME programs tensor-parallel: the forward
+    bodies move under a shard_map over the ``tp`` mesh (serve/shard.py)
+    with the member-local cfg, while sampling stays outside on the
+    replicated logits — so the RNG chain, the bucketing and the
+    donation discipline are untouched and greedy output stays
+    byte-identical to shard=1."""
     import jax
     import jax.numpy as jnp
 
     from oim_tpu.models import generate as gen
 
+    if shard > 1:
+        from oim_tpu.serve import shard as shardlib
+
+        lcfg = gen.shard_config(cfg, shard)
+        _decode = shardlib.wrap_forward(
+            shard, lambda p, t, c, tb, ps: gen.decode_step(
+                p, t, c, tb, ps, lcfg, page, axis="tp"), cache_arg=1)
+        _prefill_fwd = shardlib.wrap_forward(
+            shard, lambda p, t, n, c, tb, st: gen.prefill_into_pages(
+                p, t, n, c, tb, st, lcfg, page, axis="tp"), cache_arg=2)
+    else:
+        def _decode(p, t, c, tb, ps):
+            return gen.decode_step(p, t, c, tb, ps, cfg, page)
+
+        def _prefill_fwd(p, t, n, c, tb, st):
+            return gen.prefill_into_pages(p, t, n, c, tb, st, cfg, page)
+
     def step(params, cache, tokens, pos, keys, temps, tables):
-        logits, cache = gen.decode_step(
-            params, tokens, cache, tables, pos, cfg, page)
+        logits, cache = _decode(params, tokens, cache, tables, pos)
         split = jax.vmap(jax.random.split)(keys)  # [B, 2, key]
         carry, subs = split[:, 0], split[:, 1]
         # Sampling matches generate() bit-for-bit per row: each slot
@@ -235,8 +259,8 @@ def _target_programs(cfg: Config, page: int, max_seq: int):
 
     def prefill(params, cache, tokens, n_tokens, table, start, key,
                 temp):
-        last, cache = gen.prefill_into_pages(
-            params, tokens, n_tokens, cache, table, start, cfg, page)
+        last, cache = _prefill_fwd(
+            params, tokens, n_tokens, cache, table, start)
         carry, sub = jax.random.split(key)
         safe = jnp.where(temp > 0, temp, 1.0)
         sampled = jax.random.categorical(sub, (last / safe)[None, :])[0]
@@ -250,15 +274,32 @@ def _target_programs(cfg: Config, page: int, max_seq: int):
 
 @functools.lru_cache(maxsize=64)
 def _spec_programs(cfg: Config, dcfg: Config, page: int, max_seq: int,
-                   K: int):
+                   K: int, shard: int = 1):
     """The three speculative-decoding programs — draft prefill, the
     scanned K+1-step draft propose, and the fused verify+accept —
     built once per (target cfg, draft cfg, geometry, K) and shared
-    across engines exactly like :func:`_target_programs`."""
+    across engines exactly like :func:`_target_programs`.
+
+    Under ``shard > 1`` only the TARGET verify forward moves under the
+    shard_map (the draft is small by construction — replicating it
+    trades a little HBM for zero draft-side ICI traffic); acceptance
+    math runs on the replicated verify logits, so the accept/reject
+    stream is byte-identical to shard=1."""
     import jax
     import jax.numpy as jnp
 
     from oim_tpu.models import generate as gen
+
+    if shard > 1:
+        from oim_tpu.serve import shard as shardlib
+
+        lcfg = gen.shard_config(cfg, shard)
+        _verify_fwd = shardlib.wrap_forward(
+            shard, lambda p, s, c, tb, ps: gen.verify_step(
+                p, s, c, tb, ps, lcfg, page, axis="tp"), cache_arg=1)
+    else:
+        def _verify_fwd(p, s, c, tb, ps):
+            return gen.verify_step(p, s, c, tb, ps, cfg, page)
 
     def draft_prefill(dparams, dcache, tokens, n_tokens, table, start,
                       key):
@@ -317,8 +358,7 @@ def _spec_programs(cfg: Config, dcfg: Config, page: int, max_seq: int,
                draft_toks, draft_logits, spec_mask):
         seq = jnp.concatenate([tokens[:, None], draft_toks],
                               axis=1)  # [B, K+1]
-        logits, cache = gen.verify_step(
-            params_, seq, cache, tables, pos, cfg, page)
+        logits, cache = _verify_fwd(params_, seq, cache, tables, pos)
         out, n_emit, carry = accept_tokens(
             logits, draft_toks, draft_logits, temps, keys, spec_mask)
         rows = jnp.arange(out.shape[0])
@@ -369,6 +409,8 @@ class ServeEngine:
         spec_accept_floor: float = 0.3,
         spec_window_rounds: int = 64,
         spec_reprobe_rounds: int = 256,
+        shard: int = 1,
+        member_hbm_budget: int = 0,
         name: str = "",
     ):
         import jax
@@ -396,6 +438,17 @@ class ServeEngine:
                     f"draft vocab ({draft_cfg.vocab}) must equal the "
                     f"target vocab ({cfg.vocab}): the acceptance ratio "
                     f"test compares distributions over one vocabulary")
+        # Tensor-parallel serving (serve/shard.py): shard > 1 runs this
+        # engine's target programs over a tp mesh of that many member
+        # devices. Validate the geometry NOW — indivisible head counts
+        # and missing devices are config typos, not runtime surprises.
+        self.shard = max(int(shard), 1)
+        self.member_hbm_budget = max(int(member_hbm_budget), 0)
+        if self.shard > 1:
+            from oim_tpu.serve import shard as shardlib
+
+            gen.shard_config(cfg, self.shard)  # head-divisibility check
+            shardlib.tp_mesh(self.shard)       # device-count check
         self._jax, self._jnp = jax, jnp
         # The engine's name in fault-point context (ctx: engine=...): a
         # multi-replica process (bench clusters, the chaos sim) arms a
@@ -442,6 +495,17 @@ class ServeEngine:
                       * cfg.n_kv_heads * cfg.head_dim
                       * np.dtype(cfg.dtype).itemsize)
         self._pagepool = PagePool(n_pages, self.page_tokens, page_bytes)
+        # Per-member HBM budget: a member holds 1/shard of the split
+        # weight leaves, the replicated leaves whole, and 1/shard of
+        # every page (the pool shards with the KV heads). A model that
+        # does not fit is refused HERE, at boot — widening the mesh is
+        # what makes it fit, the "refused at 1, serves at 2" gate.
+        if self.member_hbm_budget:
+            from oim_tpu.serve import shard as shardlib
+
+            shardlib.check_member_budget(
+                params, self.shard, n_pages * page_bytes,
+                self.member_hbm_budget)
         # KV tiering (serve/kvtier.py): with a --kv-host-bytes budget,
         # evicting a store-only prefix page D2H-copies its block into
         # the host-RAM LRU instead of dropping the chain; a later chain
@@ -480,6 +544,27 @@ class ServeEngine:
         # unmapped table entry points at (see init_page_pool).
         self._cache = gen.init_page_pool(
             cfg, n_pages + 1, self.page_tokens)
+        if self.shard > 1:
+            # Commit params and pool to their mesh shardings up front:
+            # each member device holds only its weight slice and its
+            # KV-head slice of every page (the HBM accounting above),
+            # and the step programs' donated cache buffers alias from
+            # the very first dispatch instead of resharding once.
+            from jax.sharding import NamedSharding
+
+            from oim_tpu.serve import shard as shardlib
+
+            mesh = shardlib.tp_mesh(self.shard)
+            self.params = jax.device_put(
+                self.params,
+                jax.tree_util.tree_map_with_path(
+                    lambda p, _: NamedSharding(
+                        mesh, shardlib.leaf_spec(p[-1].key)),
+                    self.params))
+            self._cache = jax.device_put(
+                self._cache,
+                {k: NamedSharding(mesh, s)
+                 for k, s in shardlib.pool_specs().items()})
         page = self.page_tokens
         # Jitted programs are SHARED across engine instances of one
         # geometry (_target_programs / _spec_programs below): jit
@@ -488,7 +573,8 @@ class ServeEngine:
         # in a process — in-process bench replicas and the test suite
         # paid seconds apiece for programs an identical engine had
         # already compiled.
-        self._step, self._prefill = _target_programs(cfg, page, max_seq)
+        self._step, self._prefill = _target_programs(
+            cfg, page, max_seq, self.shard)
 
         # -- speculative decoding (serve/spec.py): draft propose K
         # tokens through its OWN small page pool (K lockstep decode
@@ -521,7 +607,7 @@ class ServeEngine:
                 window_rounds=spec_window_rounds,
                 reprobe_rounds=spec_reprobe_rounds)
             self._draft_prefill, self._propose, self._verify = \
-                _spec_programs(cfg, dcfg, page, max_seq, K)
+                _spec_programs(cfg, dcfg, page, max_seq, K, self.shard)
 
         # Per-slot host state (the scheduler's view; device state is the
         # page pool + whatever the last step returned).
@@ -582,6 +668,12 @@ class ServeEngine:
         # engine's own dispatches — callers enqueue a thunk, the run
         # loop services it between steps (_call_on_engine).
         self._cmds: collections.deque = collections.deque()
+        # Member-lease liveness (sharded replicas): stats() folds the
+        # watch callback's ready count into the published readiness, so
+        # ONE lapsed member lease flips the whole replica not-ready and
+        # routers rotate away (serve/shard.py ShardMembers).
+        self._member_watch = None
+        self._members_ok = True
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._stopping = False
@@ -699,10 +791,20 @@ class ServeEngine:
         with self._lock:
             return len(self._pending)
 
+    def set_member_watch(self, fn) -> None:
+        """Register the member-liveness poll (``ShardMembers.
+        member_counts``) a sharded replica's stats() folds into its
+        published readiness. The callback does a registry RPC, so
+        stats() calls it OUTSIDE the engine lock."""
+        self._member_watch = fn
+
     def stats(self) -> dict:
         """One consistent load snapshot — what a serve replica's registry
         heartbeat publishes and the request router routes on (free decode
         slots first, queued backlog as the tie-break)."""
+        counts = None
+        if self.shard > 1 and self._member_watch is not None:
+            counts = self._member_watch()  # registry RPC: never under lock
         with self._lock:
             active = sum(s is not None for s in self._slots)
             snap = {
@@ -721,6 +823,32 @@ class ServeEngine:
                 "target_steps": self._target_steps,
                 "decode_tokens": self._decode_tokens,
             }
+            if self.shard > 1:
+                # Shard keys ride the heartbeat row only on sharded
+                # replicas (same stance as the spec keys): pre-shard
+                # readers never see them, oimctl dash-degrades. ONE
+                # lapsed member lease flips the whole replica
+                # not-ready — a mesh missing a member cannot decode,
+                # so the router must rotate away NOW, not at first
+                # collective timeout.
+                ready_members = (min(int(counts["ready"]), self.shard)
+                                 if counts else self.shard)
+                members_ok = ready_members >= self.shard
+                snap["shard_total"] = self.shard
+                snap["shard_ready"] = ready_members
+                snap["ready"] = snap["ready"] and members_ok
+                if counts is not None:
+                    M.SERVE_SHARD_MEMBERS.labels(state="ready").set(
+                        counts["ready"])
+                    M.SERVE_SHARD_MEMBERS.labels(state="stale").set(
+                        counts.get("stale", 0))
+                if members_ok != self._members_ok:
+                    events.emit(
+                        events.SHARD_MEMBER_LOST if not members_ok
+                        else events.SHARD_MEMBER_HEALED,
+                        engine=self.name, ready=ready_members,
+                        total=self.shard)
+                    self._members_ok = members_ok
             if self.spec_tokens:
                 proposed, accepted = self._spec_proposed, \
                     self._spec_accepted
@@ -1480,6 +1608,19 @@ class ServeEngine:
                     reprobe_rounds=self._valve.reprobe_rounds)
         self._plain_once()
 
+    def _observe_ici(self, live) -> None:
+        """One ICI-allreduce observation per target dispatch (sharded
+        replicas only): the per-layer collectives are fused inside the
+        jitted step and cannot be host-timed individually, so a tiny
+        compiled psum over the SAME mesh is timed instead — the
+        exemplar carries a live request's trace_id so a slow allreduce
+        links back to the request it stalled."""
+        from oim_tpu.serve import shard as shardlib
+
+        M.SERVE_ICI_ALLREDUCE.observe(
+            shardlib.time_allreduce(self.shard),
+            self._trace_id(live[0][1]) if live else "")
+
     def _spec_once(self) -> None:
         """One speculative round: the draft proposes K tokens per row
         (K fused decode steps over its own page pool), the target
@@ -1523,6 +1664,8 @@ class ServeEngine:
         n_emit = np.asarray(n_emit)
         self._target_steps += 1
         self._spec_rounds += 1
+        if self.shard > 1:
+            self._observe_ici(live)
         proposed = self.spec_tokens * sum(spec_rows)
         accepted = sum(int(n_emit[i]) - 1 for i, _ in live
                        if spec_rows[i])
@@ -1608,6 +1751,8 @@ class ServeEngine:
         self._target_steps += 1
         with self._lock:
             live = [(i, r) for i, r in enumerate(self._slots) if r is not None]
+        if self.shard > 1:
+            self._observe_ici(live)
         for i, req in live:
             if req.cancelled.is_set():
                 self._release_slot(i, req)

@@ -160,14 +160,99 @@ def publish_weights(feeder, volume_id: str, path: str,
     return pub
 
 
-def restore_weights(feeder, volume_id: str, timeout: float = 300.0) -> dict:
+# What the most recent restore_weights() call in this process staged —
+# the sharded-restore accounting tests and bench read (bytes_staged at
+# rank k is the member's HBM weight footprint: split leaves contribute
+# 1/shard of their bytes, replicated leaves their full size).
+LAST_RESTORE: dict = {}
+
+
+def _shard_axis(keystr: str, ndim: int) -> int | None:
+    """The Megatron split axis for one manifest leaf (None =
+    replicated): COL leaves slice their last dim (output features /
+    heads — a contiguous slice keeps each query head with its own GQA
+    KV head), ROW leaves dim 1 (input features, after the stacked
+    layer dim). The sets live in serve/shard.py so the restore and the
+    engine's shard_map specs can never disagree about which leaf
+    splits which way."""
+    from oim_tpu.serve.shard import COL, ROW
+
+    name = re.findall(r"\['([^']+)'\]", keystr)[-1]
+    if name in COL:
+        return ndim - 1
+    if name in ROW:
+        return 1
+    return None
+
+
+def _unpack_shard(data: np.ndarray, shard: int, rank: int) -> dict:
+    """Rank ``rank``'s member-local params tree from packed bytes: each
+    split leaf is materialized as ONLY its 1/shard slice (one compact
+    copy out of the staged volume), replicated leaves stay zero-copy
+    views. Every rank reads the SAME byte-identical manifest — the
+    slice geometry is derived, never negotiated."""
+    if data.dtype != np.uint8:
+        data = data.view(np.uint8)
+    data = data.reshape(-1)
+    if data[:len(_MAGIC)].tobytes() != _MAGIC:
+        raise ValueError("not a packed oim weights blob (bad magic)")
+    (hlen,) = struct.unpack("<Q", data[len(_MAGIC):len(_MAGIC) + 8].tobytes())
+    body = len(_MAGIC) + 8
+    header = json.loads(data[body:body + hlen].tobytes())
+    base = body + hlen
+    tree: dict = {}
+    staged = 0
+    for leaf in header["leaves"]:
+        raw = data[base + leaf["offset"]:base + leaf["offset"] + leaf["bytes"]]
+        arr = raw.view(_leaf_dtype(leaf["dtype"])).reshape(leaf["shape"])
+        axis = _shard_axis(leaf["path"], arr.ndim)
+        if axis is not None:
+            n = arr.shape[axis]
+            if n % shard:
+                raise ValueError(
+                    f"leaf {leaf['path']} dim {axis} ({n}) does not "
+                    f"divide by shard={shard}")
+            width = n // shard
+            idx = [slice(None)] * arr.ndim
+            idx[axis] = slice(rank * width, (rank + 1) * width)
+            arr = np.ascontiguousarray(arr[tuple(idx)])
+        staged += arr.nbytes
+        _insert(tree, leaf["path"], arr)
+    LAST_RESTORE.clear()
+    LAST_RESTORE.update(
+        shard=shard, rank=rank, bytes_staged=staged,
+        total_bytes=int(header["total_bytes"]))
+    return tree
+
+
+def restore_weights(feeder, volume_id: str, timeout: float = 300.0, *,
+                    shard: int = 1, rank: int = 0) -> dict:
     """The params tree from a published weights volume: zero-copy views
     of the resident array in local mode, one whole-volume window read
-    (direct path when resolvable) in remote mode."""
+    (direct path when resolvable) in remote mode.
+
+    ``shard > 1`` is the sharded restore: member ``rank`` of an N-way
+    tensor-parallel replica gets its MEMBER-LOCAL tree — split leaves
+    sliced to this rank's heads/features, replicated leaves whole — out
+    of the same published volume every other member reads (one publish,
+    one content-addressed manifest, N partial restores; reassembling
+    all ranks along the split axes reproduces the full tree
+    byte-identically)."""
+    if not 0 <= rank < max(shard, 1):
+        raise ValueError(f"rank {rank} outside shard={shard}")
     if feeder.controller is not None:
         volume = feeder.controller.get_volume(volume_id)
         if volume is None:
             raise ValueError(f"no volume {volume_id!r} on the controller")
-        return unpack_params(np.asarray(volume.array))
-    raw, _, _ = feeder.fetch_window(volume_id, 0, 0, timeout=timeout)
-    return unpack_params(raw)
+        data = np.asarray(volume.array)
+    else:
+        raw, _, _ = feeder.fetch_window(volume_id, 0, 0, timeout=timeout)
+        data = np.frombuffer(raw, dtype=np.uint8)
+    if shard < 2:
+        tree = unpack_params(data)
+        LAST_RESTORE.clear()
+        LAST_RESTORE.update(
+            shard=1, rank=0, bytes_staged=int(data.nbytes),
+            total_bytes=int(data.nbytes))
+        return tree
+    return _unpack_shard(data, shard, rank)

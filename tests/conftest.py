@@ -22,9 +22,16 @@ import sys
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# Compile effort, not correctness: the tier-1 box is a single vCPU and the
+# suite's wall clock is dominated by XLA compiles of the same small models
+# (measured: test_train 185s -> 143s, chaos+shard smoke 90s -> 45s). Byte-
+# identity pins compare runs within one process, so they see the same
+# executable either way. Callers that want full optimization (bench.py on
+# real hardware never imports this conftest) are unaffected.
+if "xla_backend_optimization_level" not in _flags:
+    _flags = (_flags + " --xla_backend_optimization_level=0").strip()
+os.environ["XLA_FLAGS"] = _flags
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -5,9 +5,12 @@ rungs (replica SIGKILL -> retry-before-first-token, black-holed channel
 OOM) plus the serve-free quorum-registry rungs (symmetric partition ->
 minority step-down + majority election + split-brain census 0; rolling
 restart of all 3 members -> writes resume per hop with ONE Watch stream
-surviving) and the KV peer-fetch rung (prefix adopted from a peer's
+surviving), the KV peer-fetch rung (prefix adopted from a peer's
 exported volume, then the holder SIGKILLed mid-fetch -> recompute
-fallback, byte-identical), each converging on its declared
+fallback, byte-identical) and the shard-member-kill rung (a shard-2
+replica's member lease SIGKILLed -> not-ready flip, router rotates
+with zero client errors, drain + re-prestage heals on a stage-cache
+hit staging only the member slice), each converging on its declared
 /debug/events heal signature with zero client-visible errors,
 byte-identical routed outputs, and a zero-leak census
 (bench.chaos_smoke() itself raises on any divergence). The compound
@@ -26,7 +29,8 @@ def test_chaos_smoke_rungs_converge_and_fault_points_are_free():
     extras = bench.chaos_smoke()  # raises AssertionError on divergence
     assert extras["chaos_rung_names"] == [
         "replica_kill", "channel_blackhole", "pool_exhaustion",
-        "quorum_partition", "registry_rolling_restart", "kv_peer_fetch"]
+        "quorum_partition", "registry_rolling_restart", "kv_peer_fetch",
+        "shard_member_kill"]
     assert extras["chaos_event_signature"] == [
         ["replica_kill", "router_mark_failed", "router_retry"],
         ["channel_blackhole", "router_mark_failed", "router_retry"],
@@ -36,6 +40,8 @@ def test_chaos_smoke_rungs_converge_and_fault_points_are_free():
         ["registry_rolling_restart", "registry_election",
          "registry_promotion"],
         ["kv_peer_fetch", "kv_peer_fetch", "kv_fetch_fallback"],
+        ["shard_member_kill", "shard_member_lost",
+         "shard_member_healed"],
     ]
     serve_free = {"quorum_partition", "registry_rolling_restart"}
     for rung in extras["chaos_report"]:

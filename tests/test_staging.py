@@ -398,3 +398,70 @@ class TestNativeJpegDecode:
             staging_mod.decode_jpeg_batch = real
         for a, b in zip(native_out, pil_out):
             assert np.abs(a.astype(int) - b.astype(int)).max() <= 3
+
+
+# -- io_uring fast path ------------------------------------------------------
+# read_into's middle engine: when the C++ lib is away, a raw-syscall
+# io_uring ring serves the read byte-identically; when THAT is away too
+# (seccomp, old kernel, OIM_IO_URING=0), the readinto loop does. The
+# direct tests skip where the kernel refuses io_uring_setup; the
+# fallback-chain test runs everywhere.
+
+
+def _uring_or_skip():
+    if not staging.io_uring_available():
+        pytest.skip("io_uring unavailable (seccomp/kernel/OIM_IO_URING=0)")
+
+
+def test_io_uring_byte_identity_vs_readinto(datafile, monkeypatch):
+    _uring_or_skip()
+    path, data = datafile
+    monkeypatch.setattr(staging, "_lib", False)  # no native: ring branch
+    dst = np.empty(len(data), np.uint8)
+    staging.read_into(path, dst)
+    assert staging.read_path() == "io_uring"
+    assert dst.tobytes() == data
+    ref = np.empty(len(data), np.uint8)
+    assert staging._readinto_loop(str(path), ref, 0) == len(data)
+    assert dst.tobytes() == ref.tobytes()
+
+
+def test_io_uring_offset_read(datafile, monkeypatch):
+    _uring_or_skip()
+    path, data = datafile
+    monkeypatch.setattr(staging, "_lib", False)
+    off = (1 << 20) + 77  # deliberately unaligned
+    dst = np.empty(len(data) - off, np.uint8)
+    staging.read_into(path, dst, offset=off)
+    assert dst.tobytes() == data[off:]
+
+
+def test_io_uring_many_chunks_in_flight(tmp_path, monkeypatch):
+    _uring_or_skip()
+    rng = np.random.RandomState(3)
+    data = rng.bytes(9 * (1 << 20) + 31)  # > 2 CHUNKs, EOF-straddling tail
+    path = tmp_path / "big.bin"
+    path.write_bytes(data)
+    monkeypatch.setattr(staging, "_lib", False)
+    dst = np.empty(len(data), np.uint8)
+    staging.read_into(path, dst)
+    assert dst.tobytes() == data
+
+
+def test_io_uring_short_read_raises(datafile, monkeypatch):
+    _uring_or_skip()
+    path, data = datafile
+    monkeypatch.setattr(staging, "_lib", False)
+    dst = np.empty(len(data) + 10, np.uint8)  # asks past EOF
+    with pytest.raises(staging.StagingError, match="got"):
+        staging.read_into(path, dst)
+
+
+def test_read_path_reports_fallback_chain(datafile, monkeypatch):
+    path, data = datafile
+    monkeypatch.setattr(staging, "_lib", False)
+    monkeypatch.setattr(staging, "_uring", False)  # kernel said no
+    dst = np.empty(len(data), np.uint8)
+    staging.read_into(path, dst)
+    assert staging.read_path() == "readinto"
+    assert dst.tobytes() == data
