@@ -9,7 +9,7 @@
 PY ?= python
 RUFF := $(shell command -v ruff 2>/dev/null)
 
-.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke slo-smoke autoscale-smoke prefix-smoke paged-smoke spec-smoke kvtier-smoke shard-smoke chaos chaos-smoke quorum-smoke control-plane-bench scalesim-smoke
+.PHONY: test pytest lint drift proto native tsan demo start stop clean replication-demo trace-demo bench-smoke serve-smoke router-smoke obs-smoke slo-smoke autoscale-smoke prefix-smoke paged-smoke spec-smoke kvtier-smoke disagg-smoke shard-smoke chaos chaos-smoke quorum-smoke control-plane-bench scalesim-smoke
 
 # drift and tsan are standalone conveniences; the full pytest target
 # already runs both (SpecDrift + the TSAN stream test build in-fixture).
@@ -125,6 +125,20 @@ shard-smoke:
 # tests/test_kvtier_smoke.py.
 kvtier-smoke:
 	env JAX_PLATFORMS=cpu $(PY) bench.py --serve --smoke --peer-prefix
+
+# Prefill/decode disaggregation acceptance loop (~1 min): a 2-replica
+# split fleet (one prefill-role replica chunk-prefilling and shipping
+# finished chains as content-addressed volumes, one decode-role replica
+# adopting them) vs a unified 2-mixed baseline of the same geometry,
+# under a bimodal prompt mix with long prompts in flight. Gates:
+# short-prompt first-token p99 and decode inter-token p99 hold against
+# the baseline (interleaved min-time rounds), peer-shipped first-token
+# p50 strictly beats decode-local recompute, every routed output
+# byte-identical to solo generate(), and a zero-leak census on both
+# tiers (pages, host bytes, exported volumes, pooled channels). Also
+# runs in tier-1 as tests/test_disagg_smoke.py.
+disagg-smoke:
+	env JAX_PLATFORMS=cpu $(PY) bench.py --serve --smoke --disagg
 
 # Observability-plane acceptance loop (seconds): in-process registry +
 # 2 serve replicas + router; one trace_id traced from a /metrics

@@ -124,6 +124,22 @@ def main(argv=None) -> int:
                              "peer-hit vs full-recompute first-token "
                              "p50, and a zero-leak census across the "
                              "HBM tier, host tier and exported volumes")
+    parser.add_argument("--disagg", action="store_true",
+                        help="with --serve: the prefill/decode "
+                             "disaggregation bench — a 1-prefill + "
+                             "1-decode split fleet (the prefill pick "
+                             "chunk-prefills and ships the finished KV "
+                             "chain as a content-addressed volume; the "
+                             "decode pick adopts the pages) vs a "
+                             "unified 2-mixed baseline under a bimodal "
+                             "long/short mix, interleaved min-time "
+                             "rounds; gates short-prompt first-token "
+                             "p99 and decode inter-token p99 ratios, "
+                             "peer-shipped vs decode-local first-token "
+                             "p50, byte identity vs solo generate(), "
+                             "and a zero-leak census on both tiers "
+                             "(with --smoke: the trimmed tier-1 "
+                             "variant)")
     parser.add_argument("--spec-tokens", type=int, default=0,
                         help="with --serve: speculative decoding — a "
                              "draft model proposes this many tokens per "
@@ -242,6 +258,16 @@ def main(argv=None) -> int:
     if args.serve and args.peer_prefix:
         print(json.dumps({"metric": "peer_prefix_smoke", "value": 1,
                           "unit": "ok", "extras": peer_prefix_smoke()}))
+        return 0
+
+    if args.serve and args.disagg:
+        extras = disagg_bench(smoke=args.smoke)
+        print(json.dumps({
+            "metric": "disagg_smoke" if args.smoke else "disagg_bench",
+            "value": extras["short_first_token_p99_ratio"],
+            "unit": "x",
+            "extras": extras,
+        }))
         return 0
 
     if args.serve and args.shard > 1:
@@ -1029,6 +1055,12 @@ def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
         first_token_s: list[float] = []
         first_hit_s: list[float] = []
         first_miss_s: list[float] = []
+        # The prompt-mix split: pooled percentiles average a bimodal
+        # population (a long prompt's prefill dominates its first
+        # token), hiding exactly the head-of-line stall the mix
+        # exists to expose — report each length bucket on its own.
+        first_short_s: list[float] = []
+        first_long_s: list[float] = []
         token_gap_s: list[float] = []
         finished_at: list[float] = []
         rejected = [0]
@@ -1061,6 +1093,8 @@ def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
                     first_token_s.append(first)
                     (first_hit_s if shared_flags[i]
                      else first_miss_s).append(first)
+                    (first_long_s if long_flags[i] and not shared_flags[i]
+                     else first_short_s).append(first)
                     token_gap_s.extend(gaps)
                     finished_at.append(last)
             except Exception as err:  # noqa: BLE001 - tallied below
@@ -1215,6 +1249,13 @@ def serve_bench(n_requests: int = 64, offered_rps: float = 16.0,
             pstats = mix_pstats
             extras.update({
                 "prompt_mix": True,
+                # Per-length-bucket first-token percentiles (the
+                # pooled first_token_* columns above stay for
+                # continuity with BENCH_r0x records).
+                "first_token_short_p50_ms": pct(first_short_s, 50),
+                "first_token_short_p99_ms": pct(first_short_s, 99),
+                "first_token_long_p50_ms": pct(first_long_s, 50),
+                "first_token_long_p99_ms": pct(first_long_s, 99),
                 "slot_occupancy_mean": (
                     round(float(np.mean(occupancy_samples)) / max_batch, 4)
                     if occupancy_samples else None),
@@ -2032,6 +2073,407 @@ def peer_prefix_smoke() -> dict:
         for eng in (eng_a, eng_b, eng_c):
             if eng is not None:
                 eng.stop(drain=False, timeout=30)
+
+
+def _disagg_round(router_addr: str, short_reqs, long_reqs,
+                  concurrency: int = 4, stagger_s: float = 0.03):
+    """One flood round against a routed cluster: the first long-prompt
+    request fires, the shorts drain concurrently ``stagger_s`` later,
+    and the remaining longs fire one stagger apart WHILE the shorts
+    decode (the head-of-line shape disaggregation exists to absorb).
+    Returns (short_results, long_results, short_first_s, short_gap_s,
+    wall_s, errors) — first-token and inter-token samples come from
+    the SHORT streams only (the victim population)."""
+    import queue as queue_mod
+    import threading
+
+    from oim_tpu.common import tlsutil
+    from oim_tpu.spec import ServeStub, pb
+
+    work: "queue_mod.Queue[int]" = queue_mod.Queue()
+    for i in range(len(short_reqs)):
+        work.put(i)
+    short_results: list[list[int] | None] = [None] * len(short_reqs)
+    long_results: list[list[int] | None] = [None] * len(long_reqs)
+    first_s: list[float] = []
+    gap_s: list[float] = []
+    errors: list[Exception] = []
+    lock = threading.Lock()
+    chans = [tlsutil.dial(router_addr, None)
+             for _ in range(max(2, concurrency // 2) + 1)]
+
+    def stream(stub, req):
+        prompt, n_new, temp, seed = req
+        toks: list[int] = []
+        gaps: list[float] = []
+        first = None
+        start = last = time.monotonic()
+        for delta in stub.Generate(
+                pb.GenerateRequest(prompt=prompt, max_new_tokens=n_new,
+                                   temperature=temp, seed=seed),
+                timeout=300):
+            now = time.monotonic()
+            if first is None:
+                first = now - start
+            else:
+                gaps.append(now - last)
+            last = now
+            toks.extend(delta.tokens)
+        return toks, first, gaps
+
+    def long_worker(li):
+        try:
+            toks, _, _ = stream(ServeStub(chans[-1]), long_reqs[li])
+            with lock:
+                long_results[li] = toks
+        except Exception as err:  # noqa: BLE001 - tallied by caller
+            with lock:
+                errors.append(err)
+
+    def short_worker(wi):
+        stub = ServeStub(chans[wi % (len(chans) - 1)])
+        while True:
+            try:
+                i = work.get_nowait()
+            except queue_mod.Empty:
+                return
+            try:
+                toks, first, gaps = stream(stub, short_reqs[i])
+                with lock:
+                    short_results[i] = toks
+                    first_s.append(first)
+                    gap_s.extend(gaps)
+            except Exception as err:  # noqa: BLE001 - tallied by caller
+                with lock:
+                    errors.append(err)
+
+    t0 = time.monotonic()
+    long_threads = []
+    if long_reqs:
+        t = threading.Thread(target=long_worker, args=(0,), daemon=True)
+        t.start()
+        long_threads.append(t)
+        time.sleep(stagger_s)  # the long prefill is IN FLIGHT first
+    threads = [threading.Thread(target=short_worker, args=(w,),
+                                daemon=True)
+               for w in range(concurrency)]
+    for t in threads:
+        t.start()
+    for li in range(1, len(long_reqs)):
+        time.sleep(stagger_s)  # mid-decode arrival: the cadence test
+        t = threading.Thread(target=long_worker, args=(li,), daemon=True)
+        t.start()
+        long_threads.append(t)
+    for t in threads + long_threads:
+        t.join(timeout=300)
+    wall = time.monotonic() - t0
+    for channel in chans:
+        channel.close()
+    return short_results, long_results, first_s, gap_s, wall, errors
+
+
+def disagg_bench(smoke: bool = False) -> dict:
+    """Prefill/decode disaggregation acceptance bench (ROADMAP item 2
+    step 2), asserting end to end:
+
+    1. the split — a routed long-prompt request runs its prompt on the
+       prefill-tier pick (big-batch CHUNKED prefill, retirement exports
+       the finished chain as a content-addressed kvchain volume) and
+       its stream on the decode-tier pick, which adopts the shipped
+       pages over the data path instead of recomputing; every routed
+       output, short or long, greedy or sampled, is byte-identical to
+       its solo generate() run;
+    2. isolation — under a bimodal mix with long prompts IN FLIGHT,
+       the split fleet's short-prompt first-token p99 and decode-tier
+       inter-token p99 hold against a unified 2-mixed-replica baseline
+       of the same total geometry (interleaved min-time rounds: the
+       two clusters alternate round by round on the same box, and each
+       metric keeps its best round — drift cancels instead of gating);
+    3. the handoff wins — decode-tier first-token p50 with the prefill
+       peer-shipped beats decode-local recompute of the same prompt
+       shape;
+    4. census — both tiers drain to zero pages/host bytes, exported
+       volumes unpublish cleanly, the channel pool stays bounded.
+
+    The tier-1 guard wired in as tests/test_disagg_smoke.py and
+    `make disagg-smoke`."""
+    import statistics
+
+    import jax
+
+    from oim_tpu.common import metrics as M
+    from oim_tpu.controller import MallocBackend
+    from oim_tpu.controller.controller import ControllerService
+    from oim_tpu.feeder import Feeder
+    from oim_tpu.models import generate as gen, llama
+    from oim_tpu.serve.kvvolume import (
+        PeerPrefixFetcher,
+        config_fingerprint,
+        export_chain,
+    )
+
+    block, n_long_blocks, long_new, max_new = 16, 28, 4, 8
+    # Same shape as the peer-prefix smoke: 4 layers x 448-token long
+    # prompts make a full recompute prefill visibly outweigh both the
+    # peer adoption and the short prompts it stalls.
+    cfg = llama.tiny(vocab=64, dim=32, n_layers=4)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    max_seq, max_batch = 512, 4
+    rounds = 2 if smoke else 4
+    n_short, trials = 6, (2 if smoke else 3)
+    rng = np.random.RandomState(11)
+
+    def long_prompt():
+        # Fresh tokens every time: a repeated long prompt would hit
+        # prefix stores on BOTH clusters and measure cache luck, not
+        # the head-of-line stall.
+        return rng.randint(
+            1, cfg.vocab, size=block * n_long_blocks + 1).tolist()
+
+    def make_short_reqs():
+        return [
+            (rng.randint(1, cfg.vocab,
+                         size=int(rng.randint(2, 9))).tolist(),
+             int(rng.randint(4, max_new + 1)),
+             0.0 if i % 2 == 0 else 0.8,
+             int(rng.randint(0, 1 << 16)))
+            for i in range(n_short)
+        ]
+
+    def solo(prompt, n_new, temp, seed):
+        return gen.generate(
+            params, np.asarray([prompt], np.int32), n_new, cfg,
+            temperature=temp, rng=jax.random.PRNGKey(seed),
+            max_seq=max_seq)[0, len(prompt):].tolist()
+
+    def verify(reqs, results, label):
+        for (prompt, n_new, temp, seed), toks in zip(reqs, results):
+            if toks is None:
+                raise AssertionError(f"{label}: request never completed")
+            want = solo(prompt, n_new, temp, seed)
+            if toks != want:
+                raise AssertionError(
+                    f"{label}: routed tokens diverge from solo "
+                    f"generate() (temp={temp} seed={seed}): "
+                    f"{toks} != {want}")
+
+    def timed(eng, prompt, temp, seed):
+        t0 = time.perf_counter()
+        handle = eng.submit(prompt, max_new=long_new,
+                            temperature=temp, seed=seed)
+        first, toks = None, []
+        for tok in handle.tokens(timeout=300):
+            if first is None:
+                first = time.perf_counter() - t0
+            toks.append(tok)
+        return first, toks
+
+    feeder = Feeder(controller=ControllerService(MallocBackend()))
+    split_counter = M.SERVE_PREFILL_HANDOFFS.labels(outcome="split")
+    hit_counter = M.SERVE_PREFIX_PEER_FETCHES.labels(outcome="hit")
+    disagg_kwargs = [
+        # r0 = the prompt tier: chunked prefill (2 blocks per slice),
+        # retirement exports wired below (set_handoff_export needs the
+        # built engine).
+        dict(role="prefill", prefill_chunk=2 * block),
+        # r1 = the stream tier: adopts peer-shipped chains.
+        dict(role="decode",
+             kv_fetch=PeerPrefixFetcher(
+                 feeder, config_fingerprint(cfg, block))),
+    ]
+    with contextlib.ExitStack() as stack:
+        d_router, d_engines, _, d_pool = stack.enter_context(
+            router_cluster(params, cfg, 2, max_batch=max_batch,
+                           max_seq=max_seq, queue_depth=64,
+                           engine_kwargs=disagg_kwargs))
+        u_router, u_engines, _, _ = stack.enter_context(
+            router_cluster(params, cfg, 2, max_batch=max_batch,
+                           max_seq=max_seq, queue_depth=64))
+        prefill_eng, decode_eng = d_engines
+        prefill_eng.set_handoff_export(
+            lambda eng, hashes: export_chain(eng, feeder, hashes))
+
+        # ---- warm every jit program both timed paths touch ----------
+        warm_long = long_prompt()
+        for eng in (*d_engines, *u_engines):
+            eng.submit([1, 2, 3], max_new=2).result(timeout=300)
+        for eng in (decode_eng, *u_engines):
+            # The full-length prefill bucket (decode-local fallback and
+            # the unified baseline's normal path).
+            eng.submit(warm_long, max_new=2).result(timeout=300)
+        # The prefill tier's chunk buckets, plus one routed split so
+        # the decode tier compiles its adoption path (fetch + staged
+        # pages + tail-bucket resume) outside any timed window.
+        _, _, _, _, _, errs = _disagg_round(
+            d_router.addr, [], [(long_prompt(), 2, 0.0, 0)])
+        if errs:
+            raise AssertionError(f"disagg warm round failed: {errs[0]!r}")
+        _, _, _, _, _, errs = _disagg_round(
+            u_router.addr, [], [(warm_long, 2, 0.0, 0)])
+        if errs:
+            raise AssertionError(
+                f"unified warm round failed: {errs[0]!r}")
+
+        # ---- peer-shipped vs decode-local first token ----------------
+        peer_ft, local_ft = [], []
+        for t in range(trials):
+            shipped = long_prompt()
+            temp = 0.0 if t % 2 == 0 else 0.6
+            splits_before = split_counter.value
+            hits_before = hit_counter.value
+            _, lres, _, _, _, errs = _disagg_round(
+                d_router.addr, [],
+                [(shipped, long_new, temp, 100 + t)])
+            if errs:
+                raise AssertionError(
+                    f"routed split request failed: {errs[0]!r}")
+            verify([(shipped, long_new, temp, 100 + t)], lres,
+                   "split trial")
+            if split_counter.value <= splits_before:
+                raise AssertionError(
+                    "router never split the long-prompt request "
+                    "(no prefill handoff counted)")
+            if hit_counter.value <= hits_before:
+                raise AssertionError(
+                    "decode tier never adopted the shipped chain "
+                    "(no peer-fetch hit counted)")
+            # Same engine, same prompt shape, store evicted before
+            # each: trial A resumes from the shipped volume, trial B
+            # (a chain nobody exported) recomputes locally.
+            decode_eng.evict_prefix_store()
+            ft_peer, toks = timed(decode_eng, shipped, temp,
+                                  seed=200 + t)
+            if toks != solo(shipped, long_new, temp, 200 + t):
+                raise AssertionError(
+                    "peer-adopted decode-tier output diverged from solo")
+            fresh = long_prompt()
+            decode_eng.evict_prefix_store()
+            ft_local, toks = timed(decode_eng, fresh, temp,
+                                   seed=300 + t)
+            if toks != solo(fresh, long_new, temp, 300 + t):
+                raise AssertionError(
+                    "local-recompute decode-tier output diverged "
+                    "from solo")
+            peer_ft.append(ft_peer)
+            local_ft.append(ft_local)
+        peer_p50 = statistics.median(peer_ft)
+        local_p50 = statistics.median(local_ft)
+        if not peer_p50 < local_p50:
+            raise AssertionError(
+                f"peer-shipped first-token p50 {peer_p50 * 1e3:.2f}ms "
+                f"not better than decode-local recompute "
+                f"{local_p50 * 1e3:.2f}ms")
+
+        # ---- interleaved min-time flood rounds -----------------------
+        pct = lambda xs, q: (  # noqa: E731
+            float(np.percentile(xs, q)) if xs else float("nan"))
+        d_rounds, u_rounds = [], []
+        completed = {"disagg": 0, "unified": 0}
+        wall_sum = {"disagg": 0.0, "unified": 0.0}
+        for r in range(rounds):
+            for tag, addr in (("disagg", d_router.addr),
+                              ("unified", u_router.addr)):
+                shorts = make_short_reqs()
+                longs = [(long_prompt(), long_new, 0.0, 1000 + 10 * r),
+                         (long_prompt(), long_new, 0.8, 1001 + 10 * r)]
+                sres, lres, first_s, gap_s, wall, errs = _disagg_round(
+                    addr, shorts, longs)
+                if errs:
+                    raise AssertionError(
+                        f"{tag} flood round {r} had client-visible "
+                        f"errors: {errs[0]!r}")
+                verify(shorts, sres, f"{tag} round {r} shorts")
+                verify(longs, lres, f"{tag} round {r} longs")
+                row = {"ft_p50": pct(first_s, 50),
+                       "ft_p99": pct(first_s, 99),
+                       "it_p99": pct(gap_s, 99)}
+                (d_rounds if tag == "disagg" else u_rounds).append(row)
+                completed[tag] += len(shorts) + len(longs)
+                wall_sum[tag] += wall
+        # One no-flood round on the split fleet: the decode tier's
+        # undisturbed cadence, the with/without comparison column.
+        shorts = make_short_reqs()
+        sres, _, _, gap_noflood, _, errs = _disagg_round(
+            d_router.addr, shorts, [])
+        if errs:
+            raise AssertionError(
+                f"no-flood round had errors: {errs[0]!r}")
+        verify(shorts, sres, "no-flood shorts")
+
+        best = lambda rows, key: min(row[key] for row in rows)  # noqa: E731
+        d_ft_p99, u_ft_p99 = best(d_rounds, "ft_p99"), \
+            best(u_rounds, "ft_p99")
+        d_it_p99, u_it_p99 = best(d_rounds, "it_p99"), \
+            best(u_rounds, "it_p99")
+        ft_ratio = d_ft_p99 / u_ft_p99
+        it_ratio = d_it_p99 / u_it_p99
+        # The hold gates: the split fleet must not trade the flood
+        # stall for a new one. The margin absorbs scheduler noise on a
+        # shared CI box; the expected ratios sit well under 1.
+        if not ft_ratio <= 1.25:
+            raise AssertionError(
+                f"short-prompt first-token p99 did not hold under the "
+                f"long-prompt flood: disagg {d_ft_p99 * 1e3:.1f}ms vs "
+                f"unified {u_ft_p99 * 1e3:.1f}ms ({ft_ratio:.2f}x)")
+        if not it_ratio <= 1.25:
+            raise AssertionError(
+                f"decode inter-token p99 did not hold under the "
+                f"long-prompt flood: disagg {d_it_p99 * 1e3:.1f}ms vs "
+                f"unified {u_it_p99 * 1e3:.1f}ms ({it_ratio:.2f}x)")
+
+        # ---- census: both tiers drain to zero ------------------------
+        exported = prefill_eng.exported_volumes()
+        if not exported:
+            raise AssertionError("prefill tier exported no volumes")
+        for eng in (*d_engines, *u_engines):
+            eng.stop(drain=True, timeout=60)
+            eng.evict_prefix_store()
+            used = eng.pool_stats()["used_pages"]
+            if used:
+                raise AssertionError(
+                    f"{eng.role} tier leaked {used} HBM pages")
+            host = eng.host_stats()
+            if host["entries"] or host["bytes"]:
+                raise AssertionError(
+                    f"{eng.role} tier leaked host bytes: {host}")
+        for volume_id in exported.values():
+            feeder.unpublish(volume_id)
+            if feeder.controller.get_volume(volume_id) is not None:
+                raise AssertionError(
+                    f"volume {volume_id} survived unpublish")
+        pooled_channels = len(d_pool)
+
+        return {
+            "serve_qps": round(
+                completed["disagg"] / max(wall_sum["disagg"], 1e-6), 2),
+            "unified_qps": round(
+                completed["unified"] / max(wall_sum["unified"], 1e-6),
+                2),
+            "rounds": rounds,
+            "short_first_token_p50_ms": round(
+                best(d_rounds, "ft_p50") * 1e3, 3),
+            "short_first_token_p99_ms": round(d_ft_p99 * 1e3, 3),
+            "unified_short_first_token_p99_ms": round(
+                u_ft_p99 * 1e3, 3),
+            "short_first_token_p99_ratio": round(ft_ratio, 3),
+            "inter_token_p99_ms": round(d_it_p99 * 1e3, 3),
+            "unified_inter_token_p99_ms": round(u_it_p99 * 1e3, 3),
+            "inter_token_p99_ratio": round(it_ratio, 3),
+            "inter_token_p99_noflood_ms": round(
+                pct(gap_noflood, 99) * 1e3, 3),
+            "peer_first_token_p50_ms": round(peer_p50 * 1e3, 3),
+            "local_first_token_p50_ms": round(local_p50 * 1e3, 3),
+            "peer_speedup_x": round(local_p50 / peer_p50, 3),
+            "handoff_splits": int(split_counter.value),
+            "exported_volumes": len(exported),
+            "pooled_channels": pooled_channels,
+            "byte_identity": True,
+        }
+
+
+def disagg_smoke() -> dict:
+    """The trimmed tier-1 disaggregation gate (`make disagg-smoke`)."""
+    return disagg_bench(smoke=True)
 
 
 @contextlib.contextmanager

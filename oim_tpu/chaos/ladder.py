@@ -50,6 +50,14 @@ kv_peer_fetch       prefix-holder + controller         peer adoption
                                                        recompute; byte-
                                                        identity; both
                                                        tiers census 0
+prefill_replica_    SIGKILL the prefill-tier replica   router mark-failed
+kill                mid-handoff (listener dies under   + plain routing;
+                    the router's split stream; the     decode-local
+                    export never publishes)            recompute after
+                                                       the fleet fetch
+                                                       misses; byte-
+                                                       identity; zero
+                                                       client errors
 shard_member_kill   SIGKILL a non-rank-0 member of     lease lapse flips
                     a 2-way sharded replica            the replica not-
                     mid-stream                         ready; router
@@ -387,6 +395,77 @@ def _run_kv_peer_fetch(sim: ClusterSim, rng: random.Random) -> dict:
             "adopted_blocks": adopted[0]["attrs"]["blocks"],
             "host_demotions": host["demotions"],
             "requests": len(phase1) + 1}
+
+
+def _run_prefill_replica_kill(sim: ClusterSim, rng: random.Random) -> dict:
+    """Disaggregation under fire: r0 is the prefill tier (chunked
+    prefill, retire exports the chain), r1 the decode tier (adopts
+    shipped chains). Phase 1 proves the healthy split end to end; in
+    phase 2 the prefill replica is SIGKILLed MID-HANDOFF — its
+    listener dies while the router's synthetic prefill stream is in
+    flight and the export never completes — so the router must mark
+    it failed and fall back to plain routing, and the decode tier,
+    finding no shipped volume for the new chain, must fall back to
+    local recompute (kv_fetch_fallback): zero client-visible errors,
+    byte-identity throughout, zero-leak census on the survivor."""
+    from oim_tpu.serve.kvvolume import (
+        PeerPrefixFetcher,
+        config_fingerprint,
+        export_chain,
+    )
+
+    sim.warm()
+    r0, r1 = sim.replicas[0], sim.replicas[1]
+    feeder = sim.feeder("host-0")
+    r0.engine.set_handoff_export(
+        lambda eng, hashes: export_chain(eng, feeder, hashes))
+    r1.engine.set_kv_fetch(PeerPrefixFetcher(
+        sim.feeder("host-0"),
+        config_fingerprint(r1.engine.cfg, r1.engine.page_tokens)))
+    mark = sim.mark_faults()
+
+    # Phase 1 — the healthy split: one routed long prompt runs its
+    # prompt on r0 (chunked), the retire hook ships the chain, and the
+    # stream lands on r1, which adopts the shipped pages instead of
+    # recomputing (greedy, pinned to solo generate()).
+    prompt = [rng.randrange(1, 64) for _ in range(33)]  # 2 full blocks
+    reqs = [(prompt, 4, 0.0, 7)]
+    results, errors = sim.routed_load(reqs, concurrency=1)
+    assert not errors, f"healthy split round errored: {errors}"
+    assert sim.assert_byte_identity(reqs, results) == len(reqs)
+    adopted = [e for e in sim.debug_events(events.KV_PEER_FETCH)
+               if e["seq"] > mark]
+    assert adopted and adopted[0]["attrs"]["blocks"] == 2, \
+        f"decode tier never adopted the shipped chain: {adopted}"
+
+    # Phase 2 — SIGKILL mid-handoff: the export hook now kills r0's
+    # listener and heartbeat BEFORE raising, ON the engine thread —
+    # the synthetic prefill stream the router is draining dies under
+    # it deterministically, and the volume is never published. The
+    # client request must still finish byte-identical: router
+    # mark-failed + plain routing, then decode-local recompute after
+    # the fleet fetch finds nothing.
+    def killing_export(eng, hashes):
+        r0.registration.stop(deregister=False)
+        r0.server.force_stop()
+        r0.alive = False
+        raise ConnectionError("prefill replica SIGKILLed mid-handoff")
+
+    r0.engine.set_handoff_export(killing_export)
+    prompt2 = [rng.randrange(1, 64) for _ in range(33)]
+    reqs2 = [(prompt2, 4, 0.9, rng.randrange(1 << 16))]
+    results2, errors2 = sim.routed_load(reqs2, concurrency=1)
+    assert not errors2, \
+        f"client saw the prefill replica die: {errors2}"
+    assert sim.assert_byte_identity(reqs2, results2) == len(reqs2)
+    sim.wait_heal([events.ROUTER_MARK_FAILED,
+                   events.KV_FETCH_FALLBACK], mark)
+    # Finish the corpse (kill() semantics minus the parts the hook
+    # already did): the engine itself must not survive the rung.
+    r0.engine.stop(drain=False, timeout=30, quiet=True)
+    return {"requests": len(reqs) + len(reqs2),
+            "adopted_blocks": adopted[0]["attrs"]["blocks"],
+            "survivor": r1.rid}
 
 
 def _run_compound(sim: ClusterSim, rng: random.Random) -> dict:
@@ -925,6 +1004,13 @@ RUNGS: tuple[Rung, ...] = (
          dict(replicas=2, controllers=1,
               engine_kwargs=[dict(kv_host_bytes=1 << 20),
                              dict(kv_host_bytes=1 << 20)])),
+    Rung("prefill_replica_kill",
+         (events.KV_PEER_FETCH, events.ROUTER_MARK_FAILED,
+          events.KV_FETCH_FALLBACK),
+         _run_prefill_replica_kill,
+         dict(replicas=2, controllers=1,
+              engine_kwargs=[dict(role="prefill", prefill_chunk=8),
+                             dict(role="decode")])),
     Rung("shard_member_kill",
          (events.SHARD_MEMBER_LOST, events.SHARD_MEMBER_HEALED),
          _run_shard_member_kill,
@@ -950,7 +1036,8 @@ RUNGS: tuple[Rung, ...] = (
 # restart over 3 registries only; the full leader-kill-under-load rung
 # runs in `make chaos`).
 SMOKE_RUNGS = ("replica_kill", "channel_blackhole", "pool_exhaustion",
-               "kv_peer_fetch", "shard_member_kill", "quorum_partition",
+               "kv_peer_fetch", "prefill_replica_kill",
+               "shard_member_kill", "quorum_partition",
                "registry_rolling_restart")
 
 

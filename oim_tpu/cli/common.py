@@ -4,6 +4,7 @@ LoadTLSConfig pattern, cmd/*/main.go)."""
 from __future__ import annotations
 
 import argparse
+import os
 
 from oim_tpu.common import logging as oim_logging
 from oim_tpu.common.tlsutil import TLSConfig, load_tls
@@ -32,8 +33,11 @@ def add_registry_flag(
 def add_common_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument(
         "--log-level",
-        default="info",
-        help="debug|info|warning|error (reference -log.level flag)",
+        default=os.environ.get("OIM_LOG_LEVEL", "info"),
+        help="debug|info|warning|error (reference -log.level flag; "
+             "OIM_LOG_LEVEL env overrides the default — fleet operators "
+             "and the test harness quiet every daemon without threading "
+             "the flag through each spawn site)",
     )
     parser.add_argument(
         "--log-format",

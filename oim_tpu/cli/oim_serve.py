@@ -311,6 +311,24 @@ def main(argv: list[str] | None = None) -> int:
              "addressed KV volumes every --heartbeat seconds, so peers "
              "with --kv-peer-fetch skip the prefill. Needs a feeder")
     parser.add_argument(
+        "--role", default="mixed",
+        choices=("prefill", "decode", "mixed"),
+        help="disaggregation role, advertised in the heartbeat row: "
+             "prefill = prompt tier (big-batch chunked prefill; each "
+             "retirement exports the finished chain as a content-"
+             "addressed kvchain volume — needs a control plane), "
+             "decode = stream tier (pair with --kv-peer-fetch to adopt "
+             "shipped pages), mixed = unified legacy behavior. The "
+             "router splits long-prompt requests across the tiers and "
+             "falls back to decode-local prefill on any defect")
+    parser.add_argument(
+        "--prefill-chunk", type=int, default=0,
+        help="chunked prefill: prefill long prompts in slices of this "
+             "many tokens, one decode round over resident slots "
+             "between slices, so one long prompt never stalls the "
+             "batch's decode cadence (byte-identical — chunking only "
+             "changes dispatch order). 0 = one full-length prefill")
+    parser.add_argument(
         "--window-compress", action="store_true",
         help="ask volume servers to zlib-compress ReadVolume window "
              "chunks (applied only when smaller; negotiated per stream "
@@ -427,6 +445,13 @@ def main(argv: list[str] | None = None) -> int:
             "--kv-peer-fetch/--kv-export need a control plane "
             "(--backend or --registry + --controller-id), not "
             "--checkpoint-dir")
+    if args.role == "prefill" and args.checkpoint_dir:
+        # A prefill replica's entire product is the exported chain;
+        # without a feeder there is nowhere to ship pages to.
+        raise SystemExit(
+            "--role prefill exports KV chains and needs a control "
+            "plane (--backend or --registry + --controller-id), not "
+            "--checkpoint-dir")
     if args.platform:
         import jax as _jax
 
@@ -468,7 +493,17 @@ def main(argv: list[str] | None = None) -> int:
         spec_pool_tokens=args.spec_pool_tokens,
         shard=args.shard,
         member_hbm_budget=args.member_hbm_budget,
+        role=args.role,
+        prefill_chunk=args.prefill_chunk,
     )
+    if args.role == "prefill" and feeder is not None:
+        # The prefill tier exports at RETIREMENT, synchronously: the
+        # decode pick is already waiting on the volume, so the lazy
+        # --kv-export sweep (below) is the wrong vehicle for handoffs.
+        from oim_tpu.serve.kvvolume import export_chain
+
+        engine.set_handoff_export(
+            lambda eng, hashes: export_chain(eng, feeder, hashes))
     server = serve_server(
         args.endpoint,
         ServeService(engine, stream_tokens=args.stream_tokens),

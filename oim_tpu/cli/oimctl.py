@@ -384,6 +384,7 @@ def top_row(row_id: str, status: str, role: str, target: str,
     import json
 
     row = {"id": row_id, "status": status, "role": role, "qps": None,
+           "tier": None,
            "ft_ms": (None, None), "it_ms": (None, None), "queue": None,
            "slots": None, "cache_hit": None, "prefix_hit": None,
            "pages": None, "kvtier": None, "accept": None, "shard": None,
@@ -411,6 +412,13 @@ def top_row(row_id: str, status: str, role: str, target: str,
     # signal, 0 would be a lie.
     if role == "serve":
         row["qps"] = _series_value(samples, "oim_serve_qps")
+        # Disaggregation role (prefill/decode/mixed): the info gauge's
+        # label whose sample is 1. Dash for pre-role scrapes, whose
+        # series is absent entirely — the PAGES/SHARD stance.
+        for n, lbls, v in samples:
+            if n == "oim_serve_role" and v == 1 and lbls.get("role"):
+                row["tier"] = lbls["role"]
+                break
         for key, kind in (("ft_ms", "first"), ("it_ms", "next")):
             p50, p99 = _series_quantiles(
                 samples, "oim_serve_token_latency_seconds", {"kind": kind})
@@ -548,6 +556,7 @@ def fleet_top_row(entries) -> dict:
 
 def _empty_fleet_row() -> dict:
     return {"id": "ALL", "status": "-", "role": "fleet", "qps": None,
+            "tier": None,
             "ft_ms": (None, None), "it_ms": (None, None), "queue": None,
             "slots": None, "cache_hit": None, "prefix_hit": None,
             "pages": None, "kvtier": None, "accept": None, "shard": None,
@@ -642,7 +651,10 @@ def render_top(rows: list[dict]) -> str:
         cell = f"{hbm:g}/{host:g}"
         return f"{cell}+{peer:g}" if peer else cell
 
-    headers = ("ID", "ROLE", "STATUS", "QPS", "FIRST-TOK(ms)",
+    # KIND is the process kind (serve/registry/router); ROLE is the
+    # serve tier's disaggregation role (prefill/decode/mixed), dashed
+    # for non-serve rows and pre-role scrapes.
+    headers = ("ID", "KIND", "ROLE", "STATUS", "QPS", "FIRST-TOK(ms)",
                "INTER-TOK(ms)", "QUEUE", "SLOTS", "SHARD", "PAGES",
                "KV-TIER", "ACCEPT", "CACHE-HIT", "PREFIX-HIT",
                "REPL-LAG", "COMMIT(ms)", "PICK(ms)", "SPREAD",
@@ -652,7 +664,8 @@ def render_top(rows: list[dict]) -> str:
         top_events = sorted(r["events"].items(),
                             key=lambda kv: -kv[1])[:2]
         table.append((
-            r["id"], r["role"], r["status"], fmt(r["qps"]),
+            r["id"], r["role"], r.get("tier") or "-",
+            r["status"], fmt(r["qps"]),
             fmt_pair(r["ft_ms"]), fmt_pair(r["it_ms"]),
             fmt(r["queue"], "{:g}"), fmt(r["slots"]),
             fmt_pages(r.get("shard")),

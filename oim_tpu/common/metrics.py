@@ -729,6 +729,34 @@ SERVE_ICI_ALLREDUCE = DEFAULT.histogram(
     "linking a slow collective to the request it stalled",
     buckets=(0.00001, 0.000025, 0.00005, 0.0001, 0.00025, 0.0005,
              0.001, 0.0025, 0.005, 0.01, 0.05))
+# Prefill/decode disaggregation: replicas specialize by phase and the
+# router splits a request across tiers — prefill runs big-batch chunked
+# prefill and ships the finished chain as a content-addressed kvchain
+# volume; the decode pick adopts the pages instead of recomputing.
+SERVE_ROLE = DEFAULT.gauge(
+    "oim_serve_role",
+    "info gauge: the label whose sample is 1 names this replica's "
+    "serving role (prefill = big-batch prompt tier that exports "
+    "finished chains, decode = occupancy-packed stream tier, mixed = "
+    "unified legacy behavior); advertised in the heartbeat snapshot "
+    "and rendered as oimctl --top's ROLE column",
+    labelnames=("role",))
+SERVE_PREFILL_HANDOFFS = DEFAULT.counter(
+    "oim_serve_prefill_handoffs_total",
+    "prefill-tier handoff outcomes: split = router sent the prompt to "
+    "a prefill pick before streaming from decode, exported = the "
+    "retired chain was published as a kvchain volume, skipped = "
+    "nothing exportable (prompt shorter than one block, or the volume "
+    "already published), export_failed / fallback = the defect paths "
+    "that degrade to decode-local prefill (never a wrong resume)",
+    labelnames=("outcome",))
+SERVE_PREFILL_CHUNK_SECONDS = DEFAULT.histogram(
+    "oim_serve_prefill_chunk_seconds",
+    "one --prefill-chunk slice of a long prompt's prefill (device-sync "
+    "included) — the bound on how long a resident stream's decode "
+    "cadence can stall behind prompt work between interleaved steps",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+             0.5, 1.0, 2.5))
 # Request router (oim_tpu/router: least-loaded LB over serve replicas).
 ROUTER_REQUESTS_TOTAL = DEFAULT.counter(
     "oim_router_requests_total",

@@ -115,10 +115,20 @@ class RouterService:
         upstream_lanes: int = 4,
         affinity: bool = True,
         affinity_guard: int | None = None,
+        disagg: bool = True,
     ):
         self.table = table
         self.tls = tls
         self.affinity = affinity
+        # Prefill/decode disaggregation: when the table holds a
+        # prefill-tier replica (and at least one non-prefill row), the
+        # router SPLITS a long-prompt request — the prompt runs on the
+        # prefill pick (whose retirement exports the finished chain as
+        # a content-addressed volume), the stream runs on the normal
+        # pick (whose kv-fetch adopts the pages instead of
+        # recomputing). Off, or with no prefill tier registered, every
+        # request routes exactly as before.
+        self.disagg = bool(disagg)
         self.affinity_guard = (self.AFFINITY_GUARD if affinity_guard is None
                                else affinity_guard)
         self._pool = pool if pool is not None else channelpool.shared()
@@ -232,6 +242,14 @@ class RouterService:
                       if r.replica_id not in exclude]
         if not candidates:
             return None, False
+        # Prefill-tier rows take only the prompt half of a split
+        # request (_prefill_split dials them directly); the stream
+        # pick skips them — unless they are ALL that's routable, where
+        # serving whole requests from the prefill tier beats refusing
+        # (a prefill replica is a complete engine, just mis-packed).
+        non_prefill = [r for r in candidates if r.role != "prefill"]
+        if non_prefill:
+            candidates = non_prefill
         if prefer_version:
             same = [r for r in candidates if r.version == prefer_version]
             if same:
@@ -336,9 +354,59 @@ class RouterService:
         except grpc.RpcError as err:
             yield ("err", err)
 
+    def _prefill_split(self, context, span, prompt) -> None:
+        """The prompt half of a disaggregated request: run the prompt
+        through the least-loaded prefill-tier replica as a synthetic
+        1-token greedy generate, drained and DISCARDED — its only
+        product is the side effect, the retired chain exported as a
+        content-addressed volume the stream pick's kv-fetch adopts.
+        Every defect degrades to plain routing (the stream pick
+        prefills locally — slower, never wrong), so this method never
+        raises and never touches the client stream."""
+        replicas = self.table.replicas()
+        prefill = [r for r in replicas if r.role == "prefill"]
+        if not prefill or len(prefill) == len(replicas):
+            return  # no prefill tier, or nothing left to stream from
+        with self._lock:
+            target = min(
+                prefill,
+                key=lambda r: self._score(r, self._inflight[r.replica_id]))
+        if target.prefix_block < 1 \
+                or len(prompt) <= target.prefix_block:
+            # Nothing exportable: the chain a decode admission can
+            # adopt is the prompt's FULL blocks with >= 1 token left
+            # to prefill, so a sub-block prompt ships zero pages.
+            return
+        handoff = pb.GenerateRequest(
+            prompt=prompt, max_new_tokens=1, temperature=0.0,
+            seed=0).SerializeToString()
+        try:
+            channel = self._pool.get(
+                target.endpoint, self.tls,
+                lane=next(self._next_lane) % self.upstream_lanes)
+            call = channel.unary_stream(
+                GENERATE_METHOD, request_serializer=_IDENTITY,
+                response_deserializer=_IDENTITY,
+            )(handoff, timeout=context.time_remaining(),
+              metadata=tracing.inject([], span.context))
+            if not context.add_callback(call.cancel):
+                call.cancel()
+            for _ in call:
+                pass
+            span.attrs["prefill_split"] = target.replica_id
+            M.SERVE_PREFILL_HANDOFFS.labels(outcome="split").inc()
+        except Exception:  # noqa: BLE001 - best-effort by contract
+            self.table.mark_failed(target.replica_id)
+            M.SERVE_PREFILL_HANDOFFS.labels(outcome="fallback").inc()
+            from_context().warning(
+                "prefill handoff failed; falling back to local prefill",
+                replica=target.replica_id)
+
     def _route(self, request, context, span, prompt=None,
                prefix_len: int = 0):
         log = from_context()
+        if self.disagg and prompt:
+            self._prefill_split(context, span, prompt)
         tried: set[str] = set()
         last_err: grpc.RpcError | None = None
         hash_cache: dict = {}  # one hashing of the prompt per request

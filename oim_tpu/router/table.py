@@ -78,6 +78,11 @@ class Replica:
     # as a soft retry preference — a version is never a routability
     # filter, so a mixed-version fleet keeps every row in play.
     version: str = ""
+    # Disaggregation role (prefill | decode | mixed). Missing or
+    # malformed reads "mixed": a role-less row from a pre-role replica
+    # routes exactly as today, so a mixed-version fleet never strands
+    # traffic on a parse difference.
+    role: str = "mixed"
 
     @classmethod
     def parse(cls, path: str, value: str) -> "Replica | None":
@@ -127,6 +132,9 @@ class Replica:
                 isinstance(k, str) and isinstance(v, str)
                 for k, v in vol_map.items()):
             volumes = tuple(vol_map.keys())
+        role = snap.get("role")
+        if role not in ("prefill", "decode", "mixed"):
+            role = "mixed"
         try:
             return cls(
                 replica_id=parts[1],
@@ -141,6 +149,7 @@ class Replica:
                 prefix_volumes=frozenset(volumes),
                 version=(snap["version"]
                          if isinstance(snap.get("version"), str) else ""),
+                role=role,
             )
         except (TypeError, ValueError):
             return None

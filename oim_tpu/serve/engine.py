@@ -411,6 +411,8 @@ class ServeEngine:
         spec_reprobe_rounds: int = 256,
         shard: int = 1,
         member_hbm_budget: int = 0,
+        role: str = "mixed",
+        prefill_chunk: int = 0,
         name: str = "",
     ):
         import jax
@@ -449,6 +451,23 @@ class ServeEngine:
 
             gen.shard_config(cfg, self.shard)  # head-divisibility check
             shardlib.tp_mesh(self.shard)       # device-count check
+        # Prefill/decode disaggregation: the role is advertised in the
+        # heartbeat snapshot (stats() below) so the router can split a
+        # request across tiers — prefill replicas run big-batch chunked
+        # prefill and export finished chains, decode replicas stream.
+        # The engine itself stays role-agnostic on the data path: role
+        # only changes what rides the heartbeat and whether the retire
+        # hook exports (set_handoff_export).
+        self.role = str(role)
+        if self.role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"role must be prefill, decode or mixed, got {role!r}")
+        # Chunked prefill: long prompts prefill in slices of this many
+        # tokens, interleaving one decode step between slices so
+        # resident streams never stall behind one long prompt. 0 = one
+        # full-length prefill (today's behavior). Byte-identity holds:
+        # chunking changes dispatch order, never attention math.
+        self.prefill_chunk = max(0, int(prefill_chunk))
         self._jax, self._jnp = jax, jnp
         # The engine's name in fault-point context (ctx: engine=...): a
         # multi-replica process (bench clusters, the chaos sim) arms a
@@ -533,6 +552,12 @@ class ServeEngine:
         # (deepest hash -> volume id), advertised in the heartbeat row
         # so peers and freshly booted replicas can resolve them.
         self._exported: dict[str, str] = {}
+        # Prefill-tier handoff: when set (set_handoff_export), a
+        # retiring slot's finished chain is exported synchronously from
+        # the retire path — the decode pick is already waiting on the
+        # volume, so the background --kv-export sweep is too slow.
+        self._handoff_export = None
+        M.SERVE_ROLE.labels(role=self.role).set(1)
         # Full cumulative-hash chains of recent admissions (deepest hash
         # -> ordered chain, MRU last). hot_prefixes() advertises bare
         # hashes; the volume exporter needs the ORDER that rebuilds a
@@ -822,7 +847,18 @@ class ServeEngine:
                 # (Replica.parse reads only the fields it knows).
                 "target_steps": self._target_steps,
                 "decode_tokens": self._decode_tokens,
+                # Disaggregation role rides the heartbeat row; pre-role
+                # routers ignore it, new routers split requests across
+                # tiers (missing/malformed reads back as "mixed").
+                "role": self.role,
             }
+            if self.role == "prefill":
+                # A COLD prefill replica must still advertise its block
+                # size: the router's split gate compares prompt length
+                # against it, and registration only stamps the block
+                # alongside a non-empty hot-prefix advertisement —
+                # which a freshly booted prefill tier doesn't have yet.
+                snap["prefix_block"] = self.prefix_block
             if self.shard > 1:
                 # Shard keys ride the heartbeat row only on sharded
                 # replicas (same stance as the spec keys): pre-shard
@@ -1093,6 +1129,16 @@ class ServeEngine:
     def exported_volumes(self) -> dict:
         with self._lock:
             return dict(self._exported)
+
+    def set_handoff_export(self, fn) -> None:
+        """Arm the prefill-tier retire hook: ``fn(engine, hashes)``
+        runs synchronously on the engine thread when a slot retires
+        with an exportable chain (oim-serve wires export_chain here
+        for --role prefill). The decode pick is already waiting on
+        the volume, so this cannot ride the lazy --kv-export sweep.
+        None disarms."""
+        with self._lock:
+            self._handoff_export = fn
 
     def hot_chains(self, n: int = 4) -> list[tuple]:
         """The full cumulative-hash chains of the most recent
@@ -1508,20 +1554,23 @@ class ServeEngine:
         jnp = self._jnp
         P = m * self.prefix_block
         tail = req.prompt[P:]
-        padded = np.zeros((1, self._bucket(len(tail))), np.int32)
-        padded[0, :len(tail)] = tail
-        span_attrs = {"slot": slot, "prompt_tokens": n}
-        if P:
-            span_attrs["prefix_tokens"] = P
-        with tracing.start_span(
-                "serve.prefill", parent=req.trace_ctx, **span_attrs):
-            tok, self._cache, key = self._prefill(
-                self.params, self._cache, jnp.asarray(padded),
-                jnp.int32(len(tail)),
-                jnp.asarray(self._tables[slot]), jnp.int32(P),
-                self._jax.random.PRNGKey(req.seed),
-                jnp.float32(req.temperature))
-            tok = int(tok)
+        if self.prefill_chunk and len(tail) > self.prefill_chunk:
+            tok, key = self._prefill_chunked(req, slot, n, m)
+        else:
+            padded = np.zeros((1, self._bucket(len(tail))), np.int32)
+            padded[0, :len(tail)] = tail
+            span_attrs = {"slot": slot, "prompt_tokens": n}
+            if P:
+                span_attrs["prefix_tokens"] = P
+            with tracing.start_span(
+                    "serve.prefill", parent=req.trace_ctx, **span_attrs):
+                tok, self._cache, key = self._prefill(
+                    self.params, self._cache, jnp.asarray(padded),
+                    jnp.int32(len(tail)),
+                    jnp.asarray(self._tables[slot]), jnp.int32(P),
+                    self._jax.random.PRNGKey(req.seed),
+                    jnp.float32(req.temperature))
+                tok = int(tok)
         if self._prefix is not None:
             if P:
                 req.prefix_tokens = P
@@ -1530,6 +1579,69 @@ class ServeEngine:
             else:
                 M.SERVE_PREFIX_MISSES.inc()
         M.SERVE_PREFILL_TOKENS.labels(source="compute").inc(n - P)
+        return tok, key
+
+    def _prefill_chunked(self, req: _Request, slot: int, n: int, m: int):
+        """The prompt tail in --prefill-chunk token slices, one decode
+        round over the RESIDENT slots between slices — admission never
+        stalls a long prompt behind the batch, and the batch's decode
+        cadence never stalls behind a long prompt. Byte-identical to
+        one full prefill: every slice runs the SAME compiled program
+        over the same pages at shifted ``start`` (attention math is
+        position-indexed, not dispatch-indexed), and every slice gets
+        the ORIGINAL PRNGKey(seed) — the program splits it once
+        internally, so keeping only the final slice's (token, carry)
+        reproduces exactly what the one-shot path returns.
+
+        While slices interleave with decode, this slot's target table
+        row is ZEROED (prefill runs through a device copy of the row
+        instead): the row is not yet in _slots, so lockstep decode
+        treats it as idle — and an idle row's scatter at a stale
+        position must land on scratch page 0, never in the freshly
+        mapped pages (m of which are SHARED store pages other slots
+        read). The draft row gets the same treatment."""
+        jnp = self._jnp
+        P = m * self.prefix_block
+        tail = req.prompt[P:]
+        chunk = self.prefill_chunk
+        table_row = self._tables[slot].copy()
+        self._tables[slot, :] = 0
+        self._tables_dev = None
+        draft_row = None
+        if self.spec_tokens:
+            draft_row = self._draft_tables[slot].copy()
+            self._draft_tables[slot, :] = 0
+            self._draft_tables_dev = None
+        table_dev = jnp.asarray(table_row)
+        key0 = self._jax.random.PRNGKey(req.seed)
+        tok = key = None
+        with tracing.start_span(
+                "serve.prefill", parent=req.trace_ctx, slot=slot,
+                prompt_tokens=n, chunk_tokens=chunk,
+                chunks=-(-len(tail) // chunk)):
+            for off in range(0, len(tail), chunk):
+                piece = tail[off:off + chunk]
+                padded = np.zeros((1, self._bucket(len(piece))), np.int32)
+                padded[0, :len(piece)] = piece
+                t0 = time.monotonic()
+                tok, self._cache, key = self._prefill(
+                    self.params, self._cache, jnp.asarray(padded),
+                    jnp.int32(len(piece)), table_dev,
+                    jnp.int32(P + off), key0,
+                    jnp.float32(req.temperature))
+                tok = int(tok)  # device sync: the slice is DONE here
+                M.SERVE_PREFILL_CHUNK_SECONDS.observe(
+                    time.monotonic() - t0, self._trace_id(req))
+                if off + chunk < len(tail):
+                    with self._lock:
+                        resident = any(r is not None for r in self._slots)
+                    if resident:
+                        self._decode_once()
+        self._tables[slot, :] = table_row
+        self._tables_dev = None
+        if draft_row is not None:
+            self._draft_tables[slot, :] = draft_row
+            self._draft_tables_dev = None
         return tok, key
 
     def _release_slot(self, slot: int, req: _Request,
@@ -1573,6 +1685,15 @@ class ServeEngine:
         self._release_slot(slot, req)
         with self._lock:
             self._slots[slot] = None
+            export = self._handoff_export
+        if export is not None and reason != "cancelled":
+            # Prefill-tier handoff: the chain this retirement just
+            # donated to the store exports NOW, on the engine thread
+            # (synchronous D2H is legal here — _call_on_engine
+            # short-circuits), before _finish closes the client
+            # stream: when the stream ends, the decode pick's fetch
+            # must already find the volume.
+            self._export_handoff(req, export)
         if reason == "cancelled":
             # Normal retirement (eos/length) is the steady state, not an
             # incident; an eviction by client cancel/deadline is what the
@@ -1582,6 +1703,34 @@ class ServeEngine:
         self._occupancy()
         self._finish(req, reason)
         return True
+
+    def _export_handoff(self, req: _Request, export) -> None:
+        """Export the retiring request's prompt chain as a
+        content-addressed volume. The chain is ``usable_hashes`` — the
+        full-block prefix a decode admission will MATCH — not the raw
+        chain_hashes: the volume id is the deepest hash the decode
+        pick's fetcher probes, so the two sides must derive it from
+        the same truncation. Dedup on the deepest hash: re-publishing
+        an already-exported volume id is a feeder error, not a refresh."""
+        hashes = prefixhash.usable_hashes(req.prompt, self.prefix_block)
+        if not hashes:
+            M.SERVE_PREFILL_HANDOFFS.labels(outcome="skipped").inc()
+            return
+        with self._lock:
+            done = hashes[-1] in self._exported
+        if done:
+            M.SERVE_PREFILL_HANDOFFS.labels(outcome="skipped").inc()
+            return
+        try:
+            volume_id = export(self, list(hashes))
+        except Exception:  # noqa: BLE001 - handoff is best-effort
+            from_context().warning(
+                "prefill handoff export failed; decode falls back to "
+                "local prefill", trace_id=self._trace_id(req))
+            M.SERVE_PREFILL_HANDOFFS.labels(outcome="export_failed").inc()
+            return
+        M.SERVE_PREFILL_HANDOFFS.labels(
+            outcome="exported" if volume_id else "export_failed").inc()
 
     def _decode_once(self) -> None:
         """One decode round over every resident slot: a speculative

@@ -33,11 +33,30 @@ if "xla_backend_optimization_level" not in _flags:
     _flags = (_flags + " --xla_backend_optimization_level=0").strip()
 os.environ["XLA_FLAGS"] = _flags
 
+# grpc's C core logs INFO-level GOAWAY/teardown chatter (absl "I0000 ...
+# chttp2_transport.cc") straight to stderr, which splices into pytest's
+# progress lines and corrupts the tier-1 log. Only errors are signal here;
+# must be set before the first grpc import initializes the C core.
+os.environ.setdefault("GRPC_VERBOSITY", "ERROR")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# In-process daemons (registry replication, feeder drivers, serve engines)
+# log INFO/WARNING chatter to stderr from background threads, which lands
+# mid-line in pytest's progress output — the tier-1 log's dot lines must
+# stay machine-parseable. Errors still print. CLI assertions in the suite
+# read stdout, never these stderr lines.
+from oim_tpu.common import logging as _oim_logging  # noqa: E402
+
+_oim_logging.get_global().level = _oim_logging.ERROR
+# In-process CLI mains (setup_logging) and subprocess daemons re-create the
+# global logger from --log-level's default; the env override keeps them at
+# ERROR too.
+os.environ.setdefault("OIM_LOG_LEVEL", "error")
 
 import pytest  # noqa: E402
 

@@ -7,7 +7,10 @@ minority step-down + majority election + split-brain census 0; rolling
 restart of all 3 members -> writes resume per hop with ONE Watch stream
 surviving), the KV peer-fetch rung (prefix adopted from a peer's
 exported volume, then the holder SIGKILLed mid-fetch -> recompute
-fallback, byte-identical) and the shard-member-kill rung (a shard-2
+fallback, byte-identical), the prefill-replica-kill rung (the
+disaggregated prompt tier SIGKILLed mid-handoff -> router mark-failed
++ plain routing + decode-local recompute, zero client errors,
+byte-identical) and the shard-member-kill rung (a shard-2
 replica's member lease SIGKILLed -> not-ready flip, router rotates
 with zero client errors, drain + re-prestage heals on a stage-cache
 hit staging only the member slice), each converging on its declared
@@ -23,6 +26,17 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
+def teardown_module(_module):
+    # Eight rungs x several sim replicas each leave a pile of compiled
+    # executables in XLA's in-process cache; each one is live LLVM code
+    # mappings counted against the kernel's vm.max_map_count cap. Drop
+    # them so the accumulated suite stays clear of the cap (crossing it
+    # segfaults a later module's compile).
+    import jax
+
+    jax.clear_caches()
+
+
 def test_chaos_smoke_rungs_converge_and_fault_points_are_free():
     import bench
 
@@ -30,7 +44,7 @@ def test_chaos_smoke_rungs_converge_and_fault_points_are_free():
     assert extras["chaos_rung_names"] == [
         "replica_kill", "channel_blackhole", "pool_exhaustion",
         "quorum_partition", "registry_rolling_restart", "kv_peer_fetch",
-        "shard_member_kill"]
+        "prefill_replica_kill", "shard_member_kill"]
     assert extras["chaos_event_signature"] == [
         ["replica_kill", "router_mark_failed", "router_retry"],
         ["channel_blackhole", "router_mark_failed", "router_retry"],
@@ -40,6 +54,8 @@ def test_chaos_smoke_rungs_converge_and_fault_points_are_free():
         ["registry_rolling_restart", "registry_election",
          "registry_promotion"],
         ["kv_peer_fetch", "kv_peer_fetch", "kv_fetch_fallback"],
+        ["prefill_replica_kill", "kv_peer_fetch", "router_mark_failed",
+         "kv_fetch_fallback"],
         ["shard_member_kill", "shard_member_lost",
          "shard_member_healed"],
     ]

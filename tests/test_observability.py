@@ -489,6 +489,28 @@ class TestMetricsDrift:
         assert (metrics.SERVE_PREFIX_PEER_FETCHES.labelnames
                 == ("outcome",))
 
+    def test_disagg_metrics_declared_and_shaped(self):
+        """The disaggregation metric names are API (ISSUE 20): the
+        role gauge stays labeled BY ROLE (`oimctl --top`'s ROLE column
+        reads the label whose sample is 1), the handoff counter BY
+        OUTCOME (split/exported/skipped/export_failed/fallback —
+        runbooks rate() the failure outcomes), and the chunk histogram
+        is what `--prefill-chunk` is tuned against: a slice must
+        outlast a decode step, and these buckets bracket both."""
+        assert isinstance(metrics.SERVE_ROLE, Gauge)
+        assert metrics.SERVE_ROLE.name == "oim_serve_role"
+        assert metrics.SERVE_ROLE.labelnames == ("role",)
+        assert isinstance(metrics.SERVE_PREFILL_HANDOFFS, Counter)
+        assert (metrics.SERVE_PREFILL_HANDOFFS.name
+                == "oim_serve_prefill_handoffs_total")
+        assert metrics.SERVE_PREFILL_HANDOFFS.labelnames == ("outcome",)
+        assert isinstance(metrics.SERVE_PREFILL_CHUNK_SECONDS, Histogram)
+        assert (metrics.SERVE_PREFILL_CHUNK_SECONDS.name
+                == "oim_serve_prefill_chunk_seconds")
+        assert metrics.SERVE_PREFILL_CHUNK_SECONDS.buckets == (
+            0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+            1.0, 2.5)
+
     def test_control_plane_metrics_declared_and_shaped(self):
         """The control-plane self-metric names are API (ISSUE 18):
         bench.py --control-plane curves them at 10/100/1000 replicas
